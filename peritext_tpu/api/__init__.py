@@ -1,0 +1,1 @@
+"""Placeholder package init; populated by subsequent milestones."""

@@ -1,0 +1,6 @@
+"""Test/fuzz harness utilities."""
+
+from .accumulate import accumulate_patches
+from .generate import generate_docs
+
+__all__ = ["accumulate_patches", "generate_docs"]

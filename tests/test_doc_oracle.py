@@ -1,0 +1,812 @@
+"""Port of the reference's example-based suite (reference test/micromerge.ts,
+49 cases).  Each case seeds two replicas with shared history, applies
+concurrent changes, cross-merges, and asserts that both the batch read path
+(get_text_with_formatting) and the incremental patch path (accumulate_patches)
+converge to the expected span list."""
+
+import pytest
+
+from peritext_tpu import Doc, span
+from peritext_tpu.testing import accumulate_patches, generate_docs
+
+DEFAULT_TEXT = "The Peritext editor"
+
+
+def run_trace_spec(
+    initial_text=DEFAULT_TEXT,
+    pre_ops=None,
+    input_ops1=(),
+    input_ops2=(),
+    expected_result=None,
+):
+    """Reference testConcurrentWrites (test/micromerge.ts:45-85)."""
+    docs, patches, _ = generate_docs(initial_text)
+    doc1, doc2 = docs
+    patches1, patches2 = patches
+
+    if pre_ops:
+        change0, patches0 = doc1.change([{**op, "path": ["text"]} for op in pre_ops])
+        patches1 = patches1 + patches0
+        patches2 = patches2 + doc2.apply_change(change0)
+
+    change1, p1 = doc1.change([{**op, "path": ["text"]} for op in input_ops1])
+    patches1 = patches1 + p1
+    change2, p2 = doc2.change([{**op, "path": ["text"]} for op in input_ops2])
+    patches2 = patches2 + p2
+
+    patches2 = patches2 + doc2.apply_change(change1)
+    patches1 = patches1 + doc1.apply_change(change2)
+
+    # Batch read path
+    assert doc1.get_text_with_formatting(["text"]) == expected_result
+    assert doc2.get_text_with_formatting(["text"]) == expected_result
+    # Incremental patch path
+    assert accumulate_patches(patches1) == expected_result
+    assert accumulate_patches(patches2) == expected_result
+
+
+STRONG = {"strong": {"active": True}}
+EM = {"em": {"active": True}}
+
+
+def test_insert_and_delete_text():
+    docs, _, _ = generate_docs("abcde")
+    doc1 = docs[0]
+    doc1.change([{"path": ["text"], "action": "delete", "index": 0, "count": 3}])
+    assert "".join(doc1.root["text"]) == "de"
+
+
+def test_records_local_changes_in_deps_clock():
+    docs, _, _ = generate_docs("a")
+    doc1, doc2 = docs
+    change2, _ = doc2.change(
+        [{"path": ["text"], "action": "insert", "index": 1, "values": ["b"]}]
+    )
+    doc1.apply_change(change2)  # must not raise
+    assert doc1.root["text"] == ["a", "b"]
+    assert doc2.root["text"] == ["a", "b"]
+
+
+def test_concurrent_deletion_and_insertion():
+    run_trace_spec(
+        initial_text="abrxabra",
+        input_ops1=[
+            {"action": "delete", "index": 3, "count": 1},
+            {"action": "insert", "index": 4, "values": ["c", "a"]},
+        ],
+        input_ops2=[{"action": "insert", "index": 5, "values": ["d", "a"]}],
+        expected_result=[span("abracadabra")],
+    )
+
+
+def test_flattens_local_formatting_into_spans():
+    run_trace_spec(
+        input_ops1=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"}
+        ],
+        expected_result=[
+            span("The "),
+            span("Peritext", dict(STRONG)),
+            span(" editor"),
+        ],
+    )
+
+
+def test_merges_concurrent_overlapping_bold_and_italic():
+    run_trace_spec(
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 12, "markType": "strong"}
+        ],
+        input_ops2=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 19, "markType": "em"}
+        ],
+        expected_result=[
+            span("The ", dict(STRONG)),
+            span("Peritext", {**STRONG, **EM}),
+            span(" editor", dict(EM)),
+        ],
+    )
+
+
+def test_merges_insert_at_end_and_italic_to_end():
+    run_trace_spec(
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 12, "markType": "strong"},
+            {"action": "insert", "index": 19, "values": list(" is great!")},
+        ],
+        input_ops2=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 19, "markType": "em"}
+        ],
+        expected_result=[
+            span("The ", dict(STRONG)),
+            span("Peritext", {**STRONG, **EM}),
+            span(" editor is great!", dict(EM)),
+        ],
+    )
+
+
+def test_merges_concurrent_bold_and_unbold():
+    run_trace_spec(
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 12, "markType": "strong"}
+        ],
+        input_ops2=[
+            {"action": "removeMark", "startIndex": 4, "endIndex": 19, "markType": "strong"}
+        ],
+        expected_result=[span("The ", dict(STRONG)), span("Peritext editor")],
+    )
+
+
+def test_unbold_inside_bold():
+    run_trace_spec(
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 19, "markType": "strong"}
+        ],
+        input_ops2=[
+            {"action": "removeMark", "startIndex": 4, "endIndex": 12, "markType": "strong"}
+        ],
+        expected_result=[
+            span("The ", dict(STRONG)),
+            span("Peritext"),
+            span(" editor", dict(STRONG)),
+        ],
+    )
+
+
+def test_unbold_one_character():
+    run_trace_spec(
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 19, "markType": "strong"}
+        ],
+        input_ops2=[
+            {"action": "removeMark", "startIndex": 4, "endIndex": 5, "markType": "strong"}
+        ],
+        expected_result=[
+            span("The ", dict(STRONG)),
+            span("P"),
+            span("eritext editor", dict(STRONG)),
+        ],
+    )
+
+
+def test_spans_collapsed_to_zero_width():
+    run_trace_spec(
+        pre_ops=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"},
+            {"action": "delete", "index": 4, "count": 8},
+        ],
+        input_ops1=[{"action": "insert", "index": 4, "values": ["x"]}],
+        expected_result=[span("The x editor")],
+    )
+
+
+# --- span growing behavior on a single actor (reference :322) ---
+
+
+def test_grows_bold_span_to_the_right():
+    run_trace_spec(
+        input_ops2=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"},
+            {"action": "insert", "index": 12, "values": ["!"]},
+        ],
+        expected_result=[
+            span("The "),
+            span("Peritext!", dict(STRONG)),
+            span(" editor"),
+        ],
+    )
+
+
+def test_does_not_grow_bold_span_to_the_left():
+    run_trace_spec(
+        input_ops2=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"},
+            {"action": "insert", "index": 4, "values": ["!"]},
+        ],
+        expected_result=[
+            span("The !"),
+            span("Peritext", dict(STRONG)),
+            span(" editor"),
+        ],
+    )
+
+
+def test_does_not_grow_link_to_the_right():
+    run_trace_spec(
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "inkandswitch.com"},
+            },
+            {"action": "insert", "index": 12, "values": ["!"]},
+        ],
+        expected_result=[
+            span("The "),
+            span("Peritext", {"link": {"active": True, "url": "inkandswitch.com"}}),
+            span("! editor"),
+        ],
+    )
+
+
+def test_does_not_grow_link_to_the_left():
+    run_trace_spec(
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "inkandswitch.com"},
+            },
+            {"action": "insert", "index": 4, "values": ["!"]},
+        ],
+        expected_result=[
+            span("The !"),
+            span("Peritext", {"link": {"active": True, "url": "inkandswitch.com"}}),
+            span(" editor"),
+        ],
+    )
+
+
+def test_grows_only_bold_when_bold_and_link_end_together():
+    run_trace_spec(
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "inkandswitch.com"},
+            },
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"},
+            {"action": "insert", "index": 12, "values": ["!"]},
+        ],
+        expected_result=[
+            span("The "),
+            span(
+                "Peritext",
+                {"link": {"active": True, "url": "inkandswitch.com"}, **STRONG},
+            ),
+            span("!", dict(STRONG)),
+            span(" editor"),
+        ],
+    )
+
+
+def test_grows_adjacent_bold_and_unbold_spans():
+    run_trace_spec(
+        initial_text="ABCDE",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 5, "markType": "strong"},
+            {"action": "removeMark", "startIndex": 1, "endIndex": 4, "markType": "strong"},
+            {"action": "insert", "index": 1, "values": ["F"]},
+            {"action": "insert", "index": 5, "values": ["G"]},
+        ],
+        expected_result=[
+            span("AF", dict(STRONG)),
+            span("BCDG"),
+            span("E", dict(STRONG)),
+        ],
+    )
+
+
+def test_growth_behavior_when_boundary_is_tombstone():
+    run_trace_spec(
+        initial_text="ABCDE",
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 1,
+                "endIndex": 4,
+                "markType": "link",
+                "attrs": {"url": "inkandswitch.com"},
+            },
+            {"action": "delete", "index": 1, "count": 1},
+            {"action": "delete", "index": 2, "count": 1},
+            {"action": "insert", "index": 2, "values": ["F"]},
+        ],
+        expected_result=[
+            span("A"),
+            span("C", {"link": {"active": True, "url": "inkandswitch.com"}}),
+            span("FE"),
+        ],
+    )
+
+
+# --- span growing behavior with concurrent edits (reference :568) ---
+
+
+def test_concurrent_bold_and_insertion_at_boundary():
+    run_trace_spec(
+        input_ops1=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"}
+        ],
+        input_ops2=[
+            {"action": "insert", "index": 4, "values": ["*"]},
+            {"action": "insert", "index": 13, "values": ["*"]},
+        ],
+        expected_result=[
+            span("The *"),
+            span("Peritext*", dict(STRONG)),
+            span(" editor"),
+        ],
+    )
+
+
+def test_insertion_where_one_mark_ends_and_another_begins():
+    run_trace_spec(
+        input_ops1=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"},
+            {"action": "addMark", "startIndex": 12, "endIndex": 19, "markType": "em"},
+        ],
+        input_ops2=[{"action": "insert", "index": 12, "values": list("[1]")}],
+        expected_result=[
+            span("The "),
+            span("Peritext[1]", dict(STRONG)),
+            span(" editor", dict(EM)),
+        ],
+    )
+
+
+def test_insertion_at_boundary_between_bold_and_unbolded():
+    run_trace_spec(
+        initial_text="AC",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 2, "markType": "strong"},
+            {"action": "removeMark", "startIndex": 1, "endIndex": 2, "markType": "strong"},
+        ],
+        input_ops2=[{"action": "insert", "index": 1, "values": ["B"]}],
+        expected_result=[span("AB", dict(STRONG)), span("C")],
+    )
+
+
+def test_insertion_at_boundary_between_unbolded_and_bold():
+    run_trace_spec(
+        initial_text="AC",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 0, "endIndex": 2, "markType": "strong"},
+            {"action": "removeMark", "startIndex": 0, "endIndex": 1, "markType": "strong"},
+        ],
+        input_ops2=[{"action": "insert", "index": 1, "values": ["B"]}],
+        expected_result=[span("AB"), span("C", dict(STRONG))],
+    )
+
+
+def test_concurrent_adjacent_formatting_ops():
+    run_trace_spec(
+        initial_text="ABCDE",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 1, "endIndex": 2, "markType": "strong"}
+        ],
+        input_ops2=[
+            {"action": "addMark", "startIndex": 2, "endIndex": 3, "markType": "strong"}
+        ],
+        expected_result=[span("A"), span("BC", dict(STRONG)), span("DE")],
+    )
+
+
+def test_addmark_boundary_that_is_tombstone():
+    run_trace_spec(
+        initial_text="The *Peritext* editor",
+        input_ops1=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 14, "markType": "strong"},
+            {"action": "delete", "index": 4, "count": 1},
+            {"action": "delete", "index": 12, "count": 1},
+        ],
+        input_ops2=[
+            {"action": "insert", "index": 5, "values": ["_"]},
+            {"action": "insert", "index": 14, "values": ["_"]},
+        ],
+        expected_result=[
+            span("The "),
+            span("_Peritext_", dict(STRONG)),
+            span(" editor"),
+        ],
+    )
+
+
+def test_insertion_into_deleted_span_with_mark():
+    run_trace_spec(
+        pre_ops=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 12, "markType": "strong"}
+        ],
+        input_ops1=[{"action": "delete", "index": 4, "count": 8}],
+        input_ops2=[
+            {"action": "delete", "index": 5, "count": 3},
+            {"action": "insert", "index": 5, "values": list("ara")},
+        ],
+        expected_result=[
+            span("The "),
+            span("ara", dict(STRONG)),
+            span(" editor"),
+        ],
+    )
+
+
+def test_formatting_on_deleted_span():
+    run_trace_spec(
+        input_ops1=[{"action": "delete", "index": 4, "count": 9}],
+        input_ops2=[
+            {"action": "addMark", "startIndex": 5, "endIndex": 11, "markType": "strong"}
+        ],
+        expected_result=[span("The editor")],
+    )
+
+
+def test_formatting_on_single_character():
+    run_trace_spec(
+        input_ops2=[
+            {"action": "addMark", "startIndex": 4, "endIndex": 5, "markType": "strong"}
+        ],
+        expected_result=[
+            span("The "),
+            span("P", dict(STRONG)),
+            span("eritext editor"),
+        ],
+    )
+
+
+def test_formatting_on_single_deleted_character():
+    run_trace_spec(
+        initial_text="ABCDE",
+        input_ops1=[{"action": "delete", "index": 2, "count": 1}],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 2,
+                "endIndex": 3,
+                "markType": "link",
+                "attrs": {"url": "inkandswitch.com"},
+            }
+        ],
+        expected_result=[span("ABDE")],
+    )
+
+
+def test_mark_starting_and_ending_after_visible_sequence():
+    run_trace_spec(
+        initial_text="ABCDE",
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 2,
+                "endIndex": 4,
+                "markType": "link",
+                "attrs": {"url": "A.com"},
+            },
+            {"action": "delete", "index": 1, "count": 2},
+            {"action": "delete", "index": 2, "count": 1},
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 3,
+                "endIndex": 5,
+                "markType": "link",
+                "attrs": {"url": "A.com"},
+            }
+        ],
+        expected_result=[
+            span("A"),
+            span("D", {"link": {"active": True, "url": "A.com"}}),
+        ],
+    )
+
+
+def test_mark_ending_after_visible_sequence():
+    run_trace_spec(
+        initial_text="ABCDE",
+        input_ops1=[{"action": "delete", "index": 4, "count": 1}],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 3,
+                "endIndex": 5,
+                "markType": "link",
+                "attrs": {"url": "A.com"},
+            }
+        ],
+        expected_result=[
+            span("ABC"),
+            span("D", {"link": {"active": True, "url": "A.com"}}),
+        ],
+    )
+
+
+# --- patches (reference :911-1029) ---
+
+
+def test_patch_for_simple_insertion():
+    docs, _, _ = generate_docs()
+    doc1, doc2 = docs
+    input_ops = [{"path": ["text"], "action": "insert", "index": 7, "values": ["a"]}]
+    change, _ = doc1.change(input_ops)
+    patch = doc2.apply_change(change)
+    assert patch == [{**input_ops[0], "marks": {}}]
+
+
+def test_patch_with_adjusted_insertion_index():
+    docs, _, _ = generate_docs()
+    doc1, doc2 = docs
+    doc1.change(
+        [{"path": ["text"], "action": "insert", "index": 1, "values": ["a", "b", "c"]}]
+    )
+    change2, _ = doc2.change(
+        [{"path": ["text"], "action": "insert", "index": 2, "values": ["b"]}]
+    )
+    patch = doc1.apply_change(change2)
+    assert patch == [
+        {"path": ["text"], "action": "insert", "index": 5, "values": ["b"], "marks": {}}
+    ]
+
+
+def test_patch_for_simple_deletion():
+    docs, _, _ = generate_docs()
+    doc1, doc2 = docs
+    input_ops = [{"path": ["text"], "action": "delete", "index": 5, "count": 1}]
+    change, _ = doc1.change(input_ops)
+    patch = doc2.apply_change(change)
+    assert patch == input_ops
+
+
+def test_multichar_deletion_becomes_single_char_deletions():
+    docs, _, _ = generate_docs()
+    doc1, doc2 = docs
+    change, _ = doc1.change(
+        [{"path": ["text"], "action": "delete", "index": 5, "count": 2}]
+    )
+    patch = doc2.apply_change(change)
+    assert patch == [
+        {"path": ["text"], "action": "delete", "index": 5, "count": 1},
+        {"path": ["text"], "action": "delete", "index": 5, "count": 1},
+    ]
+
+
+# --- comments (reference :1031-1142) ---
+
+
+def test_single_comment_in_flattened_spans():
+    docs, _, _ = generate_docs()
+    doc1 = docs[0]
+    doc1.change(
+        [
+            {
+                "path": ["text"],
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "comment",
+                "attrs": {"id": "abc-123"},
+            }
+        ]
+    )
+    assert doc1.root["text"] == list(DEFAULT_TEXT)
+    assert doc1.get_text_with_formatting(["text"]) == [
+        span("The "),
+        span("Peritext", {"comment": [{"id": "abc-123"}]}),
+        span(" editor"),
+    ]
+
+
+def test_two_comments_same_user():
+    docs, _, _ = generate_docs()
+    doc1 = docs[0]
+    doc1.change(
+        [
+            {
+                "path": ["text"],
+                "action": "addMark",
+                "startIndex": 0,
+                "endIndex": 12,
+                "markType": "comment",
+                "attrs": {"id": "abc-123"},
+            },
+            {
+                "path": ["text"],
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 19,
+                "markType": "comment",
+                "attrs": {"id": "def-789"},
+            },
+        ]
+    )
+    assert doc1.get_text_with_formatting(["text"]) == [
+        span("The ", {"comment": [{"id": "abc-123"}]}),
+        span("Peritext", {"comment": [{"id": "abc-123"}, {"id": "def-789"}]}),
+        span(" editor", {"comment": [{"id": "def-789"}]}),
+    ]
+
+
+def test_overlapping_comments_from_different_users():
+    run_trace_spec(
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 0,
+                "endIndex": 12,
+                "markType": "comment",
+                "attrs": {"id": "abc-123"},
+            }
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 19,
+                "markType": "comment",
+                "attrs": {"id": "def-789"},
+            }
+        ],
+        expected_result=[
+            span("The ", {"comment": [{"id": "abc-123"}]}),
+            span("Peritext", {"comment": [{"id": "abc-123"}, {"id": "def-789"}]}),
+            span(" editor", {"comment": [{"id": "def-789"}]}),
+        ],
+    )
+
+
+# --- links (reference :1144-1289) ---
+
+
+def test_single_link_in_flattened_spans():
+    docs, _, _ = generate_docs()
+    doc1 = docs[0]
+    doc1.change(
+        [
+            {
+                "path": ["text"],
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://inkandswitch.com"},
+            }
+        ]
+    )
+    assert doc1.get_text_with_formatting(["text"]) == [
+        span("The "),
+        span("Peritext", {"link": {"active": True, "url": "https://inkandswitch.com"}}),
+        span(" editor"),
+    ]
+
+
+def test_link_lww_fully_overlapping():
+    run_trace_spec(
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://inkandswitch.com"},
+            }
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://google.com"},
+            }
+        ],
+        expected_result=[
+            span("The "),
+            span("Peritext", {"link": {"active": True, "url": "https://google.com"}}),
+            span(" editor"),
+        ],
+    )
+
+
+def test_link_lww_partially_overlapping():
+    run_trace_spec(
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 0,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://inkandswitch.com"},
+            }
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 19,
+                "markType": "link",
+                "attrs": {"url": "https://google.com"},
+            }
+        ],
+        expected_result=[
+            span("The ", {"link": {"active": True, "url": "https://inkandswitch.com"}}),
+            span(
+                "Peritext editor", {"link": {"active": True, "url": "https://google.com"}}
+            ),
+        ],
+    )
+
+
+def test_links_ending_at_same_place_converge():
+    run_trace_spec(
+        input_ops1=[
+            {
+                "action": "addMark",
+                "startIndex": 11,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://inkandswitch.com"},
+            }
+        ],
+        input_ops2=[
+            {
+                "action": "addMark",
+                "startIndex": 4,
+                "endIndex": 12,
+                "markType": "link",
+                "attrs": {"url": "https://google.com"},
+            }
+        ],
+        expected_result=[
+            span("The "),
+            span("Peritext", {"link": {"active": True, "url": "https://google.com"}}),
+            span(" editor"),
+        ],
+    )
+
+
+# --- cursors (reference :1291-1418) ---
+
+
+def _cursor_doc():
+    docs, _, _ = generate_docs()
+    return docs[0]
+
+
+def test_resolve_cursor_position():
+    doc1 = _cursor_doc()
+    cursor = doc1.get_cursor(["text"], 5)
+    assert doc1.resolve_cursor(cursor) == 5
+
+
+def test_cursor_increments_on_insert_before():
+    doc1 = _cursor_doc()
+    cursor = doc1.get_cursor(["text"], 5)
+    doc1.change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": ["a", "b", "c"]}]
+    )
+    assert doc1.resolve_cursor(cursor) == 8
+
+
+def test_cursor_stays_on_insert_after():
+    doc1 = _cursor_doc()
+    cursor = doc1.get_cursor(["text"], 5)
+    doc1.change(
+        [{"path": ["text"], "action": "insert", "index": 7, "values": ["a", "b", "c"]}]
+    )
+    assert doc1.resolve_cursor(cursor) == 5
+
+
+def test_cursor_moves_left_on_delete_before():
+    doc1 = _cursor_doc()
+    cursor = doc1.get_cursor(["text"], 5)
+    doc1.change([{"path": ["text"], "action": "delete", "index": 0, "count": 3}])
+    assert doc1.resolve_cursor(cursor) == 2
+
+
+def test_cursor_stays_on_delete_after():
+    doc1 = _cursor_doc()
+    cursor = doc1.get_cursor(["text"], 5)
+    doc1.change([{"path": ["text"], "action": "delete", "index": 7, "count": 3}])
+    assert doc1.resolve_cursor(cursor) == 5
+
+
+def test_cursor_returns_zero_when_prefix_deleted():
+    doc1 = _cursor_doc()
+    cursor = doc1.get_cursor(["text"], 5)
+    doc1.change([{"path": ["text"], "action": "delete", "index": 0, "count": 7}])
+    assert doc1.resolve_cursor(cursor) == 0

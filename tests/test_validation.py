@@ -1,0 +1,74 @@
+"""Input validation: bad input must raise before any replica state mutates
+(fixes found in review; the reference poisons its replication stream here)."""
+
+import pytest
+
+from peritext_tpu import Doc, PeritextError
+from peritext_tpu.core.errors import IndexOutOfBounds
+from peritext_tpu.testing import generate_docs
+
+
+def test_failed_change_does_not_advance_seq():
+    docs, _, _ = generate_docs("ab")
+    doc1, doc2 = docs
+    with pytest.raises(IndexOutOfBounds):
+        doc1.change([{"path": ["text"], "action": "insert", "index": 99, "values": ["x"]}])
+    change, _ = doc1.change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": ["y"]}]
+    )
+    assert change.seq == 2  # initial change was 1; failed attempt consumed nothing
+    doc2.apply_change(change)  # peer still in sync
+    assert doc2.root["text"] == ["y", "a", "b"]
+
+
+def test_missing_mark_attrs_rejected_cleanly():
+    docs, _, _ = generate_docs("ab")
+    doc1 = docs[0]
+    with pytest.raises(PeritextError, match="requires attr"):
+        doc1.change(
+            [
+                {
+                    "path": ["text"],
+                    "action": "addMark",
+                    "startIndex": 0,
+                    "endIndex": 2,
+                    "markType": "link",
+                }
+            ]
+        )
+    # Document must remain fully readable (no half-applied mark op).
+    assert doc1.get_text_with_formatting(["text"]) == [{"marks": {}, "text": "ab"}]
+
+
+def test_delete_out_of_bounds_rejected():
+    docs, _, _ = generate_docs("abc")
+    with pytest.raises(IndexOutOfBounds):
+        docs[0].change([{"path": ["text"], "action": "delete", "index": 1, "count": 5}])
+
+
+def test_mark_range_out_of_bounds_rejected():
+    docs, _, _ = generate_docs("abc")
+    with pytest.raises(IndexOutOfBounds):
+        docs[0].change(
+            [
+                {
+                    "path": ["text"],
+                    "action": "addMark",
+                    "startIndex": 2,
+                    "endIndex": 7,
+                    "markType": "strong",
+                }
+            ]
+        )
+
+
+def test_batch_local_makelist_then_insert_validates():
+    doc = Doc("a")
+    change, _ = doc.change(
+        [
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0, "values": ["h", "i"]},
+        ]
+    )
+    assert doc.root["text"] == ["h", "i"]
+    assert len(change.ops) == 3
