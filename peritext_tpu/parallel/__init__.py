@@ -1,1 +1,18 @@
-"""Placeholder package init; populated by subsequent milestones."""
+"""Replication and parallelism: transport, anti-entropy, causal scheduling,
+and (device) mesh sharding of the document axis."""
+
+from .anti_entropy import ChangeStore, apply_changes, get_missing_changes, sync
+from .causal import causal_sort, causal_waves
+from .change_queue import ChangeQueue
+from .pubsub import Publisher
+
+__all__ = [
+    "ChangeStore",
+    "apply_changes",
+    "get_missing_changes",
+    "sync",
+    "causal_sort",
+    "causal_waves",
+    "ChangeQueue",
+    "Publisher",
+]

@@ -1,0 +1,168 @@
+"""Seeded generative fuzz harness (reference ``test/fuzz.ts``, fixed).
+
+N replicas make random edits (insert / delete / addMark / removeMark) and
+randomly pairwise-sync via vector-clock anti-entropy.  Three convergence
+oracles after every sync (reference test/fuzz.ts:207-278):
+
+1. patch path == batch path on each replica (accumulate_patches vs
+   get_text_with_formatting),
+2. synced replicas have identical spans,
+3. synced replicas have identical clocks.
+
+Fixes over the reference fuzzer (documented deviations):
+
+* removeMark actually emits removeMark — the reference's ``removeMarkChange``
+  emits addMark by mistake (test/fuzz.ts:80), so mark removal was never
+  fuzzed upstream.
+* Deterministic seeding (``random.Random(seed)``) for reproducibility.
+* delete ranges are generated in-bounds (the reference's generator can
+  produce out-of-range deletes, its "delete everything goes wonky" bug zone,
+  test/fuzz.ts:127-128).
+
+The generated per-actor change logs are also the workload generator for the
+batched TPU merge path.
+"""
+
+from __future__ import annotations
+
+import random
+import string
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..core.doc import Doc
+from ..core.types import Change, InputOperation, Patch
+from ..parallel.anti_entropy import ChangeStore, apply_changes
+from .accumulate import accumulate_patches
+from .generate import generate_docs
+
+MARK_TYPES = ("strong", "em", "link", "comment")
+EXAMPLE_URLS = tuple(f"{c}.com" for c in string.ascii_uppercase)
+
+
+@dataclass
+class FuzzState:
+    docs: List[Doc]
+    store: ChangeStore
+    patch_lists: List[List[Patch]]
+    rng: random.Random
+    comment_history: List[str] = field(default_factory=list)
+    ops_generated: int = 0
+    syncs: int = 0
+
+
+def make_fuzz_state(seed: int, num_replicas: int = 3, initial_text: str = "ABCDE") -> FuzzState:
+    docs, patch_lists, initial_change = generate_docs(initial_text, num_replicas)
+    store = ChangeStore()
+    store.append(initial_change)
+    return FuzzState(
+        docs=docs, store=store, patch_lists=patch_lists, rng=random.Random(seed)
+    )
+
+
+def random_input_op(state: FuzzState, doc: Doc) -> Optional[InputOperation]:
+    rng = state.rng
+    length = len(doc.root["text"])
+    kind = rng.choice(("insert", "remove", "addMark", "removeMark"))
+
+    if kind == "insert" or length == 0:
+        index = rng.randint(0, length)
+        count = rng.randint(1, 3)
+        values = [rng.choice(string.ascii_lowercase + "0123456789") for _ in range(count)]
+        return {"path": ["text"], "action": "insert", "index": index, "values": values}
+
+    if kind == "remove":
+        index = rng.randrange(length)
+        count = rng.randint(1, length - index)
+        return {"path": ["text"], "action": "delete", "index": index, "count": count}
+
+    # addMark / removeMark
+    start = rng.randrange(length)
+    end = rng.randint(start + 1, length)
+    mark_type = rng.choice(MARK_TYPES)
+    op: InputOperation = {
+        "path": ["text"],
+        "action": "addMark" if kind == "addMark" else "removeMark",
+        "startIndex": start,
+        "endIndex": end,
+        "markType": mark_type,
+    }
+    if mark_type == "link":
+        if kind == "addMark":
+            op["attrs"] = {"url": rng.choice(EXAMPLE_URLS)}
+    elif mark_type == "comment":
+        if kind == "addMark":
+            cid = f"comment-{rng.randrange(1 << 16):04x}"
+            state.comment_history.append(cid)
+            op["attrs"] = {"id": cid}
+        else:
+            if not state.comment_history:
+                return None
+            op["attrs"] = {"id": rng.choice(state.comment_history)}
+    return op
+
+
+def fuzz_step(state: FuzzState, check: bool = True) -> None:
+    """One fuzz iteration: a random edit on a random replica, then a random
+    pairwise sync with convergence checks."""
+    rng = state.rng
+    target = rng.randrange(len(state.docs))
+    doc = state.docs[target]
+
+    input_op = random_input_op(state, doc)
+    if input_op is not None:
+        change, patches = doc.change([input_op])
+        state.store.append(change)
+        state.patch_lists[target].extend(patches)
+        state.ops_generated += len(change.ops)
+
+    left = rng.randrange(len(state.docs))
+    right = rng.randrange(len(state.docs))
+    if left == right:
+        return
+    state.syncs += 1
+
+    for src, dst in ((left, right), (right, left)):
+        missing = state.store.missing_changes(
+            state.docs[src].clock, state.docs[dst].clock
+        )
+        rng.shuffle(missing)  # delivery order must not matter
+        state.patch_lists[dst].extend(apply_changes(state.docs[dst], missing))
+
+    if check:
+        left_spans = state.docs[left].get_text_with_formatting(["text"])
+        right_spans = state.docs[right].get_text_with_formatting(["text"])
+        assert left_spans == right_spans, (
+            f"replica divergence after sync #{state.syncs}:\n{left_spans}\n{right_spans}"
+        )
+        assert state.docs[left].clock == state.docs[right].clock
+        for idx in (left, right):
+            acc = accumulate_patches(state.patch_lists[idx])
+            batch = state.docs[idx].get_text_with_formatting(["text"])
+            assert acc == batch, (
+                f"patch/batch divergence on replica {idx} after sync #{state.syncs}:"
+                f"\npatch: {acc}\nbatch: {batch}"
+            )
+
+
+def run_fuzz(seed: int, iterations: int, num_replicas: int = 3, check: bool = True) -> FuzzState:
+    state = make_fuzz_state(seed, num_replicas)
+    for _ in range(iterations):
+        fuzz_step(state, check=check)
+    return state
+
+
+def generate_workload(
+    seed: int, num_docs: int, ops_per_doc: int, num_replicas: int = 3
+) -> List[Dict[str, List[Change]]]:
+    """Generate ``num_docs`` independent fuzz change-log sets (no checking) —
+    the batched-merge workload for the TPU path."""
+    workloads = []
+    for d in range(num_docs):
+        state = make_fuzz_state(seed + d, num_replicas)
+        while state.ops_generated < ops_per_doc:
+            fuzz_step(state, check=False)
+        workloads.append(
+            {actor: list(state.store.log(actor)) for actor in state.store.actors()}
+        )
+    return workloads
