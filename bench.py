@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""peritext-tpu benchmark: batched CRDT op application throughput.
+
+Measures the north-star metric (BASELINE.md): CRDT ops applied/sec/chip for
+converging a batch of concurrently-edited documents, vs the single-thread
+scalar baseline.
+
+Baseline caveat: BASELINE.json config 1 calls for the reference TypeScript
+micromerge on one CPU core, but this image has no node runtime, so the
+single-thread baseline is this framework's own scalar Python oracle
+(core/doc.py — the same semantics, measured on one core).  The oracle applies
+internal ops through the same applyChange path the reference does.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ops/s", "vs_baseline": N, ...extras}
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def measure_scalar_baseline(num_ops: int = 4000, seed: int = 7) -> float:
+    """Single-thread ops/sec: replay fuzz-generated change logs through the
+    scalar oracle's apply_change path."""
+    from peritext_tpu.core.doc import Doc
+    from peritext_tpu.parallel.causal import causal_sort
+    from peritext_tpu.testing.fuzz import make_fuzz_state, fuzz_step
+
+    state = make_fuzz_state(seed, num_replicas=3)
+    while state.ops_generated < num_ops:
+        fuzz_step(state, check=False)
+    changes = causal_sort(
+        [ch for actor in state.store.actors() for ch in state.store.log(actor)]
+    )
+    total_ops = sum(len(ch.ops) for ch in changes)
+
+    doc = Doc("baseline")
+    t0 = time.perf_counter()
+    for ch in changes:
+        doc.apply_change(ch)
+    elapsed = time.perf_counter() - t0
+    return total_ops / elapsed
+
+
+def run(args) -> dict:
+    import jax
+    from peritext_tpu.ops.kernel import apply_ops_jit
+    from peritext_tpu.ops.packed import empty_docs
+    from peritext_tpu.ops.resolve import resolve_jit
+    from peritext_tpu.testing.synth import synth_op_streams
+
+    d, k, s, m = args.docs, args.ops_per_doc, args.slots, args.marks
+
+    gen_start = time.perf_counter()
+    ops = synth_op_streams(d, k, seed=args.seed)
+    gen_time = time.perf_counter() - gen_start
+
+    apply_jit = apply_ops_jit
+    state0 = empty_docs(d, s, m)
+    ops_dev = jax.device_put(ops)
+
+    # NOTE: jax.block_until_ready does not actually block on the axon TPU
+    # platform; force a small host transfer to synchronize honestly.
+    def sync(r):
+        return np.asarray(r.num_slots)
+
+    compile_start = time.perf_counter()
+    result = apply_jit(state0, ops_dev)
+    sync(result)
+    compile_time = time.perf_counter() - compile_start
+
+    times = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        result = apply_jit(state0, ops_dev)
+        sync(result)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+
+    overflow = int(np.asarray(result.overflow).sum())
+    total_ops = d * k
+    device_ops_per_sec = total_ops / best
+
+    # resolution (read path) timing, reported as extra context
+    resolved = resolve_jit(result, 32)
+    np.asarray(resolved.visible)
+    t0 = time.perf_counter()
+    resolved = resolve_jit(result, 32)
+    np.asarray(resolved.visible)
+    resolve_time = time.perf_counter() - t0
+
+    baseline = measure_scalar_baseline()
+
+    return {
+        "metric": "crdt_ops_per_sec_per_chip",
+        "value": round(device_ops_per_sec, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(device_ops_per_sec / baseline, 2),
+        "baseline_ops_per_sec": round(baseline, 1),
+        "baseline_impl": "scalar-python-oracle-1-core (no node runtime in image for TS reference)",
+        "docs": d,
+        "ops_per_doc": k,
+        "slot_capacity": s,
+        "apply_seconds": round(best, 4),
+        "resolve_seconds": round(resolve_time, 4),
+        "compile_seconds": round(compile_time, 1),
+        "workload_gen_seconds": round(gen_time, 1),
+        "overflow_docs": overflow,
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--smoke", action="store_true", help="small fast config")
+    parser.add_argument("--docs", type=int, default=None)
+    parser.add_argument("--ops-per-doc", type=int, default=None)
+    parser.add_argument("--slots", type=int, default=None)
+    parser.add_argument("--marks", type=int, default=None)
+    parser.add_argument("--iters", type=int, default=3)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    defaults = (64, 128, 192, 64) if args.smoke else (8192, 256, 384, 96)
+    args.docs = args.docs or defaults[0]
+    args.ops_per_doc = args.ops_per_doc or defaults[1]
+    args.slots = args.slots or defaults[2]
+    args.marks = args.marks or defaults[3]
+
+    result = run(args)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

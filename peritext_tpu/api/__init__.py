@@ -1,1 +1,5 @@
-"""Placeholder package init; populated by subsequent milestones."""
+"""User-facing facades: batched merge backend and (scalar) document API."""
+
+from .batch import DocBatch, MergeReport, Workload, oracle_merge
+
+__all__ = ["DocBatch", "MergeReport", "Workload", "oracle_merge"]

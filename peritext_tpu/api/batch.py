@@ -1,0 +1,132 @@
+"""DocBatch: the batched TPU merge backend.
+
+The user-facing entry for the framework's north-star workload: given change
+logs for D collaborative documents (each a dict actor -> [Change], exactly
+what the replication layer accumulates), converge all of them at once on
+device and return each document's formatted spans.
+
+Pipeline: host causal sort + interning (ops/encode.py) -> device batched
+apply (ops/kernel.py) -> device span resolution (ops/resolve.py) -> host
+decode (ops/decode.py).  Documents the device path cannot express (non-text
+objects) or that overflow their static capacities fall back to the scalar
+oracle (core/doc.py) transparently; ``MergeReport.fallback_docs`` says which.
+
+Semantically equivalent to constructing a fresh ``core.Doc`` per workload and
+replaying all changes — the differential tests assert exactly that equality.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.doc import Doc
+from ..core.types import Change, FormatSpan
+from ..ops.decode import decode_doc_spans
+from ..ops.encode import encode_workloads
+from ..ops.kernel import apply_ops, apply_ops_jit
+from ..ops.packed import PackedDocs, empty_docs
+from ..ops.resolve import resolve, resolve_jit
+from ..parallel.causal import causal_sort
+
+Workload = Dict[str, List[Change]]
+
+
+@dataclass
+class MergeReport:
+    """Outcome of a batched merge."""
+
+    spans: List[List[FormatSpan]]
+    #: doc indices resolved by the scalar oracle instead of the device
+    fallback_docs: List[int] = field(default_factory=list)
+    #: ops applied on device (excludes fallback docs)
+    device_ops: int = 0
+
+
+class DocBatch:
+    """Batched document merge engine.
+
+    Capacities are static (XLA compiles one program per shape bucket):
+    ``slot_capacity`` bounds elements-including-tombstones per doc,
+    ``mark_capacity`` bounds mark ops per doc, ``comment_capacity`` bounds
+    distinct interned attrs per doc.
+    """
+
+    def __init__(
+        self,
+        slot_capacity: int = 256,
+        mark_capacity: int = 64,
+        comment_capacity: int = 32,
+        op_capacity: Optional[int] = None,
+        jit: bool = True,
+        mesh=None,
+    ) -> None:
+        self.slot_capacity = slot_capacity
+        self.mark_capacity = mark_capacity
+        self.comment_capacity = comment_capacity
+        self.op_capacity = op_capacity
+        #: optional jax.sharding.Mesh; when set, the doc axis of every tensor
+        #: is sharded across it (pure data parallelism; XLA adds collectives
+        #: only for cross-doc reductions like the convergence digest).
+        self.mesh = mesh
+        # Reuse the module-level jitted wrappers: JAX's compilation cache is
+        # keyed per-wrapper, so per-instance jax.jit would recompile the same
+        # kernel for every DocBatch.
+        self._apply = apply_ops_jit if jit else apply_ops
+        self._resolve = resolve_jit if jit else resolve
+
+    # -- device pipeline ---------------------------------------------------
+
+    def apply_encoded(self, ops: np.ndarray) -> PackedDocs:
+        """Run the batched apply kernel on encoded op tensors (D, K, F)."""
+        if self.mesh is not None:
+            from ..parallel.mesh import pad_doc_axis, shard_docs
+
+            ops = pad_doc_axis(np.asarray(ops), self.mesh.size)
+            ops = shard_docs(ops, self.mesh)
+        state = empty_docs(ops.shape[0], self.slot_capacity, self.mark_capacity)
+        if self.mesh is not None:
+            from ..parallel.mesh import shard_docs
+
+            state = shard_docs(state, self.mesh)
+        return self._apply(state, ops)
+
+    def merge(self, workloads: Sequence[Workload]) -> MergeReport:
+        """Converge every workload; returns per-doc formatted spans."""
+        encoded = encode_workloads(
+            list(workloads), op_capacity=self.op_capacity, overflow_to_fallback=True
+        )
+        state = self.apply_encoded(encoded.ops)
+        resolved = self._resolve(state, self.comment_capacity)
+
+        overflow = np.asarray(resolved.overflow)
+        fallback = set(encoded.fallback_docs) | {
+            int(d) for d in np.nonzero(overflow)[0] if d < len(workloads)
+        }
+
+        spans: List[List[FormatSpan]] = []
+        device_ops = 0
+        for d, workload in enumerate(workloads):
+            if d in fallback:
+                spans.append(_oracle_spans(workload))
+            else:
+                spans.append(decode_doc_spans(resolved, d, encoded.attr_tables[d]))
+                device_ops += int(encoded.num_ops[d])
+        return MergeReport(
+            spans=spans, fallback_docs=sorted(fallback), device_ops=device_ops
+        )
+
+
+def _oracle_spans(workload: Workload) -> List[FormatSpan]:
+    doc = Doc("batch-fallback")
+    for change in causal_sort([ch for log in workload.values() for ch in log]):
+        doc.apply_change(change)
+    return doc.get_text_with_formatting(["text"])
+
+
+def oracle_merge(workloads: Sequence[Workload]) -> List[List[FormatSpan]]:
+    """Scalar reference path for the same inputs (differential-test anchor)."""
+    return [_oracle_spans(w) for w in workloads]

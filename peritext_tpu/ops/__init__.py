@@ -1,1 +1,22 @@
-"""Placeholder package init; populated by subsequent milestones."""
+"""Batched device path: packed state, op encoding, apply kernel, resolution."""
+
+from .decode import decode_doc_spans, decode_doc_text
+from .encode import EncodeResult, encode_workloads
+from .kernel import apply_ops, apply_ops_jit, apply_ops_single
+from .packed import PackedDocs, empty_docs
+from .resolve import ResolvedDocs, resolve, resolve_jit
+
+__all__ = [
+    "PackedDocs",
+    "empty_docs",
+    "EncodeResult",
+    "encode_workloads",
+    "apply_ops",
+    "apply_ops_jit",
+    "apply_ops_single",
+    "ResolvedDocs",
+    "resolve",
+    "resolve_jit",
+    "decode_doc_spans",
+    "decode_doc_text",
+]

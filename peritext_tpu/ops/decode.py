@@ -1,0 +1,59 @@
+"""Host-side decoding: resolved device state -> format spans / plain text.
+
+The inverse boundary of ops/encode.py: un-interns attrs, converts codepoints
+back to characters, and flattens per-character mark state into the same
+merged span lists the scalar oracle's ``get_text_with_formatting`` returns,
+so the two paths are directly comparable (byte-equality oracle).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..core.spans import add_characters_to_spans
+from ..core.types import FormatSpan
+from ..schema import MARK_INDEX
+from ..utils.interning import Interner
+from .resolve import ResolvedDocs
+
+_STRONG = MARK_INDEX["strong"]
+_EM = MARK_INDEX["em"]
+_LINK = MARK_INDEX["link"]
+
+
+def decode_doc_spans(
+    resolved: ResolvedDocs, doc_index: int, attr_table: Interner
+) -> List[FormatSpan]:
+    """Decode one document of a (numpy-converted) ResolvedDocs batch."""
+    d = doc_index
+    visible = np.asarray(resolved.visible[d])
+    chars = np.asarray(resolved.char[d])
+    lww = np.asarray(resolved.lww_active[d])
+    link_attr = np.asarray(resolved.link_attr[d])
+    comments = np.asarray(resolved.comment_active[d])
+
+    spans: List[FormatSpan] = []
+    for slot in np.nonzero(visible)[0]:
+        marks = {}
+        if lww[_STRONG, slot]:
+            marks["strong"] = {"active": True}
+        if lww[_EM, slot]:
+            marks["em"] = {"active": True}
+        if lww[_LINK, slot]:
+            url = attr_table.lookup(int(link_attr[slot]))
+            marks["link"] = {"active": True, "url": url}
+        active_ids = sorted(
+            attr_table.lookup(int(c)) for c in np.nonzero(comments[:, slot])[0]
+        )
+        if active_ids:
+            marks["comment"] = [{"id": cid} for cid in active_ids]
+        add_characters_to_spans([chr(int(chars[slot]))], marks, spans)
+    return spans
+
+
+def decode_doc_text(resolved: ResolvedDocs, doc_index: int) -> str:
+    visible = np.asarray(resolved.visible[doc_index])
+    chars = np.asarray(resolved.char[doc_index])
+    return "".join(chr(int(c)) for c in chars[visible])

@@ -1,0 +1,179 @@
+"""Host-side encoding: change logs -> padded (doc x op) int32 tensors.
+
+The hot device kernel (ops/kernel.py) consumes a causally pre-ordered, padded
+op stream per document.  This module owns the irregular, string-y work that is
+wrong for the TPU: causal sorting (parallel/causal.py), actor/attr interning
+(utils/interning.py), boundary-anchor flattening, and padding/bucketing.
+
+Encoded op record layout (one int32 row per internal op; F_* field indices):
+every op kind uses a subset of the fields, zeros elsewhere.  Ops address the
+document's single text list; workloads that touch other objects (nested maps)
+are routed to the scalar oracle instead (``EncodeResult.fallback_docs``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.opids import HEAD
+from ..core.types import BEFORE, AFTER, END_OF_TEXT, START_OF_TEXT, Boundary, Change
+from ..parallel.causal import causal_sort
+from ..schema import MARK_INDEX
+from ..utils.interning import Interner, OrderedActorTable
+from .packed import BK_AFTER, BK_BEFORE, BK_END_OF_TEXT, BK_START_OF_TEXT
+
+# Field indices of an encoded op row.
+F_KIND = 0
+F_OP_CTR = 1
+F_OP_ACTOR = 2
+F_REF_CTR = 3  # insert: predecessor elem (0,0 = HEAD); delete: target elem
+F_REF_ACTOR = 4
+F_START_KIND = 5
+F_START_CTR = 6
+F_START_ACTOR = 7
+F_END_KIND = 8
+F_END_CTR = 9
+F_END_ACTOR = 10
+F_MARK_TYPE = 11
+F_ATTR = 12
+F_CHAR = 13
+NUM_FIELDS = 14
+
+# Op kinds.
+K_PAD = 0
+K_INSERT = 1
+K_DELETE = 2
+K_ADD_MARK = 3
+K_REMOVE_MARK = 4
+
+_BK = {BEFORE: BK_BEFORE, AFTER: BK_AFTER, START_OF_TEXT: BK_START_OF_TEXT, END_OF_TEXT: BK_END_OF_TEXT}
+
+
+@dataclass
+class EncodeResult:
+    """Padded batch of op streams plus the intern tables to decode outputs."""
+
+    ops: np.ndarray  # int32 (D, K, NUM_FIELDS)
+    num_ops: np.ndarray  # int32 (D,)
+    actor_tables: List[OrderedActorTable]
+    attr_tables: List[Interner]
+    #: doc indices whose logs the device path cannot express (non-text objects)
+    fallback_docs: List[int] = field(default_factory=list)
+
+
+def _boundary(b: Boundary, actors: OrderedActorTable) -> Tuple[int, int, int]:
+    kind = _BK[b.kind]
+    if b.elem is not None:
+        return kind, b.elem[0], actors.intern(b.elem[1])
+    return kind, 0, 0
+
+
+def encode_doc_ops(
+    changes: Sequence[Change],
+    actors: OrderedActorTable,
+    attrs: Interner,
+) -> Tuple[Optional[np.ndarray], bool]:
+    """Encode one document's causally-sorted changes into an (n, F) array.
+    Returns (rows, ok); ok=False means this log needs the host fallback."""
+    rows: List[List[int]] = []
+    text_obj = None  # op ID of the makeList that created the text list
+
+    for change in changes:
+        for op in change.ops:
+            if op.action == "makeList" and text_obj is None:
+                text_obj = op.opid
+                continue
+            if op.obj != text_obj:
+                return None, False  # non-text object: host fallback
+            row = [0] * NUM_FIELDS
+            row[F_OP_CTR] = op.opid[0]
+            row[F_OP_ACTOR] = actors.intern(op.opid[1])
+            if op.action == "set" and op.insert:
+                row[F_KIND] = K_INSERT
+                if op.elem_id is not HEAD:
+                    row[F_REF_CTR] = op.elem_id[0]
+                    row[F_REF_ACTOR] = actors.intern(op.elem_id[1])
+                row[F_CHAR] = ord(op.value)
+            elif op.action == "del":
+                row[F_KIND] = K_DELETE
+                row[F_REF_CTR] = op.elem_id[0]
+                row[F_REF_ACTOR] = actors.intern(op.elem_id[1])
+            elif op.action in ("addMark", "removeMark"):
+                row[F_KIND] = K_ADD_MARK if op.action == "addMark" else K_REMOVE_MARK
+                row[F_START_KIND], row[F_START_CTR], row[F_START_ACTOR] = _boundary(
+                    op.start, actors
+                )
+                row[F_END_KIND], row[F_END_CTR], row[F_END_ACTOR] = _boundary(
+                    op.end, actors
+                )
+                row[F_MARK_TYPE] = MARK_INDEX[op.mark_type]
+                if op.attrs:
+                    attr_value = op.attrs.get("url") or op.attrs.get("id")
+                    if attr_value is not None:
+                        row[F_ATTR] = attrs.intern(attr_value)
+            else:
+                return None, False  # makeMap / map set / del: host fallback
+            rows.append(row)
+
+    return np.asarray(rows, np.int32).reshape(-1, NUM_FIELDS), True
+
+
+def encode_workloads(
+    workloads: Sequence[Dict[str, List[Change]]],
+    op_capacity: Optional[int] = None,
+    overflow_to_fallback: bool = False,
+) -> EncodeResult:
+    """Encode a batch of per-doc change-log sets into padded device tensors.
+
+    Each workload is a dict actor -> [Change] (one collaborative document).
+    Logs are causally linearized per doc; the resulting op streams are padded
+    to a common K (``op_capacity`` or the max stream length, rounded up to a
+    multiple of 8 for layout friendliness).
+    """
+    per_doc_rows: List[Optional[np.ndarray]] = []
+    actor_tables: List[OrderedActorTable] = []
+    attr_tables: List[Interner] = []
+    fallback: List[int] = []
+
+    for doc_index, queues in enumerate(workloads):
+        all_changes = [ch for log in queues.values() for ch in log]
+        ordered = causal_sort(all_changes)
+        actors = OrderedActorTable(
+            {ch.actor for ch in all_changes}
+            | {op.opid[1] for ch in all_changes for op in ch.ops}
+        )
+        attrs = Interner()
+        rows, ok = encode_doc_ops(ordered, actors, attrs)
+        if not ok:
+            fallback.append(doc_index)
+            rows = np.zeros((0, NUM_FIELDS), np.int32)
+        per_doc_rows.append(rows)
+        actor_tables.append(actors)
+        attr_tables.append(attrs)
+
+    max_ops = max((r.shape[0] for r in per_doc_rows), default=0)
+    if op_capacity is None:
+        op_capacity = max(8, -(-max_ops // 8) * 8)
+    if max_ops > op_capacity and not overflow_to_fallback:
+        raise ValueError(f"op stream length {max_ops} exceeds capacity {op_capacity}")
+
+    batch = np.zeros((len(per_doc_rows), op_capacity, NUM_FIELDS), np.int32)
+    num_ops = np.zeros(len(per_doc_rows), np.int32)
+    for i, rows in enumerate(per_doc_rows):
+        if rows.shape[0] > op_capacity:
+            # too many ops for this shape bucket: route to the scalar oracle
+            fallback.append(i)
+            continue
+        batch[i, : rows.shape[0]] = rows
+        num_ops[i] = rows.shape[0]
+
+    return EncodeResult(
+        ops=batch,
+        num_ops=num_ops,
+        actor_tables=actor_tables,
+        attr_tables=attr_tables,
+        fallback_docs=fallback,
+    )

@@ -1,0 +1,166 @@
+"""Batched CRDT op-application kernel.
+
+Per document: a ``lax.fori_loop`` over its causally pre-ordered, padded op
+stream; ``vmap`` over the doc axis (which is the sharded axis under a mesh).
+Each op's work is a fixed set of masked vector primitives over the slot axis
+— the reference's O(n) pointer-chasing scans (src/micromerge.ts:1304, :1334)
+become O(S) lane-parallel compare/select/shift ops, which is the shape the
+TPU VPU wants.  No data-dependent Python control flow: op dispatch is
+``lax.switch``, loops are structural.
+
+Semantics mirrored from the reference:
+* insert: RGA insert-after-reference with the convergence skip past elements
+  whose elemId exceeds the inserting op's ID (src/micromerge.ts:1201-1208);
+  realized as "first non-blocked position right of the reference" via a
+  masked argmin, then a masked shift-right of the slot arrays.
+* delete: tombstone, idempotent (src/micromerge.ts:1261-1277); visibility is
+  recomputed on read, so no splice is needed.
+* addMark/removeMark: append to the grow-only mark table (span resolution
+  happens at read time; see ops/resolve.py).
+
+A reference element that cannot be found, or a capacity overflow, sets the
+doc's ``overflow`` flag; the API layer falls back to the scalar oracle for
+flagged docs (core/errors.CapacityExceeded).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .encode import (
+    F_CHAR,
+    F_KIND,
+    F_OP_ACTOR,
+    F_OP_CTR,
+    F_REF_ACTOR,
+    F_REF_CTR,
+    F_START_KIND,
+    F_START_CTR,
+    F_START_ACTOR,
+    F_END_KIND,
+    F_END_CTR,
+    F_END_ACTOR,
+    F_MARK_TYPE,
+    F_ATTR,
+    K_ADD_MARK,
+    K_REMOVE_MARK,
+)
+from .packed import MA_ADD, MA_REMOVE, PackedDocs
+
+
+def _lex_gt(a_ctr, a_actor, b_ctr, b_actor):
+    """(a_ctr, a_actor) > (b_ctr, b_actor) lexicographically."""
+    return (a_ctr > b_ctr) | ((a_ctr == b_ctr) & (a_actor > b_actor))
+
+
+def _apply_pad(state: PackedDocs, row: jnp.ndarray) -> PackedDocs:
+    return state
+
+
+def _apply_insert(state: PackedDocs, row: jnp.ndarray) -> PackedDocs:
+    s_cap = state.elem_ctr.shape[0]
+    pos = jnp.arange(s_cap, dtype=jnp.int32)
+    n = state.num_slots
+
+    ref_ctr, ref_actor = row[F_REF_CTR], row[F_REF_ACTOR]
+    op_ctr, op_actor = row[F_OP_CTR], row[F_OP_ACTOR]
+
+    is_head = (ref_ctr == 0) & (ref_actor == 0)
+    match = (state.elem_ctr == ref_ctr) & (state.elem_actor == ref_actor) & (pos < n)
+    found = is_head | jnp.any(match)
+    p = jnp.where(is_head, jnp.int32(-1), jnp.argmax(match).astype(jnp.int32))
+
+    # RGA convergence skip: land at the first position right of the reference
+    # whose element does NOT have a greater elemId than the inserting op.
+    elem_gt_op = _lex_gt(state.elem_ctr, state.elem_actor, op_ctr, op_actor)
+    candidate = (pos > p) & (pos < n) & ~elem_gt_op
+    q = jnp.min(jnp.where(candidate, pos, n))
+
+    def shifted(arr, new_value):
+        rolled = jnp.roll(arr, 1)
+        return jnp.where(pos < q, arr, jnp.where(pos == q, new_value, rolled))
+
+    ok = found & (n < s_cap)
+
+    def write(old, new):
+        return jnp.where(ok, new, old)
+
+    return state._replace(
+        elem_ctr=write(state.elem_ctr, shifted(state.elem_ctr, op_ctr)),
+        elem_actor=write(state.elem_actor, shifted(state.elem_actor, op_actor)),
+        char=write(state.char, shifted(state.char, row[F_CHAR])),
+        deleted=write(state.deleted, shifted(state.deleted, False)),
+        num_slots=jnp.where(ok, n + 1, n),
+        overflow=state.overflow | ~ok,
+    )
+
+
+def _apply_delete(state: PackedDocs, row: jnp.ndarray) -> PackedDocs:
+    s_cap = state.elem_ctr.shape[0]
+    pos = jnp.arange(s_cap, dtype=jnp.int32)
+    match = (
+        (state.elem_ctr == row[F_REF_CTR])
+        & (state.elem_actor == row[F_REF_ACTOR])
+        & (pos < state.num_slots)
+    )
+    found = jnp.any(match)
+    return state._replace(
+        deleted=state.deleted | match,
+        overflow=state.overflow | ~found,
+    )
+
+
+def _apply_mark(action: int, state: PackedDocs, row: jnp.ndarray) -> PackedDocs:
+    m_cap = state.m_action.shape[0]
+    mpos = jnp.arange(m_cap, dtype=jnp.int32)
+    idx = state.num_marks
+    at = mpos == idx  # matches nothing when idx >= m_cap
+
+    def w(arr, value):
+        return jnp.where(at, value, arr)
+
+    return state._replace(
+        m_action=w(state.m_action, jnp.int32(action)),
+        m_type=w(state.m_type, row[F_MARK_TYPE]),
+        m_start_kind=w(state.m_start_kind, row[F_START_KIND]),
+        m_start_ctr=w(state.m_start_ctr, row[F_START_CTR]),
+        m_start_actor=w(state.m_start_actor, row[F_START_ACTOR]),
+        m_end_kind=w(state.m_end_kind, row[F_END_KIND]),
+        m_end_ctr=w(state.m_end_ctr, row[F_END_CTR]),
+        m_end_actor=w(state.m_end_actor, row[F_END_ACTOR]),
+        m_op_ctr=w(state.m_op_ctr, row[F_OP_CTR]),
+        m_op_actor=w(state.m_op_actor, row[F_OP_ACTOR]),
+        m_attr=w(state.m_attr, row[F_ATTR]),
+        num_marks=jnp.minimum(idx + 1, m_cap),
+        overflow=state.overflow | (idx >= m_cap),
+    )
+
+
+def apply_ops_single(state: PackedDocs, ops: jnp.ndarray) -> PackedDocs:
+    """Apply one document's padded op stream (K, NUM_FIELDS) sequentially."""
+
+    branches = (
+        _apply_pad,
+        _apply_insert,
+        _apply_delete,
+        partial(_apply_mark, MA_ADD),
+        partial(_apply_mark, MA_REMOVE),
+    )
+
+    def body(k, st):
+        row = ops[k]
+        return lax.switch(jnp.clip(row[F_KIND], 0, 4), branches, st, row)
+
+    return lax.fori_loop(0, ops.shape[0], body, state)
+
+
+#: Batched apply: vmap over the doc axis.  jit at the call site (api/batch.py)
+#: so sharding constraints can be attached.
+apply_ops = jax.vmap(apply_ops_single)
+
+
+apply_ops_jit = jax.jit(apply_ops)

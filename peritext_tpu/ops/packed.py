@@ -1,0 +1,109 @@
+"""Packed device-side document state.
+
+The scalar oracle's per-element metadata list (core/doc.py ListItemMeta;
+reference ``ListItemMetadata`` src/micromerge.ts:341-357) becomes a
+struct-of-arrays over a padded ``(D docs x S slots)`` tensor, and the
+reference's per-gap mark-op *sets* become a grow-only ``(D x M)`` mark-op
+table.  The gap sets are an incremental cache; the convergent semantics is a
+pure function of (element order, mark table) — an op covers a character iff
+its boundary anchors straddle that character's gap in the final element order
+— so the device path stores only the table and resolves spans at read time
+(see ops/resolve.py).  That formulation is order-independent, which is what
+makes it batchable *and* removes the reference's materialized-gap divergence
+bugs (its traces/ record them).
+
+All identifiers are interned to int32 host-side (see ops/encode.py):
+op IDs become (counter, actor_index) pairs compared lexicographically, where
+actor indices are assigned in sorted-actor-string order so device ordering
+matches the reference's string comparison (src/micromerge.ts:1389-1403).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# Boundary-kind encoding (core/types.py Boundary kinds)
+BK_BEFORE = 0
+BK_AFTER = 1
+BK_START_OF_TEXT = 2
+BK_END_OF_TEXT = 3
+
+# Mark action encoding
+MA_ADD = 1
+MA_REMOVE = 2
+
+
+class PackedDocs(NamedTuple):
+    """Batched document state; leading axis D is the (shardable) doc axis.
+
+    Slots [0, num_slots[d]) of doc d hold its elements in document order,
+    tombstones included.  Element IDs are (ctr, actor) int32 pairs; actor 0 is
+    reserved/invalid.
+    """
+
+    # element axis (D, S)
+    elem_ctr: jnp.ndarray  # int32
+    elem_actor: jnp.ndarray  # int32
+    char: jnp.ndarray  # int32 codepoint
+    deleted: jnp.ndarray  # bool
+    # mark-op table (D, M)
+    m_action: jnp.ndarray  # int32: MA_ADD / MA_REMOVE (0 = empty row)
+    m_type: jnp.ndarray  # int32: schema.MARK_INDEX
+    m_start_kind: jnp.ndarray  # int32 BK_*
+    m_start_ctr: jnp.ndarray  # int32
+    m_start_actor: jnp.ndarray  # int32
+    m_end_kind: jnp.ndarray  # int32
+    m_end_ctr: jnp.ndarray  # int32
+    m_end_actor: jnp.ndarray  # int32
+    m_op_ctr: jnp.ndarray  # int32
+    m_op_actor: jnp.ndarray  # int32
+    m_attr: jnp.ndarray  # int32 interned attr (url/comment id); 0 = none
+    # scalars per doc (D,)
+    num_slots: jnp.ndarray  # int32
+    num_marks: jnp.ndarray  # int32
+    overflow: jnp.ndarray  # bool: any capacity exceeded (slot or mark table)
+
+    @property
+    def num_docs(self) -> int:
+        return self.elem_ctr.shape[0]
+
+    @property
+    def slot_capacity(self) -> int:
+        return self.elem_ctr.shape[1]
+
+    @property
+    def mark_capacity(self) -> int:
+        return self.m_action.shape[1]
+
+
+def empty_docs(num_docs: int, slot_capacity: int, mark_capacity: int) -> PackedDocs:
+    """Fresh empty batch (documents are built by applying their change logs)."""
+    d, s, m = num_docs, slot_capacity, mark_capacity
+    zi = lambda *shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
+    return PackedDocs(
+        elem_ctr=zi(d, s),
+        elem_actor=zi(d, s),
+        char=zi(d, s),
+        deleted=jnp.zeros((d, s), bool),
+        m_action=zi(d, m),
+        m_type=zi(d, m),
+        m_start_kind=zi(d, m),
+        m_start_ctr=zi(d, m),
+        m_start_actor=zi(d, m),
+        m_end_kind=zi(d, m),
+        m_end_ctr=zi(d, m),
+        m_end_actor=zi(d, m),
+        m_op_ctr=zi(d, m),
+        m_op_actor=zi(d, m),
+        m_attr=zi(d, m),
+        num_slots=zi(d),
+        num_marks=zi(d),
+        overflow=jnp.zeros((d,), bool),
+    )
+
+
+def to_numpy(state: PackedDocs) -> "PackedDocs":
+    return PackedDocs(*(np.asarray(x) for x in state))

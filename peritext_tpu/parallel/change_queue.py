@@ -20,6 +20,8 @@ class ChangeQueue:
         self,
         handle_flush: Callable[[List[Change]], None],
         interval: float = 0.01,
+        on_error: Optional[Callable[[Exception], None]] = None,
+        max_backoff: float = 1.0,
     ) -> None:
         self._changes: List[Change] = []
         self._handle_flush = handle_flush
@@ -27,6 +29,10 @@ class ChangeQueue:
         self._timer: Optional[threading.Timer] = None
         self._lock = threading.Lock()
         self._running = False
+        #: called with the exception when a timer-driven flush fails
+        self._on_error = on_error
+        self._max_backoff = max_backoff
+        self._current_interval = interval
 
     def enqueue(self, *changes: Change) -> None:
         with self._lock:
@@ -60,13 +66,21 @@ class ChangeQueue:
         with self._lock:
             if not self._running:
                 return
-            self._timer = threading.Timer(self._interval, self._tick)
+            self._timer = threading.Timer(self._current_interval, self._tick)
             self._timer.daemon = True
             self._timer.start()
 
     def _tick(self) -> None:
+        # Timer-driven flushes must not leak exceptions into the timer thread;
+        # failures back off exponentially (changes stay queued) and are
+        # reported through on_error.
         try:
             self.flush()
+            self._current_interval = self._interval
+        except Exception as exc:  # noqa: BLE001 - deliberate boundary
+            self._current_interval = min(self._current_interval * 2, self._max_backoff)
+            if self._on_error is not None:
+                self._on_error(exc)
         finally:
             self._schedule()
 

@@ -1,0 +1,76 @@
+"""Device-mesh sharding of the document axis.
+
+The merge workload is data-parallel over documents: every ``(D, ...)`` tensor
+(op streams, packed state, resolved output) is sharded on its leading axis
+across a 1-D ``jax.sharding.Mesh``; the kernels themselves are unchanged
+(vmap over docs), XLA partitions them and inserts collectives only where the
+program asks for cross-doc values (e.g. the convergence digest's global sum,
+which becomes an all-reduce over ICI).
+
+Per SURVEY.md §5.8 the cross-shard needs of this workload are intentionally
+small: docs are independent; collectives exist for (a) global convergence
+digests, (b) clock-frontier exchange, (c) rebalancing.  This module covers
+(a) directly and provides the sharding plumbing the rest use.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DOC_AXIS = "docs"
+
+
+def make_mesh(num_devices: Optional[int] = None, axis_name: str = DOC_AXIS) -> Mesh:
+    """1-D mesh over the first ``num_devices`` (default: all) devices."""
+    devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def doc_sharding(mesh: Mesh, axis_name: str = DOC_AXIS) -> NamedSharding:
+    """Shard the leading (doc) axis; replicate everything else."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def pad_doc_axis(array: np.ndarray, multiple: int) -> np.ndarray:
+    """Pad the leading axis up to a multiple (sharding needs equal shards).
+    Padded rows are all-zero => kind=PAD ops / empty docs, which the kernels
+    treat as no-ops."""
+    d = array.shape[0]
+    target = -(-d // multiple) * multiple
+    if target == d:
+        return array
+    pad_width = [(0, target - d)] + [(0, 0)] * (array.ndim - 1)
+    return np.pad(array, pad_width)
+
+
+def shard_docs(tree, mesh: Mesh, axis_name: str = DOC_AXIS):
+    """device_put every leaf with its leading axis sharded over the mesh."""
+    sharding = doc_sharding(mesh, axis_name)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def convergence_digest(chars: jnp.ndarray, visible: jnp.ndarray) -> jnp.ndarray:
+    """Order-sensitive scalar digest of all documents' visible text.
+
+    Computed inside the sharded program, so the final sum lowers to an XLA
+    all-reduce across the mesh — the "global convergence check" collective.
+    Two replicas of a batch converged iff their digests match (probabilistic,
+    64-ish bits folded into int32 pairs).
+    """
+    d, s = chars.shape
+    # Per-slot mix of (char, visible, position) with distinct odd multipliers.
+    pos = jnp.arange(s, dtype=jnp.uint32)[None, :]
+    x = chars.astype(jnp.uint32) * jnp.uint32(2654435761)
+    x = x ^ (pos * jnp.uint32(40503))
+    x = jnp.where(visible, x, jnp.uint32(0x9E3779B9))
+    x = x * jnp.uint32(2246822519)
+    x = x ^ (x >> 15)
+    per_doc = jnp.sum(x, axis=1, dtype=jnp.uint32)
+    return jnp.sum(per_doc, dtype=jnp.uint32)  # cross-shard all-reduce

@@ -1,1 +1,5 @@
-"""Placeholder package init; populated by subsequent milestones."""
+"""Cross-cutting utilities: interning, tracing, metrics, checkpointing."""
+
+from .interning import Interner, OrderedActorTable
+
+__all__ = ["Interner", "OrderedActorTable"]

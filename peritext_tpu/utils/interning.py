@@ -1,0 +1,69 @@
+"""String interning for the device boundary.
+
+Actor IDs and mark attributes (urls, comment ids) are strings at the API
+boundary but int32 on device.  Actor indices must preserve the reference's
+*string* ordering (op IDs tie-break on lexicographic actor comparison,
+reference src/micromerge.ts:1389-1403), so actor tables are built from the
+full sorted actor set of a workload.  Attribute interning needs no ordering,
+only per-id identity — except link URLs, whose winner is picked by op ID, not
+URL order.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+
+class Interner:
+    """Bidirectional string <-> int32 table; index 0 is reserved for 'none'."""
+
+    def __init__(self, strings: Iterable[str] = ()) -> None:
+        self._to_int: Dict[str, int] = {}
+        self._to_str: List[Optional[str]] = [None]
+        for s in strings:
+            self.intern(s)
+
+    def intern(self, s: str) -> int:
+        idx = self._to_int.get(s)
+        if idx is None:
+            idx = len(self._to_str)
+            self._to_int[s] = idx
+            self._to_str.append(s)
+        return idx
+
+    def lookup(self, idx: int) -> Optional[str]:
+        return self._to_str[idx]
+
+    def get(self, s: str) -> Optional[int]:
+        return self._to_int.get(s)
+
+    def __len__(self) -> int:
+        return len(self._to_str)
+
+    def __contains__(self, s: str) -> bool:
+        return s in self._to_int
+
+
+class OrderedActorTable(Interner):
+    """Actor interner whose int ordering equals string ordering.
+
+    Built from the complete actor set up front (sorted), so
+    ``idx(a) < idx(b) iff a < b`` — the property the device's int32
+    lexicographic op-ID comparison relies on.  ``intern`` of an unseen actor
+    raises: growing the table could violate the order invariant; rebuild with
+    the enlarged actor set instead (cheap, host-side).
+    """
+
+    def __init__(self, actors: Iterable[str]) -> None:
+        super().__init__()
+        for actor in sorted(set(actors)):
+            Interner.intern(self, actor)
+
+    def intern(self, s: str) -> int:
+        idx = self.get(s)
+        if idx is None:
+            raise KeyError(
+                f"Actor {s!r} not in the ordered actor table; rebuild the table "
+                "with the full actor set (ordering must match string order)"
+            )
+        return idx

@@ -2,12 +2,18 @@
 
 Tests run on CPU with 8 virtual XLA host devices so the multi-chip sharding
 paths (jax.sharding.Mesh over the doc axis) are exercised without TPU
-hardware.  Must run before the first jax import anywhere in the test session.
+hardware.  The environment preselects the TPU platform (JAX_PLATFORMS=axon,
+and the plugin re-asserts itself at config level), so we must both set the
+env vars *and* update jax.config before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
