@@ -48,20 +48,32 @@ def measure_scalar_baseline(num_ops: int = 4000, seed: int = 7) -> float:
 
 def run(args) -> dict:
     import jax
-    from peritext_tpu.ops.kernel import apply_ops_jit
+
+    if args.platform:
+        # The axon plugin pins jax_platforms at config level, so a plain
+        # JAX_PLATFORMS env var is not enough to redirect the bench.
+        jax.config.update("jax_platforms", args.platform)
+    from peritext_tpu.ops.kernel import apply_batch_jit
     from peritext_tpu.ops.packed import empty_docs
     from peritext_tpu.ops.resolve import resolve_jit
-    from peritext_tpu.testing.synth import synth_op_streams
+    from peritext_tpu.testing.synth import synth_streams, synth_total_ops
 
     d, k, s, m = args.docs, args.ops_per_doc, args.slots, args.marks
+    # op mix matching the fuzz distribution: ~70% inserts, 15% deletes, 15% marks
+    ki = int(k * 0.7)
+    kd = int(k * 0.15)
+    km = k - ki - kd
 
     gen_start = time.perf_counter()
-    ops = synth_op_streams(d, k, seed=args.seed)
+    streams = synth_streams(
+        d, inserts_per_doc=ki, deletes_per_doc=kd, marks_per_doc=km, seed=args.seed
+    )
+    total_ops = synth_total_ops(streams)
     gen_time = time.perf_counter() - gen_start
 
-    apply_jit = apply_ops_jit
-    state0 = empty_docs(d, s, m)
-    ops_dev = jax.device_put(ops)
+    apply_jit = apply_batch_jit
+    state0 = empty_docs(d, s, max(m, km), tomb_capacity=max(kd, 8))
+    ops_dev = jax.device_put(streams)
 
     # NOTE: jax.block_until_ready does not actually block on the axon TPU
     # platform; force a small host transfer to synchronize honestly.
@@ -82,7 +94,6 @@ def run(args) -> dict:
     best = min(times)
 
     overflow = int(np.asarray(result.overflow).sum())
-    total_ops = d * k
     device_ops_per_sec = total_ops / best
 
     # resolution (read path) timing, reported as extra context
@@ -124,6 +135,9 @@ def main() -> None:
     parser.add_argument("--marks", type=int, default=None)
     parser.add_argument("--iters", type=int, default=3)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--platform", default=None, help="force a jax platform (e.g. cpu)"
+    )
     args = parser.parse_args()
 
     defaults = (64, 128, 192, 64) if args.smoke else (8192, 256, 384, 96)

@@ -5,11 +5,12 @@ logs for D collaborative documents (each a dict actor -> [Change], exactly
 what the replication layer accumulates), converge all of them at once on
 device and return each document's formatted spans.
 
-Pipeline: host causal sort + interning (ops/encode.py) -> device batched
-apply (ops/kernel.py) -> device span resolution (ops/resolve.py) -> host
-decode (ops/decode.py).  Documents the device path cannot express (non-text
-objects) or that overflow their static capacities fall back to the scalar
-oracle (core/doc.py) transparently; ``MergeReport.fallback_docs`` says which.
+Pipeline: host causal sort + interning + stream splitting (ops/encode.py) ->
+device batched apply (ops/kernel.py) -> device span resolution
+(ops/resolve.py) -> host decode (ops/decode.py).  Documents the device path
+cannot express (non-text objects, too many actors) or that overflow their
+static capacities fall back to the scalar oracle (core/doc.py) transparently;
+``MergeReport.fallback_docs`` says which.
 
 Semantically equivalent to constructing a fresh ``core.Doc`` per workload and
 replaying all changes — the differential tests assert exactly that equality.
@@ -20,14 +21,13 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
-import jax
 import numpy as np
 
 from ..core.doc import Doc
 from ..core.types import Change, FormatSpan
 from ..ops.decode import decode_doc_spans
-from ..ops.encode import encode_workloads
-from ..ops.kernel import apply_ops, apply_ops_jit
+from ..ops.encode import EncodedBatch, encode_workloads
+from ..ops.kernel import apply_batch, apply_batch_jit, encoded_arrays_of
 from ..ops.packed import PackedDocs, empty_docs
 from ..ops.resolve import resolve, resolve_jit
 from ..parallel.causal import causal_sort
@@ -52,7 +52,8 @@ class DocBatch:
     Capacities are static (XLA compiles one program per shape bucket):
     ``slot_capacity`` bounds elements-including-tombstones per doc,
     ``mark_capacity`` bounds mark ops per doc, ``comment_capacity`` bounds
-    distinct interned attrs per doc.
+    distinct interned attrs per doc, ``op_capacity`` bounds the insert and
+    delete streams per merge call (None = sized to the batch).
     """
 
     def __init__(
@@ -75,32 +76,53 @@ class DocBatch:
         # Reuse the module-level jitted wrappers: JAX's compilation cache is
         # keyed per-wrapper, so per-instance jax.jit would recompile the same
         # kernel for every DocBatch.
-        self._apply = apply_ops_jit if jit else apply_ops
+        self._apply = apply_batch_jit if jit else apply_batch
         self._resolve = resolve_jit if jit else resolve
 
     # -- device pipeline ---------------------------------------------------
 
-    def apply_encoded(self, ops: np.ndarray) -> PackedDocs:
-        """Run the batched apply kernel on encoded op tensors (D, K, F)."""
+    def encode(self, workloads: Sequence[Workload]) -> EncodedBatch:
+        return encode_workloads(
+            list(workloads),
+            insert_capacity=self.op_capacity,
+            delete_capacity=self.op_capacity,
+            mark_capacity=self.mark_capacity,
+        )
+
+    def apply_encoded(self, encoded: EncodedBatch) -> PackedDocs:
+        """Run the batched two-phase apply on an encoded batch."""
+        arrays = encoded_arrays_of(encoded)
+        num_docs = encoded.num_docs
         if self.mesh is not None:
             from ..parallel.mesh import pad_doc_axis, shard_docs
+            import jax
 
-            ops = pad_doc_axis(np.asarray(ops), self.mesh.size)
-            ops = shard_docs(ops, self.mesh)
-        state = empty_docs(ops.shape[0], self.slot_capacity, self.mark_capacity)
+            arrays = jax.tree_util.tree_map(
+                lambda x: pad_doc_axis(np.asarray(x), self.mesh.size), arrays
+            )
+            arrays = shard_docs(arrays, self.mesh)
+            num_docs = arrays[0].shape[0]
+        state = empty_docs(
+            num_docs,
+            self.slot_capacity,
+            self.mark_capacity,
+            tomb_capacity=arrays[3].shape[1],  # delete-stream width
+        )
         if self.mesh is not None:
             from ..parallel.mesh import shard_docs
 
             state = shard_docs(state, self.mesh)
-        return self._apply(state, ops)
+        return self._apply(state, arrays)
 
     def merge(self, workloads: Sequence[Workload]) -> MergeReport:
         """Converge every workload; returns per-doc formatted spans."""
-        encoded = encode_workloads(
-            list(workloads), op_capacity=self.op_capacity, overflow_to_fallback=True
-        )
-        state = self.apply_encoded(encoded.ops)
+        encoded = self.encode(workloads)
+        state = self.apply_encoded(encoded)
         resolved = self._resolve(state, self.comment_capacity)
+        # One whole-array transfer per field, up front: decoding per doc on
+        # the raw (possibly mesh-sharded) arrays would do 5 device gathers
+        # per document.
+        resolved = type(resolved)(*(np.asarray(x) for x in resolved))
 
         overflow = np.asarray(resolved.overflow)
         fallback = set(encoded.fallback_docs) | {

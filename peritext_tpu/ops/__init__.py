@@ -1,19 +1,30 @@
 """Batched device path: packed state, op encoding, apply kernel, resolution."""
 
 from .decode import decode_doc_spans, decode_doc_text
-from .encode import EncodeResult, encode_workloads
-from .kernel import apply_ops, apply_ops_jit, apply_ops_single
-from .packed import PackedDocs, empty_docs
+from .encode import EncodedBatch, encode_workloads
+from .kernel import (
+    apply_batch,
+    apply_batch_jit,
+    apply_ops,
+    apply_ops_jit,
+    encoded_arrays_of,
+)
+from .packed import ACTOR_BITS, PackedDocs, empty_docs, pack_id, unpack_id
 from .resolve import ResolvedDocs, resolve, resolve_jit
 
 __all__ = [
     "PackedDocs",
     "empty_docs",
-    "EncodeResult",
+    "pack_id",
+    "unpack_id",
+    "ACTOR_BITS",
+    "EncodedBatch",
     "encode_workloads",
+    "apply_batch",
+    "apply_batch_jit",
     "apply_ops",
     "apply_ops_jit",
-    "apply_ops_single",
+    "encoded_arrays_of",
     "ResolvedDocs",
     "resolve",
     "resolve_jit",

@@ -1,14 +1,24 @@
-"""Host-side encoding: change logs -> padded (doc x op) int32 tensors.
+"""Host-side encoding: change logs -> padded split-stream device tensors.
 
-The hot device kernel (ops/kernel.py) consumes a causally pre-ordered, padded
-op stream per document.  This module owns the irregular, string-y work that is
-wrong for the TPU: causal sorting (parallel/causal.py), actor/attr interning
-(utils/interning.py), boundary-anchor flattening, and padding/bucketing.
+The irregular, string-y work that is wrong for the TPU happens here: causal
+sorting (parallel/causal.py), actor/attr interning (utils/interning.py),
+boundary-anchor flattening, and padding/bucketing.
 
-Encoded op record layout (one int32 row per internal op; F_* field indices):
-every op kind uses a subset of the fields, zeros elsewhere.  Ops address the
-document's single text list; workloads that touch other objects (nested maps)
-are routed to the scalar oracle instead (``EncodeResult.fallback_docs``).
+Ops are split into three streams per document, exploiting the commutation
+structure of the packed representation (ops/packed.py):
+
+* **inserts** — the only truly sequential stream (each insert's position
+  depends on prior inserts); consumed by the per-doc fori_loop.
+* **deletes** — idempotent tombstone sets; they commute with each other and
+  with inserts' *placement* (the RGA skip compares only element ids,
+  reference src/micromerge.ts:1201-1208), so they apply as one vectorized
+  pass after all inserts.
+* **marks** — grow-only table rows; they are encoded host-side directly in
+  mark-table layout and appended with one vectorized scatter.
+
+All identifiers are packed int32s (packed.pack_id).  Documents whose logs the
+device path cannot express (non-text objects, too many actors/ops) are routed
+to the scalar-oracle fallback (``EncodedBatch.fallback_docs``).
 """
 
 from __future__ import annotations
@@ -19,67 +29,91 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core.opids import HEAD
-from ..core.types import BEFORE, AFTER, END_OF_TEXT, START_OF_TEXT, Boundary, Change
+from ..core.types import AFTER, BEFORE, END_OF_TEXT, START_OF_TEXT, Boundary, Change
 from ..parallel.causal import causal_sort
 from ..schema import MARK_INDEX
 from ..utils.interning import Interner, OrderedActorTable
-from .packed import BK_AFTER, BK_BEFORE, BK_END_OF_TEXT, BK_START_OF_TEXT
+from .packed import (
+    BK_AFTER,
+    BK_BEFORE,
+    BK_END_OF_TEXT,
+    BK_START_OF_TEXT,
+    MA_ADD,
+    MA_REMOVE,
+    MAX_ACTORS,
+    MAX_CTR,
+    pack_id,
+)
 
-# Field indices of an encoded op row.
-F_KIND = 0
-F_OP_CTR = 1
-F_OP_ACTOR = 2
-F_REF_CTR = 3  # insert: predecessor elem (0,0 = HEAD); delete: target elem
-F_REF_ACTOR = 4
-F_START_KIND = 5
-F_START_CTR = 6
-F_START_ACTOR = 7
-F_END_KIND = 8
-F_END_CTR = 9
-F_END_ACTOR = 10
-F_MARK_TYPE = 11
-F_ATTR = 12
-F_CHAR = 13
-NUM_FIELDS = 14
+_BK = {
+    BEFORE: BK_BEFORE,
+    AFTER: BK_AFTER,
+    START_OF_TEXT: BK_START_OF_TEXT,
+    END_OF_TEXT: BK_END_OF_TEXT,
+}
 
-# Op kinds.
-K_PAD = 0
-K_INSERT = 1
-K_DELETE = 2
-K_ADD_MARK = 3
-K_REMOVE_MARK = 4
-
-_BK = {BEFORE: BK_BEFORE, AFTER: BK_AFTER, START_OF_TEXT: BK_START_OF_TEXT, END_OF_TEXT: BK_END_OF_TEXT}
+#: Columns of a host-side mark row, in PackedDocs mark-table order.
+MARK_COLS = (
+    "m_action",
+    "m_type",
+    "m_start_kind",
+    "m_start_elem",
+    "m_end_kind",
+    "m_end_elem",
+    "m_op",
+    "m_attr",
+)
 
 
 @dataclass
-class EncodeResult:
-    """Padded batch of op streams plus the intern tables to decode outputs."""
+class EncodedBatch:
+    """Padded split-stream batch plus intern tables for decoding outputs."""
 
-    ops: np.ndarray  # int32 (D, K, NUM_FIELDS)
-    num_ops: np.ndarray  # int32 (D,)
+    # insert stream (D, KI)
+    ins_ref: np.ndarray  # packed predecessor elem (0 = HEAD)
+    ins_op: np.ndarray  # packed op id (0 = pad)
+    ins_char: np.ndarray  # int32 codepoint
+    # delete stream (D, KD); packed target elem (0 = pad)
+    del_target: np.ndarray
+    # mark stream (D, KM) per MARK_COLS
+    marks: Dict[str, np.ndarray]
+    mark_count: np.ndarray  # int32 (D,)
+    num_ops: np.ndarray  # int32 (D,) total encoded ops (stats)
     actor_tables: List[OrderedActorTable]
     attr_tables: List[Interner]
-    #: doc indices whose logs the device path cannot express (non-text objects)
+    #: doc indices the device path cannot express; resolved by the oracle
     fallback_docs: List[int] = field(default_factory=list)
 
+    @property
+    def num_docs(self) -> int:
+        return self.ins_op.shape[0]
 
-def _boundary(b: Boundary, actors: OrderedActorTable) -> Tuple[int, int, int]:
-    kind = _BK[b.kind]
+
+class _DocStreams:
+    def __init__(self) -> None:
+        self.ins: List[Tuple[int, int, int]] = []  # (ref, op, char)
+        self.dels: List[int] = []
+        self.marks: List[Tuple[int, ...]] = []  # MARK_COLS order
+
+
+def _pack_opid(opid, actors: OrderedActorTable) -> int:
+    ctr, actor = opid
+    if ctr > MAX_CTR:
+        raise OverflowError(f"op counter {ctr} exceeds packed capacity")
+    return pack_id(ctr, actors.intern(actor))
+
+
+def _pack_boundary(b: Boundary, actors: OrderedActorTable) -> Tuple[int, int]:
     if b.elem is not None:
-        return kind, b.elem[0], actors.intern(b.elem[1])
-    return kind, 0, 0
+        return _BK[b.kind], _pack_opid(b.elem, actors)
+    return _BK[b.kind], 0
 
 
-def encode_doc_ops(
-    changes: Sequence[Change],
-    actors: OrderedActorTable,
-    attrs: Interner,
-) -> Tuple[Optional[np.ndarray], bool]:
-    """Encode one document's causally-sorted changes into an (n, F) array.
-    Returns (rows, ok); ok=False means this log needs the host fallback."""
-    rows: List[List[int]] = []
-    text_obj = None  # op ID of the makeList that created the text list
+def encode_doc(changes: Sequence[Change], actors: OrderedActorTable, attrs: Interner):
+    """Split one document's causally-sorted changes into three streams.
+    Returns (_DocStreams, ok); ok=False -> host fallback."""
+    streams = _DocStreams()
+    text_obj = None
 
     for change in changes:
         for op in change.ops:
@@ -87,53 +121,51 @@ def encode_doc_ops(
                 text_obj = op.opid
                 continue
             if op.obj != text_obj:
-                return None, False  # non-text object: host fallback
-            row = [0] * NUM_FIELDS
-            row[F_OP_CTR] = op.opid[0]
-            row[F_OP_ACTOR] = actors.intern(op.opid[1])
+                return streams, False
             if op.action == "set" and op.insert:
-                row[F_KIND] = K_INSERT
-                if op.elem_id is not HEAD:
-                    row[F_REF_CTR] = op.elem_id[0]
-                    row[F_REF_ACTOR] = actors.intern(op.elem_id[1])
-                row[F_CHAR] = ord(op.value)
+                ref = 0 if op.elem_id is HEAD else _pack_opid(op.elem_id, actors)
+                streams.ins.append((ref, _pack_opid(op.opid, actors), ord(op.value)))
             elif op.action == "del":
-                row[F_KIND] = K_DELETE
-                row[F_REF_CTR] = op.elem_id[0]
-                row[F_REF_ACTOR] = actors.intern(op.elem_id[1])
+                streams.dels.append(_pack_opid(op.elem_id, actors))
             elif op.action in ("addMark", "removeMark"):
-                row[F_KIND] = K_ADD_MARK if op.action == "addMark" else K_REMOVE_MARK
-                row[F_START_KIND], row[F_START_CTR], row[F_START_ACTOR] = _boundary(
-                    op.start, actors
-                )
-                row[F_END_KIND], row[F_END_CTR], row[F_END_ACTOR] = _boundary(
-                    op.end, actors
-                )
-                row[F_MARK_TYPE] = MARK_INDEX[op.mark_type]
+                sk, se = _pack_boundary(op.start, actors)
+                ek, ee = _pack_boundary(op.end, actors)
+                attr = 0
                 if op.attrs:
-                    attr_value = op.attrs.get("url") or op.attrs.get("id")
-                    if attr_value is not None:
-                        row[F_ATTR] = attrs.intern(attr_value)
+                    # key-presence, not truthiness: an empty url/id is a value
+                    if "url" in op.attrs:
+                        attr = attrs.intern(op.attrs["url"])
+                    elif "id" in op.attrs:
+                        attr = attrs.intern(op.attrs["id"])
+                streams.marks.append(
+                    (
+                        MA_ADD if op.action == "addMark" else MA_REMOVE,
+                        MARK_INDEX[op.mark_type],
+                        sk,
+                        se,
+                        ek,
+                        ee,
+                        _pack_opid(op.opid, actors),
+                        attr,
+                    )
+                )
             else:
-                return None, False  # makeMap / map set / del: host fallback
-            rows.append(row)
+                return streams, False  # makeMap / map ops: host fallback
+    return streams, True
 
-    return np.asarray(rows, np.int32).reshape(-1, NUM_FIELDS), True
+
+def _round8(n: int) -> int:
+    return max(8, -(-n // 8) * 8)
 
 
 def encode_workloads(
     workloads: Sequence[Dict[str, List[Change]]],
-    op_capacity: Optional[int] = None,
-    overflow_to_fallback: bool = False,
-) -> EncodeResult:
-    """Encode a batch of per-doc change-log sets into padded device tensors.
-
-    Each workload is a dict actor -> [Change] (one collaborative document).
-    Logs are causally linearized per doc; the resulting op streams are padded
-    to a common K (``op_capacity`` or the max stream length, rounded up to a
-    multiple of 8 for layout friendliness).
-    """
-    per_doc_rows: List[Optional[np.ndarray]] = []
+    insert_capacity: Optional[int] = None,
+    delete_capacity: Optional[int] = None,
+    mark_capacity: Optional[int] = None,
+) -> EncodedBatch:
+    """Encode a batch of per-doc change-log sets (dict actor -> [Change])."""
+    per_doc: List[Optional[_DocStreams]] = []
     actor_tables: List[OrderedActorTable] = []
     attr_tables: List[Interner] = []
     fallback: List[int] = []
@@ -141,39 +173,67 @@ def encode_workloads(
     for doc_index, queues in enumerate(workloads):
         all_changes = [ch for log in queues.values() for ch in log]
         ordered = causal_sort(all_changes)
-        actors = OrderedActorTable(
-            {ch.actor for ch in all_changes}
-            | {op.opid[1] for ch in all_changes for op in ch.ops}
-        )
+        actor_set = {ch.actor for ch in all_changes} | {
+            op.opid[1] for ch in all_changes for op in ch.ops
+        }
+        actors = OrderedActorTable(actor_set)
         attrs = Interner()
-        rows, ok = encode_doc_ops(ordered, actors, attrs)
+        ok = len(actors) <= MAX_ACTORS
+        streams = _DocStreams()
+        if ok:
+            try:
+                streams, ok = encode_doc(ordered, actors, attrs)
+            except OverflowError:
+                ok = False
         if not ok:
             fallback.append(doc_index)
-            rows = np.zeros((0, NUM_FIELDS), np.int32)
-        per_doc_rows.append(rows)
+            streams = _DocStreams()
+        per_doc.append(streams)
         actor_tables.append(actors)
         attr_tables.append(attrs)
 
-    max_ops = max((r.shape[0] for r in per_doc_rows), default=0)
-    if op_capacity is None:
-        op_capacity = max(8, -(-max_ops // 8) * 8)
-    if max_ops > op_capacity and not overflow_to_fallback:
-        raise ValueError(f"op stream length {max_ops} exceeds capacity {op_capacity}")
+    d = len(per_doc)
+    ki = insert_capacity or _round8(max((len(s.ins) for s in per_doc), default=0))
+    kd = delete_capacity or _round8(max((len(s.dels) for s in per_doc), default=0))
+    km = mark_capacity or _round8(max((len(s.marks) for s in per_doc), default=0))
 
-    batch = np.zeros((len(per_doc_rows), op_capacity, NUM_FIELDS), np.int32)
-    num_ops = np.zeros(len(per_doc_rows), np.int32)
-    for i, rows in enumerate(per_doc_rows):
-        if rows.shape[0] > op_capacity:
-            # too many ops for this shape bucket: route to the scalar oracle
-            fallback.append(i)
+    ins_ref = np.zeros((d, ki), np.int32)
+    ins_op = np.zeros((d, ki), np.int32)
+    ins_char = np.zeros((d, ki), np.int32)
+    del_target = np.zeros((d, kd), np.int32)
+    marks = {col: np.zeros((d, km), np.int32) for col in MARK_COLS}
+    mark_count = np.zeros(d, np.int32)
+    num_ops = np.zeros(d, np.int32)
+
+    for i, streams in enumerate(per_doc):
+        if i in fallback:
             continue
-        batch[i, : rows.shape[0]] = rows
-        num_ops[i] = rows.shape[0]
+        if len(streams.ins) > ki or len(streams.dels) > kd or len(streams.marks) > km:
+            fallback.append(i)  # over this shape bucket: oracle fallback
+            continue
+        if streams.ins:
+            arr = np.asarray(streams.ins, np.int32)
+            ins_ref[i, : len(arr)] = arr[:, 0]
+            ins_op[i, : len(arr)] = arr[:, 1]
+            ins_char[i, : len(arr)] = arr[:, 2]
+        if streams.dels:
+            del_target[i, : len(streams.dels)] = streams.dels
+        if streams.marks:
+            arr = np.asarray(streams.marks, np.int32)
+            for c, col in enumerate(MARK_COLS):
+                marks[col][i, : len(arr)] = arr[:, c]
+            mark_count[i] = len(arr)
+        num_ops[i] = len(streams.ins) + len(streams.dels) + len(streams.marks)
 
-    return EncodeResult(
-        ops=batch,
+    return EncodedBatch(
+        ins_ref=ins_ref,
+        ins_op=ins_op,
+        ins_char=ins_char,
+        del_target=del_target,
+        marks=marks,
+        mark_count=mark_count,
         num_ops=num_ops,
         actor_tables=actor_tables,
         attr_tables=attr_tables,
-        fallback_docs=fallback,
+        fallback_docs=sorted(fallback),
     )

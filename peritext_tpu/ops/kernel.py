@@ -1,166 +1,163 @@
-"""Batched CRDT op-application kernel.
+"""Batched CRDT op-application kernel (two-phase, split-stream).
 
-Per document: a ``lax.fori_loop`` over its causally pre-ordered, padded op
-stream; ``vmap`` over the doc axis (which is the sharded axis under a mesh).
-Each op's work is a fixed set of masked vector primitives over the slot axis
-— the reference's O(n) pointer-chasing scans (src/micromerge.ts:1304, :1334)
-become O(S) lane-parallel compare/select/shift ops, which is the shape the
-TPU VPU wants.  No data-dependent Python control flow: op dispatch is
-``lax.switch``, loops are structural.
+Phase structure per document (vmap over the doc axis, which is the sharded
+axis under a mesh):
 
-Semantics mirrored from the reference:
-* insert: RGA insert-after-reference with the convergence skip past elements
-  whose elemId exceeds the inserting op's ID (src/micromerge.ts:1201-1208);
-  realized as "first non-blocked position right of the reference" via a
-  masked argmin, then a masked shift-right of the slot arrays.
-* delete: tombstone, idempotent (src/micromerge.ts:1261-1277); visibility is
-  recomputed on read, so no splice is needed.
-* addMark/removeMark: append to the grow-only mark table (span resolution
-  happens at read time; see ops/resolve.py).
+1. **Inserts** — the only sequential phase: a ``lax.fori_loop`` whose carry
+   is exactly two (S,) arrays (packed element ids + characters) plus two
+   scalars.  Each step realizes the reference's RGA insert-after-reference
+   with its convergence skip (src/micromerge.ts:1187-1245): the O(n)
+   pointer-chasing scans become O(S) lane-parallel compare/select, and the
+   list splice becomes a masked shift.  Keeping the carry to 2 arrays is the
+   point — the loop is HBM-bandwidth bound.
+2. **Deletes** — tombstones are idempotent flag-sets that commute with each
+   other and do not affect insert placement (the RGA skip compares only
+   element ids), so the whole delete stream applies as ONE vectorized
+   any-match over (S x KD) (reference applyListUpdate, :1250-1277; the
+   visible-array splice is unnecessary — visibility is recomputed on read).
+3. **Marks** — already encoded in mark-table layout host-side; appended with
+   one masked scatter (span semantics live in ops/resolve.py).
 
 A reference element that cannot be found, or a capacity overflow, sets the
 doc's ``overflow`` flag; the API layer falls back to the scalar oracle for
-flagged docs (core/errors.CapacityExceeded).
+flagged docs.
 """
 
 from __future__ import annotations
 
-from functools import partial
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .encode import (
-    F_CHAR,
-    F_KIND,
-    F_OP_ACTOR,
-    F_OP_CTR,
-    F_REF_ACTOR,
-    F_REF_CTR,
-    F_START_KIND,
-    F_START_CTR,
-    F_START_ACTOR,
-    F_END_KIND,
-    F_END_CTR,
-    F_END_ACTOR,
-    F_MARK_TYPE,
-    F_ATTR,
-    K_ADD_MARK,
-    K_REMOVE_MARK,
-)
-from .packed import MA_ADD, MA_REMOVE, PackedDocs
+from .encode import EncodedBatch, MARK_COLS
+from .packed import PackedDocs
 
 
-def _lex_gt(a_ctr, a_actor, b_ctr, b_actor):
-    """(a_ctr, a_actor) > (b_ctr, b_actor) lexicographically."""
-    return (a_ctr > b_ctr) | ((a_ctr == b_ctr) & (a_actor > b_actor))
-
-
-def _apply_pad(state: PackedDocs, row: jnp.ndarray) -> PackedDocs:
-    return state
-
-
-def _apply_insert(state: PackedDocs, row: jnp.ndarray) -> PackedDocs:
-    s_cap = state.elem_ctr.shape[0]
+def _insert_loop(elem_id, char, n0, overflow0, ins_ref, ins_op, ins_char):
+    """Sequential RGA insert phase for one document."""
+    s_cap = elem_id.shape[0]
     pos = jnp.arange(s_cap, dtype=jnp.int32)
-    n = state.num_slots
 
-    ref_ctr, ref_actor = row[F_REF_CTR], row[F_REF_ACTOR]
-    op_ctr, op_actor = row[F_OP_CTR], row[F_OP_ACTOR]
+    def body(k, carry):
+        elem, chars, n, ov = carry
+        ref, op = ins_ref[k], ins_op[k]
+        live = op != 0
+        is_head = ref == 0
+        match = (elem == ref) & (pos < n)
+        found = is_head | jnp.any(match)
+        p = jnp.where(is_head, jnp.int32(-1), jnp.argmax(match).astype(jnp.int32))
 
-    is_head = (ref_ctr == 0) & (ref_actor == 0)
-    match = (state.elem_ctr == ref_ctr) & (state.elem_actor == ref_actor) & (pos < n)
-    found = is_head | jnp.any(match)
-    p = jnp.where(is_head, jnp.int32(-1), jnp.argmax(match).astype(jnp.int32))
+        # Convergence skip: first position right of the reference whose
+        # element id is NOT greater than the inserting op's id.  Packed ids
+        # make this a single integer compare.
+        candidate = (pos > p) & (pos < n) & (elem < op)
+        q = jnp.min(jnp.where(candidate, pos, n))
 
-    # RGA convergence skip: land at the first position right of the reference
-    # whose element does NOT have a greater elemId than the inserting op.
-    elem_gt_op = _lex_gt(state.elem_ctr, state.elem_actor, op_ctr, op_actor)
-    candidate = (pos > p) & (pos < n) & ~elem_gt_op
-    q = jnp.min(jnp.where(candidate, pos, n))
+        ok = live & found & (n < s_cap)
+        rolled_elem = jnp.roll(elem, 1)
+        rolled_char = jnp.roll(chars, 1)
+        new_elem = jnp.where(pos < q, elem, jnp.where(pos == q, op, rolled_elem))
+        new_char = jnp.where(pos < q, chars, jnp.where(pos == q, ins_char[k], rolled_char))
+        return (
+            jnp.where(ok, new_elem, elem),
+            jnp.where(ok, new_char, chars),
+            jnp.where(ok, n + 1, n),
+            ov | (live & ~found) | (live & (n >= s_cap)),
+        )
 
-    def shifted(arr, new_value):
-        rolled = jnp.roll(arr, 1)
-        return jnp.where(pos < q, arr, jnp.where(pos == q, new_value, rolled))
+    return lax.fori_loop(0, ins_op.shape[0], body, (elem_id, char, n0, overflow0))
 
-    ok = found & (n < s_cap)
 
-    def write(old, new):
-        return jnp.where(ok, new, old)
+def _append_rows(table, count, rows, rows_count):
+    """Masked scatter appending ``rows`` (dict or single array) into append-only
+    ``table`` at [count, count + rows_count); out-of-range writes drop."""
+    single = not isinstance(table, dict)
+    tables = {"_": table} if single else table
+    new_rows = {"_": rows} if single else rows
+    cap = next(iter(tables.values())).shape[0]
+    km = next(iter(new_rows.values())).shape[0]
+    src = jnp.arange(km, dtype=jnp.int32)
+    dst = count + src
+    valid = src < rows_count
+    dst = jnp.where(valid, dst, cap)
+    out = {
+        col: tables[col].at[dst].set(new_rows[col], mode="drop") for col in tables
+    }
+    overflow = count + rows_count > cap
+    new_count = jnp.minimum(count + rows_count, cap)
+    if single:
+        return out["_"], new_count, overflow
+    return out, new_count, overflow
 
+
+def _apply_doc(state: PackedDocs, ins_ref, ins_op, ins_char, del_target, mark_rows, mark_count):
+    elem, char, n, ov = _insert_loop(
+        state.elem_id, state.char, state.num_slots, state.overflow,
+        ins_ref, ins_op, ins_char,
+    )
+
+    # Deletes: validate targets exist, then append to the tombstone table
+    # (dedup against rows already there keeps re-delivery idempotent).
+    live = del_target != 0
+    exists = jnp.any(elem[:, None] == del_target[None, :], axis=0)  # (KD,)
+    already = jnp.any(
+        state.tomb_id[:, None] == del_target[None, :], axis=0
+    ) & live
+    del_err = jnp.any(live & ~exists)
+    keep = live & exists & ~already
+    # compact kept targets to a dense prefix so the append is contiguous
+    order = jnp.argsort(~keep, stable=True)  # kept rows first
+    dense = jnp.where(keep[order], del_target[order], 0)
+    tomb_id, num_tombs, tomb_ov = _append_rows(
+        state.tomb_id, state.num_tombs, dense, jnp.sum(keep).astype(jnp.int32)
+    )
+
+    marks_in = {col: getattr(state, col) for col in MARK_COLS}
+    marks_out, num_marks, mark_ov = _append_rows(
+        marks_in, state.num_marks, mark_rows, mark_count
+    )
     return state._replace(
-        elem_ctr=write(state.elem_ctr, shifted(state.elem_ctr, op_ctr)),
-        elem_actor=write(state.elem_actor, shifted(state.elem_actor, op_actor)),
-        char=write(state.char, shifted(state.char, row[F_CHAR])),
-        deleted=write(state.deleted, shifted(state.deleted, False)),
-        num_slots=jnp.where(ok, n + 1, n),
-        overflow=state.overflow | ~ok,
+        elem_id=elem,
+        char=char,
+        tomb_id=tomb_id,
+        num_slots=n,
+        num_tombs=num_tombs,
+        num_marks=num_marks,
+        overflow=ov | del_err | tomb_ov | mark_ov,
+        **marks_out,
     )
 
 
-def _apply_delete(state: PackedDocs, row: jnp.ndarray) -> PackedDocs:
-    s_cap = state.elem_ctr.shape[0]
-    pos = jnp.arange(s_cap, dtype=jnp.int32)
-    match = (
-        (state.elem_ctr == row[F_REF_CTR])
-        & (state.elem_actor == row[F_REF_ACTOR])
-        & (pos < state.num_slots)
-    )
-    found = jnp.any(match)
-    return state._replace(
-        deleted=state.deleted | match,
-        overflow=state.overflow | ~found,
+def apply_batch(state: PackedDocs, encoded_arrays) -> PackedDocs:
+    """Batched apply: vmap of the two-phase pipeline over the doc axis.
+
+    ``encoded_arrays`` is the tuple
+    (ins_ref, ins_op, ins_char, del_target, marks_dict, mark_count)
+    with leading doc axes, as produced by :func:`encoded_arrays_of`.
+    """
+    ins_ref, ins_op, ins_char, del_target, marks, mark_count = encoded_arrays
+    return jax.vmap(_apply_doc)(
+        state, ins_ref, ins_op, ins_char, del_target, marks, mark_count
     )
 
 
-def _apply_mark(action: int, state: PackedDocs, row: jnp.ndarray) -> PackedDocs:
-    m_cap = state.m_action.shape[0]
-    mpos = jnp.arange(m_cap, dtype=jnp.int32)
-    idx = state.num_marks
-    at = mpos == idx  # matches nothing when idx >= m_cap
-
-    def w(arr, value):
-        return jnp.where(at, value, arr)
-
-    return state._replace(
-        m_action=w(state.m_action, jnp.int32(action)),
-        m_type=w(state.m_type, row[F_MARK_TYPE]),
-        m_start_kind=w(state.m_start_kind, row[F_START_KIND]),
-        m_start_ctr=w(state.m_start_ctr, row[F_START_CTR]),
-        m_start_actor=w(state.m_start_actor, row[F_START_ACTOR]),
-        m_end_kind=w(state.m_end_kind, row[F_END_KIND]),
-        m_end_ctr=w(state.m_end_ctr, row[F_END_CTR]),
-        m_end_actor=w(state.m_end_actor, row[F_END_ACTOR]),
-        m_op_ctr=w(state.m_op_ctr, row[F_OP_CTR]),
-        m_op_actor=w(state.m_op_actor, row[F_OP_ACTOR]),
-        m_attr=w(state.m_attr, row[F_ATTR]),
-        num_marks=jnp.minimum(idx + 1, m_cap),
-        overflow=state.overflow | (idx >= m_cap),
+def encoded_arrays_of(encoded: EncodedBatch):
+    """The device-array tuple for apply_batch from a host EncodedBatch."""
+    return (
+        jnp.asarray(encoded.ins_ref),
+        jnp.asarray(encoded.ins_op),
+        jnp.asarray(encoded.ins_char),
+        jnp.asarray(encoded.del_target),
+        {col: jnp.asarray(arr) for col, arr in encoded.marks.items()},
+        jnp.asarray(encoded.mark_count),
     )
 
 
-def apply_ops_single(state: PackedDocs, ops: jnp.ndarray) -> PackedDocs:
-    """Apply one document's padded op stream (K, NUM_FIELDS) sequentially."""
-
-    branches = (
-        _apply_pad,
-        _apply_insert,
-        _apply_delete,
-        partial(_apply_mark, MA_ADD),
-        partial(_apply_mark, MA_REMOVE),
-    )
-
-    def body(k, st):
-        row = ops[k]
-        return lax.switch(jnp.clip(row[F_KIND], 0, 4), branches, st, row)
-
-    return lax.fori_loop(0, ops.shape[0], body, state)
+apply_batch_jit = jax.jit(apply_batch)
 
 
-#: Batched apply: vmap over the doc axis.  jit at the call site (api/batch.py)
-#: so sharding constraints can be attached.
-apply_ops = jax.vmap(apply_ops_single)
-
-
-apply_ops_jit = jax.jit(apply_ops)
+# Backwards-compatible aliases for the driver entry / benches.
+apply_ops = apply_batch
+apply_ops_jit = apply_batch_jit

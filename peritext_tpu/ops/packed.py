@@ -12,10 +12,13 @@ its boundary anchors straddle that character's gap in the final element order
 makes it batchable *and* removes the reference's materialized-gap divergence
 bugs (its traces/ record them).
 
-All identifiers are interned to int32 host-side (see ops/encode.py):
-op IDs become (counter, actor_index) pairs compared lexicographically, where
-actor indices are assigned in sorted-actor-string order so device ordering
-matches the reference's string comparison (src/micromerge.ts:1389-1403).
+Element and op identifiers are single int32s: ``(counter << ACTOR_BITS) |
+actor_index`` with actor indices assigned in sorted-actor-string order
+(ops/encode.py), so plain integer comparison IS the reference's op-ID order
+(counter first, then lexicographic actor; src/micromerge.ts:1389-1403).
+Halving the bytes per identifier matters: the sequential insert loop is HBM
+bandwidth bound, and it carries exactly two (D, S) arrays — packed element
+ids and characters.
 """
 
 from __future__ import annotations
@@ -24,6 +27,21 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 import numpy as np
+
+# Identifier packing: id = (ctr << ACTOR_BITS) | actor_index.
+# actor 0 is reserved; packed id 0 means HEAD / empty slot.
+ACTOR_BITS = 10
+MAX_ACTORS = (1 << ACTOR_BITS) - 1  # 1023 actors per document
+MAX_CTR = (1 << (31 - ACTOR_BITS)) - 1  # ~2M ops per document
+
+
+def pack_id(ctr: int, actor_index: int) -> int:
+    return (ctr << ACTOR_BITS) | actor_index
+
+
+def unpack_id(packed: int):
+    return packed >> ACTOR_BITS, packed & MAX_ACTORS
+
 
 # Boundary-kind encoding (core/types.py Boundary kinds)
 BK_BEFORE = 0
@@ -40,66 +58,72 @@ class PackedDocs(NamedTuple):
     """Batched document state; leading axis D is the (shardable) doc axis.
 
     Slots [0, num_slots[d]) of doc d hold its elements in document order,
-    tombstones included.  Element IDs are (ctr, actor) int32 pairs; actor 0 is
-    reserved/invalid.
+    tombstones included.
     """
 
     # element axis (D, S)
-    elem_ctr: jnp.ndarray  # int32
-    elem_actor: jnp.ndarray  # int32
+    elem_id: jnp.ndarray  # int32 packed (ctr << ACTOR_BITS | actor)
     char: jnp.ndarray  # int32 codepoint
-    deleted: jnp.ndarray  # bool
+    # tombstone table (D, T): packed ids of deleted elements (append-only;
+    # slot-aligned deleted flags would go stale when later inserts shift
+    # slots, so visibility is recomputed at read time instead)
+    tomb_id: jnp.ndarray  # int32 packed (0 = empty row)
     # mark-op table (D, M)
     m_action: jnp.ndarray  # int32: MA_ADD / MA_REMOVE (0 = empty row)
     m_type: jnp.ndarray  # int32: schema.MARK_INDEX
     m_start_kind: jnp.ndarray  # int32 BK_*
-    m_start_ctr: jnp.ndarray  # int32
-    m_start_actor: jnp.ndarray  # int32
+    m_start_elem: jnp.ndarray  # int32 packed
     m_end_kind: jnp.ndarray  # int32
-    m_end_ctr: jnp.ndarray  # int32
-    m_end_actor: jnp.ndarray  # int32
-    m_op_ctr: jnp.ndarray  # int32
-    m_op_actor: jnp.ndarray  # int32
+    m_end_elem: jnp.ndarray  # int32 packed
+    m_op: jnp.ndarray  # int32 packed op id
     m_attr: jnp.ndarray  # int32 interned attr (url/comment id); 0 = none
     # scalars per doc (D,)
     num_slots: jnp.ndarray  # int32
+    num_tombs: jnp.ndarray  # int32
     num_marks: jnp.ndarray  # int32
-    overflow: jnp.ndarray  # bool: any capacity exceeded (slot or mark table)
+    overflow: jnp.ndarray  # bool: capacity exceeded or invalid reference
 
     @property
     def num_docs(self) -> int:
-        return self.elem_ctr.shape[0]
+        return self.elem_id.shape[0]
 
     @property
     def slot_capacity(self) -> int:
-        return self.elem_ctr.shape[1]
+        return self.elem_id.shape[1]
+
+    @property
+    def tomb_capacity(self) -> int:
+        return self.tomb_id.shape[1]
 
     @property
     def mark_capacity(self) -> int:
         return self.m_action.shape[1]
 
 
-def empty_docs(num_docs: int, slot_capacity: int, mark_capacity: int) -> PackedDocs:
+def empty_docs(
+    num_docs: int,
+    slot_capacity: int,
+    mark_capacity: int,
+    tomb_capacity: int | None = None,
+) -> PackedDocs:
     """Fresh empty batch (documents are built by applying their change logs)."""
     d, s, m = num_docs, slot_capacity, mark_capacity
+    t = tomb_capacity if tomb_capacity is not None else s
     zi = lambda *shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
     return PackedDocs(
-        elem_ctr=zi(d, s),
-        elem_actor=zi(d, s),
+        elem_id=zi(d, s),
         char=zi(d, s),
-        deleted=jnp.zeros((d, s), bool),
+        tomb_id=zi(d, t),
         m_action=zi(d, m),
         m_type=zi(d, m),
         m_start_kind=zi(d, m),
-        m_start_ctr=zi(d, m),
-        m_start_actor=zi(d, m),
+        m_start_elem=zi(d, m),
         m_end_kind=zi(d, m),
-        m_end_ctr=zi(d, m),
-        m_end_actor=zi(d, m),
-        m_op_ctr=zi(d, m),
-        m_op_actor=zi(d, m),
+        m_end_elem=zi(d, m),
+        m_op=zi(d, m),
         m_attr=zi(d, m),
         num_slots=zi(d),
+        num_tombs=zi(d),
         num_marks=zi(d),
         overflow=jnp.zeros((d,), bool),
     )

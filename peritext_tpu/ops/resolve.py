@@ -9,10 +9,14 @@ BoundaryPosition, src/micromerge.ts:266-270; this is the pure form of the
 reference's materialized-gap walk :1002-1138).
 
 Winners are resolved per mark type exactly as core/spans.ops_to_marks:
-last-writer-wins by (ctr, actor) for strong/em/link, per-comment-id LWW for
-comments.  Realized as a ``fori_loop`` over the mark table maintaining running
-lexicographic-max winner state per slot — O(S) (and O(C x S) for comments)
-memory, no (M x S) cover matrix is ever materialized.
+last-writer-wins by op id for strong/em/link, per-comment-id LWW for
+comments — packed ids make every winner comparison a single integer max.
+Realized as a ``fori_loop`` over the mark table maintaining running winner
+state per slot: O(S) (and O(C x S) for comments) memory; no (M x S) cover
+matrix is ever materialized.
+
+Visibility is also computed here: a slot is visible iff occupied and its
+element id is absent from the tombstone table (one vectorized any-match).
 """
 
 from __future__ import annotations
@@ -25,7 +29,6 @@ from jax import lax
 
 from ..schema import ALL_MARKS, MARK_INDEX
 from .packed import (
-    BK_AFTER,
     BK_BEFORE,
     BK_END_OF_TEXT,
     BK_START_OF_TEXT,
@@ -33,7 +36,7 @@ from .packed import (
     PackedDocs,
 )
 
-NUM_LWW_TYPES = len(ALL_MARKS)  # winner tracked per type; comments use rows too
+NUM_TYPES = len(ALL_MARKS)
 COMMENT_TYPE = MARK_INDEX["comment"]
 
 
@@ -51,13 +54,12 @@ class ResolvedDocs(NamedTuple):
     overflow: jnp.ndarray  # bool (D,)
 
 
-def _anchor_gap(state: PackedDocs, kind, ctr, actor, pos, n):
-    """Gap-grid position of a boundary anchor; elements matched over slots."""
-    match = (state.elem_ctr == ctr) & (state.elem_actor == actor) & (pos < n)
+def _anchor_gap(elem_id, kind, anchor, pos, n, big):
+    """Gap-grid position of a boundary anchor; element matched over slots."""
+    match = (elem_id == anchor) & (pos < n)
     idx = jnp.argmax(match).astype(jnp.int32)
     found = jnp.any(match)
     elem_gap = jnp.where(kind == BK_BEFORE, 2 * idx, 2 * idx + 1)
-    big = jnp.int32(2 * state.elem_ctr.shape[0] + 1)
     gap = jnp.where(
         kind == BK_START_OF_TEXT,
         jnp.int32(-1),
@@ -69,78 +71,71 @@ def _anchor_gap(state: PackedDocs, kind, ctr, actor, pos, n):
 
 def resolve_single(state: PackedDocs, comment_capacity: int) -> ResolvedDocs:
     """Resolve one document (unbatched arrays)."""
-    s_cap = state.elem_ctr.shape[0]
+    s_cap = state.elem_id.shape[0]
     m_cap = state.m_action.shape[0]
     pos = jnp.arange(s_cap, dtype=jnp.int32)
     n = state.num_slots
+    big = jnp.int32(2 * s_cap + 1)
     gap_before = 2 * pos  # the gap governing each slot's character
 
     class Carry(NamedTuple):
-        best_ctr: jnp.ndarray  # (T, S)
-        best_actor: jnp.ndarray  # (T, S)
+        best_op: jnp.ndarray  # (T, S) packed id of winning op per LWW type
         best_add: jnp.ndarray  # (T, S) bool
         best_attr: jnp.ndarray  # (T, S) int32 (only the link row is read)
-        c_ctr: jnp.ndarray  # (C, S)
-        c_actor: jnp.ndarray  # (C, S)
+        c_op: jnp.ndarray  # (C, S)
         c_add: jnp.ndarray  # (C, S) bool
         error: jnp.ndarray  # () bool
 
-    t_shape = (NUM_LWW_TYPES, s_cap)
-    c_shape = (comment_capacity, s_cap)
     carry = Carry(
-        best_ctr=jnp.full(t_shape, -1, jnp.int32),
-        best_actor=jnp.full(t_shape, -1, jnp.int32),
-        best_add=jnp.zeros(t_shape, bool),
-        best_attr=jnp.zeros(t_shape, jnp.int32),
-        c_ctr=jnp.full(c_shape, -1, jnp.int32),
-        c_actor=jnp.full(c_shape, -1, jnp.int32),
-        c_add=jnp.zeros(c_shape, bool),
+        best_op=jnp.zeros((NUM_TYPES, s_cap), jnp.int32),
+        best_add=jnp.zeros((NUM_TYPES, s_cap), bool),
+        best_attr=jnp.zeros((NUM_TYPES, s_cap), jnp.int32),
+        c_op=jnp.zeros((comment_capacity, s_cap), jnp.int32),
+        c_add=jnp.zeros((comment_capacity, s_cap), bool),
         error=jnp.asarray(False),
     )
 
     def body(m, carry: Carry) -> Carry:
         live = state.m_action[m] != 0
         s_gap, s_ok = _anchor_gap(
-            state, state.m_start_kind[m], state.m_start_ctr[m], state.m_start_actor[m], pos, n
+            state.elem_id, state.m_start_kind[m], state.m_start_elem[m], pos, n, big
         )
         e_gap, e_ok = _anchor_gap(
-            state, state.m_end_kind[m], state.m_end_ctr[m], state.m_end_actor[m], pos, n
+            state.elem_id, state.m_end_kind[m], state.m_end_elem[m], pos, n, big
         )
-        cover = live & (s_gap <= gap_before) & (gap_before < e_gap) & (pos < n)  # (S,)
+        cover = live & (s_gap <= gap_before) & (gap_before < e_gap) & (pos < n)
 
-        op_ctr, op_actor = state.m_op_ctr[m], state.m_op_actor[m]
+        op = state.m_op[m]
         is_add = state.m_action[m] == MA_ADD
         mtype = state.m_type[m]
         attr = state.m_attr[m]
 
-        # LWW winner update for this op's type row.
-        type_row = (jnp.arange(NUM_LWW_TYPES, dtype=jnp.int32) == mtype)[:, None]
-        newer = (op_ctr > carry.best_ctr) | (
-            (op_ctr == carry.best_ctr) & (op_actor > carry.best_actor)
-        )
-        upd = type_row & cover[None, :] & newer & (mtype != COMMENT_TYPE)
-        best_ctr = jnp.where(upd, op_ctr, carry.best_ctr)
-        best_actor = jnp.where(upd, op_actor, carry.best_actor)
+        # LWW winner update for this op's type row (packed id max).
+        type_row = (jnp.arange(NUM_TYPES, dtype=jnp.int32) == mtype)[:, None]
+        upd = type_row & cover[None, :] & (op > carry.best_op) & (mtype != COMMENT_TYPE)
+        best_op = jnp.where(upd, op, carry.best_op)
         best_add = jnp.where(upd, is_add, carry.best_add)
         best_attr = jnp.where(upd, attr, carry.best_attr)
 
         # Per-comment-id winner update (row = interned attr id).
         c_row = (jnp.arange(comment_capacity, dtype=jnp.int32) == attr)[:, None]
-        c_newer = (op_ctr > carry.c_ctr) | (
-            (op_ctr == carry.c_ctr) & (op_actor > carry.c_actor)
-        )
-        c_upd = c_row & cover[None, :] & c_newer & (mtype == COMMENT_TYPE)
-        c_ctr = jnp.where(c_upd, op_ctr, carry.c_ctr)
-        c_actor = jnp.where(c_upd, op_actor, carry.c_actor)
+        c_upd = c_row & cover[None, :] & (op > carry.c_op) & (mtype == COMMENT_TYPE)
+        c_op = jnp.where(c_upd, op, carry.c_op)
         c_add = jnp.where(c_upd, is_add, carry.c_add)
 
         error = carry.error | (live & ~(s_ok & e_ok))
         error = error | (live & (mtype == COMMENT_TYPE) & (attr >= comment_capacity))
-        return Carry(best_ctr, best_actor, best_add, best_attr, c_ctr, c_actor, c_add, error)
+        return Carry(best_op, best_add, best_attr, c_op, c_add, error)
 
     out = lax.fori_loop(0, m_cap, body, carry)
 
-    visible = (pos < n) & ~state.deleted
+    # Visibility: occupied and not tombstoned (one vectorized any-match).
+    tombed = jnp.any(
+        (state.elem_id[:, None] == state.tomb_id[None, :]) & (state.tomb_id != 0)[None, :],
+        axis=1,
+    )
+    visible = (pos < n) & ~tombed
+
     return ResolvedDocs(
         char=state.char,
         visible=visible,

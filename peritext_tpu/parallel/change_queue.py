@@ -58,6 +58,7 @@ class ChangeQueue:
         """Begin periodic flushing on a daemon timer."""
         with self._lock:
             self._running = True
+            self._current_interval = self._interval  # forget stale backoff
         self._schedule()
 
     def _schedule(self) -> None:

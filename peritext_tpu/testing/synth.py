@@ -3,115 +3,83 @@
 The fuzz harness (testing/fuzz.py) produces semantically-checked workloads but
 pays full scalar-oracle cost per generated op — fine for correctness, too slow
 to build 10K-doc x 4K-op benchmark batches.  This module emits *valid encoded
-op streams directly* (inserts reference existing elements, deletes target
+split streams directly* (inserts reference existing elements, deletes target
 existing elements, mark anchors are real), which is exactly what the device
 kernel consumes after host-side causal scheduling; generation is cheap numpy.
 
-Opids are (k+1, random actor) for stream position k — unique per doc, with
-random actor tie-breaking so the RGA convergence skip path gets exercised.
+Packed op ids are (k+1 << ACTOR_BITS | random actor) for stream position k —
+unique per doc, with random actor bits so the RGA convergence skip path gets
+exercised.
 """
 
 from __future__ import annotations
 
+from typing import Dict, Tuple
+
 import numpy as np
 
+from ..ops.encode import MARK_COLS
+from ..ops.packed import ACTOR_BITS, BK_AFTER, BK_BEFORE, MA_ADD, MA_REMOVE
 from ..schema import MARK_INDEX
-from ..ops.encode import (
-    F_ATTR,
-    F_CHAR,
-    F_END_ACTOR,
-    F_END_CTR,
-    F_END_KIND,
-    F_KIND,
-    F_MARK_TYPE,
-    F_OP_ACTOR,
-    F_OP_CTR,
-    F_REF_ACTOR,
-    F_REF_CTR,
-    F_START_ACTOR,
-    F_START_CTR,
-    F_START_KIND,
-    K_ADD_MARK,
-    K_DELETE,
-    K_INSERT,
-    K_REMOVE_MARK,
-    NUM_FIELDS,
-)
-from ..ops.packed import BK_AFTER, BK_BEFORE
+
+SynthStreams = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, Dict[str, np.ndarray], np.ndarray]
 
 
-def synth_op_streams(
+def synth_streams(
     num_docs: int,
-    ops_per_doc: int,
+    inserts_per_doc: int,
+    deletes_per_doc: int = 0,
+    marks_per_doc: int = 0,
     num_actors: int = 4,
-    insert_frac: float = 0.7,
-    delete_frac: float = 0.15,
     seed: int = 0,
-) -> np.ndarray:
-    """(D, K, NUM_FIELDS) int32 op streams, every doc independent."""
+) -> SynthStreams:
+    """Split-stream tuple (ins_ref, ins_op, ins_char, del_target, marks,
+    mark_count) shaped for ops/kernel.apply_batch."""
     rng = np.random.default_rng(seed)
-    d, k = num_docs, ops_per_doc
-    out = np.zeros((d, k, NUM_FIELDS), np.int32)
+    d, ki, kd, km = num_docs, inserts_per_doc, deletes_per_doc, marks_per_doc
 
-    u = rng.random((d, k))
-    kinds = np.where(
-        u < insert_frac,
-        K_INSERT,
-        np.where(u < insert_frac + delete_frac, K_DELETE, K_ADD_MARK),
+    actors = rng.integers(1, num_actors + 1, size=(d, ki), dtype=np.int32)
+    ctrs = np.broadcast_to(np.arange(1, ki + 1, dtype=np.int32), (d, ki))
+    ins_op = (ctrs << ACTOR_BITS) | actors
+
+    # ref for insert k: HEAD (5%) or a uniformly random earlier insert
+    pick = rng.random((d, ki))
+    ref_idx = (pick * np.arange(ki)[None, :]).astype(np.int64)  # in [0, k)
+    ins_ref = np.where(
+        (np.arange(ki)[None, :] == 0) | (pick < 0.05),
+        np.int32(0),
+        np.take_along_axis(ins_op, ref_idx, axis=1),
     ).astype(np.int32)
-    # a slice of the marks are removals
-    mark_mask = kinds == K_ADD_MARK
-    removes = rng.random((d, k)) < 0.3
-    kinds = np.where(mark_mask & removes, K_REMOVE_MARK, kinds)
-    # first op of every doc must insert (nothing exists yet)
-    kinds[:, 0] = K_INSERT
+    ins_char = rng.integers(ord("a"), ord("z") + 1, size=(d, ki), dtype=np.int32)
 
-    actors = rng.integers(1, num_actors + 1, size=(d, k), dtype=np.int32)
-    chars = rng.integers(ord("a"), ord("z") + 1, size=(d, k), dtype=np.int32)
-    mark_types = rng.integers(0, len(MARK_INDEX), size=(d, k), dtype=np.int32)
-    attrs = rng.integers(1, 16, size=(d, k), dtype=np.int32)
-    sides = rng.integers(0, 2, size=(d, k, 2), dtype=np.int32)  # BK_BEFORE/AFTER
+    # deletes target random inserted elements
+    del_idx = rng.integers(0, ki, size=(d, kd), dtype=np.int64)
+    del_target = (
+        np.take_along_axis(ins_op, del_idx, axis=1) if kd else np.zeros((d, 0), np.int32)
+    ).astype(np.int32)
 
-    # Random reference selection: pick a uniform earlier stream position that
-    # was an insert (all inserts create elements with ctr = pos + 1).
-    ref_pick = rng.random((d, k))
-    anchor_pick = rng.random((d, k, 2))
+    marks = {col: np.zeros((d, km), np.int32) for col in MARK_COLS}
+    if km:
+        a_idx = rng.integers(0, ki, size=(d, km), dtype=np.int64)
+        b_idx = rng.integers(0, ki, size=(d, km), dtype=np.int64)
+        marks["m_action"][:] = np.where(rng.random((d, km)) < 0.7, MA_ADD, MA_REMOVE)
+        marks["m_type"][:] = rng.integers(0, len(MARK_INDEX), size=(d, km))
+        marks["m_start_kind"][:] = np.where(rng.random((d, km)) < 0.5, BK_BEFORE, BK_AFTER)
+        marks["m_start_elem"][:] = np.take_along_axis(ins_op, a_idx, axis=1)
+        marks["m_end_kind"][:] = np.where(rng.random((d, km)) < 0.5, BK_BEFORE, BK_AFTER)
+        marks["m_end_elem"][:] = np.take_along_axis(ins_op, b_idx, axis=1)
+        # mark op ids continue the counter space above the inserts
+        m_ctrs = np.broadcast_to(
+            np.arange(ki + 1, ki + km + 1, dtype=np.int32), (d, km)
+        )
+        m_actors = rng.integers(1, num_actors + 1, size=(d, km), dtype=np.int32)
+        marks["m_op"][:] = (m_ctrs << ACTOR_BITS) | m_actors
+        marks["m_attr"][:] = rng.integers(1, 16, size=(d, km))
+    mark_count = np.full(d, km, np.int32)
 
-    for di in range(d):
-        insert_ctrs: list = []  # ctrs of elements created so far (this doc)
-        for ki in range(k):
-            row = out[di, ki]
-            kind = kinds[di, ki]
-            n_elems = len(insert_ctrs)
-            if kind != K_INSERT and n_elems == 0:
-                kind = K_INSERT
-            row[F_KIND] = kind
-            row[F_OP_CTR] = ki + 1
-            row[F_OP_ACTOR] = actors[di, ki]
-            if kind == K_INSERT:
-                # ref: HEAD with small probability, else random existing elem
-                if n_elems == 0 or ref_pick[di, ki] < 0.05:
-                    pass  # HEAD = (0, 0)
-                else:
-                    j = int(ref_pick[di, ki] * n_elems) % n_elems
-                    row[F_REF_CTR] = insert_ctrs[j]
-                    # actor of that elem: reconstruct from stream
-                    row[F_REF_ACTOR] = out[di, insert_ctrs[j] - 1, F_OP_ACTOR]
-                row[F_CHAR] = chars[di, ki]
-                insert_ctrs.append(ki + 1)
-            elif kind == K_DELETE:
-                j = int(ref_pick[di, ki] * n_elems) % n_elems
-                row[F_REF_CTR] = insert_ctrs[j]
-                row[F_REF_ACTOR] = out[di, insert_ctrs[j] - 1, F_OP_ACTOR]
-            else:  # marks
-                j0 = int(anchor_pick[di, ki, 0] * n_elems) % n_elems
-                j1 = int(anchor_pick[di, ki, 1] * n_elems) % n_elems
-                row[F_START_KIND] = BK_BEFORE if sides[di, ki, 0] == 0 else BK_AFTER
-                row[F_START_CTR] = insert_ctrs[j0]
-                row[F_START_ACTOR] = out[di, insert_ctrs[j0] - 1, F_OP_ACTOR]
-                row[F_END_KIND] = BK_BEFORE if sides[di, ki, 1] == 0 else BK_AFTER
-                row[F_END_CTR] = insert_ctrs[j1]
-                row[F_END_ACTOR] = out[di, insert_ctrs[j1] - 1, F_OP_ACTOR]
-                row[F_MARK_TYPE] = mark_types[di, ki]
-                row[F_ATTR] = attrs[di, ki]
-    return out
+    return ins_ref, ins_op, ins_char, del_target, marks, mark_count
+
+
+def synth_total_ops(streams: SynthStreams) -> int:
+    ins_ref, ins_op, _, del_target, marks, _ = streams
+    return int(ins_op.size + del_target.size + marks["m_action"].size)

@@ -40,12 +40,10 @@ def test_state_is_actually_sharded(mesh):
         slot_capacity=128, mark_capacity=64, comment_capacity=16, op_capacity=128,
         mesh=mesh,
     )
-    from peritext_tpu.ops.encode import encode_workloads
-
-    encoded = encode_workloads(workloads, op_capacity=128)
-    state = batch.apply_encoded(encoded.ops)
+    encoded = batch.encode(workloads)
+    state = batch.apply_encoded(encoded)
     # each of the 8 devices should hold a (2, ...) shard of the 16-doc batch
-    shards = state.elem_ctr.addressable_shards
+    shards = state.elem_id.addressable_shards
     assert len(shards) == 8
     assert all(s.data.shape[0] == 2 for s in shards)
 
@@ -56,10 +54,8 @@ def test_convergence_digest_allreduce(mesh):
         slot_capacity=128, mark_capacity=64, comment_capacity=16, op_capacity=128,
         mesh=mesh,
     )
-    from peritext_tpu.ops.encode import encode_workloads
-
-    encoded = encode_workloads(workloads, op_capacity=128)
-    state = batch.apply_encoded(encoded.ops)
+    encoded = batch.encode(workloads)
+    state = batch.apply_encoded(encoded)
     resolved = resolve_jit(state, 16)
 
     digest_fn = jax.jit(convergence_digest)
@@ -68,16 +64,16 @@ def test_convergence_digest_allreduce(mesh):
     reordered = [
         {actor: log for actor, log in reversed(list(w.items()))} for w in workloads
     ]
-    encoded2 = encode_workloads(reordered, op_capacity=128)
-    state2 = batch.apply_encoded(encoded2.ops)
+    encoded2 = batch.encode(reordered)
+    state2 = batch.apply_encoded(encoded2)
     resolved2 = resolve_jit(state2, 16)
     d2 = digest_fn(resolved2.char, resolved2.visible)
     assert int(d1) == int(d2)
 
     # and a genuinely different batch digests differently
     other = generate_workload(seed=12, num_docs=8, ops_per_doc=30)
-    encoded3 = encode_workloads(other, op_capacity=128)
-    state3 = batch.apply_encoded(encoded3.ops)
+    encoded3 = batch.encode(other)
+    state3 = batch.apply_encoded(encoded3)
     resolved3 = resolve_jit(state3, 16)
     d3 = digest_fn(resolved3.char, resolved3.visible)
     assert int(d1) != int(d3)
