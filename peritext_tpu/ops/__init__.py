@@ -5,8 +5,6 @@ from .encode import EncodedBatch, encode_workloads
 from .kernel import (
     apply_batch,
     apply_batch_jit,
-    apply_ops,
-    apply_ops_jit,
     encoded_arrays_of,
 )
 from .packed import ACTOR_BITS, PackedDocs, empty_docs, pack_id, unpack_id
@@ -22,8 +20,6 @@ __all__ = [
     "encode_workloads",
     "apply_batch",
     "apply_batch_jit",
-    "apply_ops",
-    "apply_ops_jit",
     "encoded_arrays_of",
     "ResolvedDocs",
     "resolve",

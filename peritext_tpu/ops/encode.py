@@ -178,7 +178,9 @@ def encode_workloads(
         }
         actors = OrderedActorTable(actor_set)
         attrs = Interner()
-        ok = len(actors) <= MAX_ACTORS
+        # len(actors) includes the reserved index-0 None slot, so the largest
+        # assigned actor index is len(actors) - 1, which must fit ACTOR_BITS.
+        ok = len(actors) - 1 <= MAX_ACTORS
         streams = _DocStreams()
         if ok:
             try:

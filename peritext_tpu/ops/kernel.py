@@ -102,8 +102,16 @@ def _apply_doc(state: PackedDocs, ins_ref, ins_op, ins_char, del_target, mark_ro
     # (dedup against rows already there keeps re-delivery idempotent).
     live = del_target != 0
     exists = jnp.any(elem[:, None] == del_target[None, :], axis=0)  # (KD,)
-    already = jnp.any(
-        state.tomb_id[:, None] == del_target[None, :], axis=0
+    # Idempotence: skip targets already tombstoned in the carried-over table
+    # AND duplicates within this stream (concurrent deletes of one char).
+    kd = del_target.shape[0]
+    dup_earlier = jnp.any(
+        (del_target[None, :] == del_target[:, None])
+        & (jnp.arange(kd)[:, None] < jnp.arange(kd)[None, :]),
+        axis=0,
+    )
+    already = (
+        jnp.any(state.tomb_id[:, None] == del_target[None, :], axis=0) | dup_earlier
     ) & live
     del_err = jnp.any(live & ~exists)
     keep = live & exists & ~already
@@ -156,8 +164,3 @@ def encoded_arrays_of(encoded: EncodedBatch):
 
 
 apply_batch_jit = jax.jit(apply_batch)
-
-
-# Backwards-compatible aliases for the driver entry / benches.
-apply_ops = apply_batch
-apply_ops_jit = apply_batch_jit

@@ -1,0 +1,236 @@
+"""Editor bridge tests (reference behaviors from ``src/bridge.ts``).
+
+The core invariant throughout: the editor view is driven *only* by patches
+(incremental path), and must equal a full ``get_text_with_formatting`` render
+(batch path) after every operation — the same dual-oracle the reference's
+``accumulatePatches`` tests enforce.
+"""
+
+import pytest
+
+from peritext_tpu.bridge import (
+    Editor,
+    EditorDoc,
+    Transaction,
+    create_editor,
+    editor_doc_from_crdt,
+    initialize_docs,
+    patch_to_steps,
+    transaction_to_input_ops,
+)
+from peritext_tpu.bridge.commands import (
+    add_comment,
+    delete_range,
+    set_link,
+    toggle_bold,
+    toggle_italic,
+    type_text,
+)
+from peritext_tpu.core.types import span
+from peritext_tpu.parallel.pubsub import Publisher
+
+
+def make_pair(text="The Peritext editor"):
+    pub = Publisher()
+    alice = create_editor("alice", pub)
+    bob = create_editor("bob", pub)
+    initialize_docs([alice, bob], text)
+    return pub, alice, bob
+
+
+def assert_view_consistent(editor: Editor):
+    """Incremental patch-driven view == full CRDT render."""
+    assert editor.view == editor_doc_from_crdt(editor.doc)
+
+
+class TestTransforms:
+    def test_insert_step_position_shift(self):
+        # Editor position p addresses CRDT index p-1 (reference :360-371).
+        ops = transaction_to_input_ops(Transaction().insert_text(1, "hi"))
+        assert ops == [
+            {"path": ["text"], "action": "insert", "index": 0, "values": ["h", "i"]}
+        ]
+
+    def test_replace_becomes_delete_then_insert(self):
+        # Reference translates content-bearing ReplaceStep as delete+insert
+        # (src/bridge.ts:428-444).
+        ops = transaction_to_input_ops(Transaction().replace(2, 5, "xyz"))
+        assert ops == [
+            {"path": ["text"], "action": "delete", "index": 1, "count": 3},
+            {"path": ["text"], "action": "insert", "index": 1, "values": ["x", "y", "z"]},
+        ]
+
+    def test_mark_steps(self):
+        ops = transaction_to_input_ops(
+            Transaction()
+            .add_mark(1, 4, "strong")
+            .remove_mark(2, 3, "comment", {"id": "c1"})
+        )
+        assert ops == [
+            {
+                "path": ["text"],
+                "action": "addMark",
+                "startIndex": 0,
+                "endIndex": 3,
+                "markType": "strong",
+            },
+            {
+                "path": ["text"],
+                "action": "removeMark",
+                "startIndex": 1,
+                "endIndex": 2,
+                "markType": "comment",
+                "attrs": {"id": "c1"},
+            },
+        ]
+
+    def test_patch_to_steps_roundtrip_indices(self):
+        view = EditorDoc(list("abc"), [{}, {}, {}])
+        for step in patch_to_steps(
+            {"path": ["text"], "action": "insert", "index": 1, "values": ["X"], "marks": {}}
+        ):
+            step.apply(view)
+        assert view.text == "aXbc"
+        for step in patch_to_steps(
+            {"path": ["text"], "action": "delete", "index": 0, "count": 2}
+        ):
+            step.apply(view)
+        assert view.text == "bc"
+
+
+class TestLocalDispatch:
+    def test_typing_updates_view_via_patches(self):
+        _, alice, bob = make_pair()
+        type_text(alice, 1, "Hey! ")
+        assert alice.text == "Hey! The Peritext editor"
+        assert_view_consistent(alice)
+
+    def test_bold_then_unbold(self):
+        _, alice, _ = make_pair()
+        toggle_bold(alice, 5, 13)
+        assert {"strong": {"active": True}} in [m for m in alice.view.marks]
+        assert_view_consistent(alice)
+        toggle_bold(alice, 5, 13)  # toggle off
+        assert all("strong" not in m for m in alice.view.marks)
+        assert_view_consistent(alice)
+
+    def test_replace_range(self):
+        _, alice, _ = make_pair("hello world")
+        alice.dispatch(Transaction().replace(1, 6, "goodbye"))
+        assert alice.text == "goodbye world"
+        assert_view_consistent(alice)
+
+    def test_comment_and_link(self):
+        _, alice, _ = make_pair("hello world")
+        add_comment(alice, 1, 6, comment_id="c-1")
+        set_link(alice, 7, 12, "https://example.com")
+        spans = alice.doc.get_text_with_formatting(["text"])
+        assert spans == [
+            span("hello", {"comment": [{"id": "c-1"}]}),
+            span(" "),
+            span("world", {"link": {"active": True, "url": "https://example.com"}}),
+        ]
+        assert_view_consistent(alice)
+
+
+class TestSync:
+    def test_two_editor_convergence_via_pubsub(self):
+        _, alice, bob = make_pair()
+        type_text(alice, 1, "A")
+        type_text(bob, 1, "B")
+        # nothing flushed yet: views diverge
+        assert alice.text != bob.text
+        alice.sync()
+        bob.sync()
+        assert alice.text == bob.text
+        assert alice.view == bob.view
+        assert_view_consistent(alice)
+        assert_view_consistent(bob)
+
+    def test_concurrent_format_and_edit(self):
+        _, alice, bob = make_pair("The quick fox")
+        toggle_bold(alice, 1, 10)
+        type_text(bob, 5, "very ")
+        alice.sync()
+        bob.sync()
+        assert alice.text == bob.text == "The very quick fox"
+        assert alice.view == bob.view
+        assert_view_consistent(alice)
+
+    def test_out_of_order_delivery_holdback(self):
+        pub, alice, bob = make_pair()
+        ch1 = type_text(alice, 1, "one ")
+        ch2 = type_text(alice, 1, "two ")
+        # deliver newest first: bob must hold it back until ch1 arrives
+        bob.apply_remote(ch2)
+        assert bob.text == "The Peritext editor"
+        bob.apply_remote(ch1)
+        assert bob.text == "two one The Peritext editor"
+        assert_view_consistent(bob)
+
+    def test_duplicate_delivery_is_idempotent(self):
+        _, alice, bob = make_pair()
+        ch = type_text(alice, 1, "x")
+        bob.apply_remote(ch)
+        bob.apply_remote(ch)
+        assert bob.text == "xThe Peritext editor"
+        assert_view_consistent(bob)
+
+    def test_disconnect_drops_sync(self):
+        _, alice, bob = make_pair()
+        alice.disconnect()
+        type_text(alice, 1, "offline ")
+        # queue still accumulates; manual sync after "reconnect" delivers
+        assert bob.text == "The Peritext editor"
+        alice.sync()
+        assert bob.text == "offline The Peritext editor"
+
+
+class TestRemoteHighlightHook:
+    def test_on_remote_patch_called(self):
+        pub = Publisher()
+        seen = []
+        alice = create_editor("alice", pub)
+        bob = create_editor(
+            "bob", pub, on_remote_patch=lambda ed, p: seen.append(p["action"])
+        )
+        initialize_docs([alice, bob])
+        type_text(alice, 1, "hi")
+        alice.sync()
+        assert "insert" in seen
+
+
+class TestFuzzBridge:
+    def test_random_editing_session_converges(self):
+        import random
+
+        rng = random.Random(42)
+        _, alice, bob = make_pair("seed text")
+        editors = [alice, bob]
+        for i in range(120):
+            ed = rng.choice(editors)
+            n = len(ed.view)
+            action = rng.randrange(4)
+            if action == 0 or n == 0:
+                pos = rng.randint(1, n + 1)
+                type_text(ed, pos, rng.choice("abcdefgh"))
+            elif action == 1 and n >= 1:
+                start = rng.randint(1, n)
+                end = min(n + 1, start + rng.randint(1, 3))
+                delete_range(ed, start, end)
+            elif action == 2 and n >= 2:
+                start = rng.randint(1, n - 1)
+                end = rng.randint(start + 1, n)
+                toggle_bold(ed, start, end)
+            elif n >= 2:
+                start = rng.randint(1, n - 1)
+                end = rng.randint(start + 1, n)
+                toggle_italic(ed, start, end)
+            if i % 10 == 0:
+                alice.sync()
+                bob.sync()
+        alice.sync()
+        bob.sync()
+        assert alice.view == bob.view
+        assert_view_consistent(alice)
+        assert_view_consistent(bob)
