@@ -203,7 +203,12 @@ class Editor:
     # -- local edits (reference dispatchTransaction, src/bridge.ts:309-347) --
 
     def dispatch(self, txn: Transaction) -> Change:
-        change, patches = apply_transaction_to_doc(self.doc, txn)
+        return self.dispatch_input_ops(transaction_to_input_ops(txn))
+
+    def dispatch_input_ops(self, input_ops: List[InputOperation]) -> Change:
+        """Apply raw input operations locally (the playback interpreter drives
+        editors this way, reference ``executeTraceEvent`` src/playback.ts:102-115)."""
+        change, patches = self.doc.change(input_ops)
         for patch in patches:
             for step in patch_to_steps(patch):
                 step.apply(self.view)

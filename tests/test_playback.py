@@ -1,0 +1,143 @@
+"""Trace playback + comment model tests (reference ``src/playback.ts``,
+``src/comment.ts``) and demo smoke runs."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from peritext_tpu.bridge import create_editor, editor_doc_from_crdt
+from peritext_tpu.bridge.playback import (
+    endless_loop,
+    execute_trace_event,
+    play_trace,
+    simulate_typing_for_input_op,
+    trace_from_spec,
+)
+from peritext_tpu.core.comment import (
+    Comment,
+    get_comment,
+    list_comments,
+    put_comment,
+    remove_comment,
+)
+from peritext_tpu.core.doc import Doc
+from peritext_tpu.core.types import span
+from peritext_tpu.parallel.pubsub import Publisher
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def make_editors():
+    pub = Publisher()
+    return {name: create_editor(name, pub) for name in ("alice", "bob")}
+
+
+class TestSimulateTyping:
+    def test_insert_expands_per_keystroke(self):
+        events = simulate_typing_for_input_op(
+            "alice", {"action": "insert", "index": 3, "values": list("hi!")}
+        )
+        assert [(e["index"], e["values"]) for e in events] == [
+            (3, ["h"]),
+            (4, ["i"]),
+            (5, ["!"]),
+        ]
+        assert all(e["editorId"] == "alice" and e["delay"] > 0 for e in events)
+
+    def test_non_insert_passthrough(self):
+        events = simulate_typing_for_input_op(
+            "bob", {"action": "addMark", "startIndex": 0, "endIndex": 2, "markType": "em"}
+        )
+        assert len(events) == 1 and events[0]["action"] == "addMark"
+
+
+class TestTracePlayback:
+    def test_trace_from_spec_plays_to_expected_result(self):
+        # The reference's built-in demo trace spec (src/playback.ts:53-80):
+        # concurrent bold over [0,12) and em over [4,19) on the seed text.
+        trace = trace_from_spec(
+            {
+                "initialText": "The Peritext editor",
+                "inputOps1": [
+                    {"action": "addMark", "startIndex": 0, "endIndex": 12, "markType": "strong"}
+                ],
+                "inputOps2": [
+                    {"action": "addMark", "startIndex": 4, "endIndex": 19, "markType": "em"}
+                ],
+            }
+        )
+        editors = make_editors()
+        play_trace(trace, editors)
+        expected = [
+            span("The ", {"strong": {"active": True}}),
+            span("Peritext", {"strong": {"active": True}, "em": {"active": True}}),
+            span(" editor", {"em": {"active": True}}),
+        ]
+        for editor in editors.values():
+            assert editor.view.spans() == expected
+            assert editor.view == editor_doc_from_crdt(editor.doc)
+
+    def test_missing_editor_raises(self):
+        with pytest.raises(KeyError):
+            execute_trace_event(
+                {"editorId": "ghost", "action": "insert", "path": ["text"],
+                 "index": 0, "values": ["x"]},
+                make_editors(),
+            )
+
+    def test_sync_hook_called_and_restart_noop(self):
+        calls = []
+        editors = make_editors()
+        play_trace(
+            [{"action": "restart"}, {"action": "sync"}],
+            editors,
+            on_sync=lambda: calls.append(1),
+        )
+        assert calls == [1]
+
+    def test_endless_loop_cycles(self):
+        gen = endless_loop([{"action": "restart"}, {"action": "sync"}])
+        kinds = [next(gen)["action"] for _ in range(5)]
+        assert kinds == ["restart", "sync", "restart", "sync", "restart"]
+
+
+class TestCommentModel:
+    def test_put_get_list_remove(self):
+        doc = Doc("alice")
+        put_comment(doc, Comment(id="c1", actor="alice", content="first!"))
+        put_comment(doc, Comment(id="c0", actor="bob", content="second"))
+        assert get_comment(doc, "c1") == Comment("c1", "alice", "first!")
+        assert [c.id for c in list_comments(doc)] == ["c0", "c1"]
+        remove_comment(doc, "c1")
+        assert get_comment(doc, "c1") is None
+        assert [c.id for c in list_comments(doc)] == ["c0"]
+
+    def test_comments_replicate(self):
+        alice, bob = Doc("alice"), Doc("bob")
+        ch1, _ = put_comment(alice, Comment(id="c1", actor="alice", content="hello"))
+        bob.apply_change(ch1)
+        assert get_comment(bob, "c1") == Comment("c1", "alice", "hello")
+        # concurrent field edit converges by op-id LWW
+        ch2, _ = put_comment(alice, Comment(id="c1", actor="alice", content="edited"))
+        ch3, _ = bob.change(
+            [{"path": ["comments", "c1"], "action": "set", "key": "content", "value": "bobbed"}]
+        )
+        alice.apply_change(ch3)
+        bob.apply_change(ch2)
+        assert get_comment(alice, "c1") == get_comment(bob, "c1")
+
+
+class TestDemoScripts:
+    @pytest.mark.parametrize("script", ["demos/two_editors.py", "demos/essay_demo.py"])
+    def test_demo_runs_clean(self, script):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / script)],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "converged" in proc.stdout
