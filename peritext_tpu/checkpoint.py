@@ -1,0 +1,211 @@
+"""Checkpoint / resume.
+
+The reference has no checkpointing; its durable state is the per-actor change
+logs themselves — any replica is reconstructible by replaying changes (event
+sourcing; reference ``queues`` test/fuzz.ts:160-163, failed-state traces
+test/fuzz.ts:16-20).  This module makes that durability real and adds a fast
+path for the device state:
+
+* **Change-log persistence** — the source of truth.  A :class:`ChangeStore`
+  round-trips through JSON-lines in the reference's exact change wire format,
+  so checkpoints interoperate with recorded reference traces.
+* **Replica restore by replay** — rebuild any ``Doc`` from the log.
+* **Packed-state snapshots** — the batched device state (``PackedDocs``) is a
+  NamedTuple of int tensors; it serializes to one ``.npz``.  Restoring a
+  snapshot skips replaying history for long-lived batches; the change log
+  still guards against snapshot loss.
+* **CheckpointManager** — step-tagged checkpoint directories with atomic
+  publish (write to temp, rename) and retention, so a long streaming run can
+  resume after a failure (SURVEY §5.4 *Build* item).
+
+Failed fuzz states serialize via :func:`save_failed_trace` in the same
+queues-plus-evidence shape the reference writes to ``traces/*.json``
+(test/fuzz.ts:16-20), replayable by ``testing/traces.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .core.doc import Doc
+from .core.types import Change
+from .ops.packed import PackedDocs
+from .parallel.anti_entropy import ChangeStore, apply_changes
+from .parallel.causal import causal_sort
+
+# ---------------------------------------------------------------------------
+# Change-log persistence (the durable source of truth)
+# ---------------------------------------------------------------------------
+
+
+def save_change_log(store: ChangeStore, path: str | Path) -> int:
+    """Write every change as one JSON line (wire format); returns the count."""
+    path = Path(path)
+    count = 0
+    with open(path, "w") as f:
+        for actor in sorted(store.actors()):
+            for change in store.log(actor):
+                f.write(json.dumps(change.to_json()) + "\n")
+                count += 1
+    return count
+
+
+def load_change_log(path: str | Path) -> ChangeStore:
+    store = ChangeStore()
+    by_actor: Dict[str, List[Change]] = {}
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                change = Change.from_json(json.loads(line))
+                by_actor.setdefault(change.actor, []).append(change)
+    # logs must append in seq order regardless of file order
+    for changes in by_actor.values():
+        for change in sorted(changes, key=lambda c: c.seq):
+            store.append(change)
+    return store
+
+
+def doc_from_store(store: ChangeStore, actor_id: str = "restored") -> Doc:
+    """Rebuild a replica by replaying the full log (event-sourcing restore)."""
+    doc = Doc(actor_id)
+    changes = [ch for actor in store.actors() for ch in store.log(actor)]
+    apply_changes(doc, changes)
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# Packed device-state snapshots
+# ---------------------------------------------------------------------------
+
+
+def save_packed(state: PackedDocs, path: str | Path) -> None:
+    """Snapshot the batched device state to one ``.npz`` (host transfer of
+    every field, then a single file write)."""
+    arrays = {name: np.asarray(x) for name, x in zip(PackedDocs._fields, state)}
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **arrays)
+
+
+def load_packed(path: str | Path) -> PackedDocs:
+    with np.load(path) as data:
+        return PackedDocs(*(data[name] for name in PackedDocs._fields))
+
+
+# ---------------------------------------------------------------------------
+# Step-tagged checkpoints with atomic publish + retention
+# ---------------------------------------------------------------------------
+
+_STEP_PREFIX = "step_"
+
+
+@dataclass
+class Checkpoint:
+    step: int
+    directory: Path
+    meta: Dict[str, Any]
+
+    @property
+    def store(self) -> ChangeStore:
+        return load_change_log(self.directory / "changes.jsonl")
+
+    @property
+    def packed(self) -> Optional[PackedDocs]:
+        path = self.directory / "packed.npz"
+        return load_packed(path) if path.exists() else None
+
+
+class CheckpointManager:
+    """Directory of step-tagged checkpoints.
+
+    Each checkpoint is staged in a temp dir and published with an atomic
+    rename, so a crash mid-save never corrupts the latest good checkpoint.
+    ``keep`` bounds how many checkpoints are retained (oldest pruned).
+    """
+
+    def __init__(self, root: str | Path, keep: int = 3) -> None:
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.keep = keep
+
+    def save(
+        self,
+        step: int,
+        store: Optional[ChangeStore] = None,
+        packed: Optional[PackedDocs] = None,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> Path:
+        if store is None and packed is None:
+            raise ValueError("nothing to checkpoint: need a store and/or packed state")
+        final = self.root / f"{_STEP_PREFIX}{step:012d}"
+        staging = Path(tempfile.mkdtemp(prefix=".staging_", dir=self.root))
+        try:
+            payload_meta = dict(meta or {})
+            payload_meta["step"] = step
+            if store is not None:
+                payload_meta["changes"] = save_change_log(store, staging / "changes.jsonl")
+            if packed is not None:
+                save_packed(packed, staging / "packed.npz")
+                payload_meta["num_docs"] = int(packed.num_docs)
+            (staging / "meta.json").write_text(json.dumps(payload_meta, indent=2))
+            if final.exists():
+                shutil.rmtree(final)
+            os.rename(staging, final)
+        except BaseException:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
+        self._prune()
+        return final
+
+    def steps(self) -> List[int]:
+        return sorted(
+            int(p.name[len(_STEP_PREFIX):])
+            for p in self.root.iterdir()
+            if p.is_dir() and p.name.startswith(_STEP_PREFIX)
+        )
+
+    def latest(self) -> Optional[Checkpoint]:
+        steps = self.steps()
+        return self.restore(steps[-1]) if steps else None
+
+    def restore(self, step: int) -> Checkpoint:
+        directory = self.root / f"{_STEP_PREFIX}{step:012d}"
+        meta = json.loads((directory / "meta.json").read_text())
+        return Checkpoint(step=step, directory=directory, meta=meta)
+
+    def _prune(self) -> None:
+        steps = self.steps()
+        for step in steps[: max(0, len(steps) - self.keep)]:
+            shutil.rmtree(self.root / f"{_STEP_PREFIX}{step:012d}")
+
+
+# ---------------------------------------------------------------------------
+# Failed-state traces (reference saveFailedTrace, test/fuzz.ts:16-20)
+# ---------------------------------------------------------------------------
+
+
+def save_failed_trace(
+    path: str | Path,
+    store: ChangeStore,
+    evidence: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Serialize a failing multi-replica state: replayable per-actor change
+    ``queues`` plus free-form divergence evidence.  The ``queues`` are ground
+    truth; evidence fields are diagnostics only (the reference's trace files
+    carry divergent final texts — SURVEY §2.15's oracle caution)."""
+    payload: Dict[str, Any] = {
+        "queues": {
+            actor: [ch.to_json() for ch in store.log(actor)]
+            for actor in sorted(store.actors())
+        }
+    }
+    if evidence:
+        payload.update(evidence)
+    Path(path).write_text(json.dumps(payload, indent=2))
