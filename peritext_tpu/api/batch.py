@@ -18,6 +18,7 @@ replaying all changes — the differential tests assert exactly that equality.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -25,6 +26,7 @@ import numpy as np
 
 from ..core.doc import Doc
 from ..core.types import Change, FormatSpan
+from ..observability import GLOBAL_COUNTERS, MergeStats
 from ..ops.decode import decode_doc_spans
 from ..ops.encode import EncodedBatch, encode_workloads
 from ..ops.kernel import apply_batch, apply_batch_jit, encoded_arrays_of
@@ -44,6 +46,8 @@ class MergeReport:
     fallback_docs: List[int] = field(default_factory=list)
     #: ops applied on device (excludes fallback docs)
     device_ops: int = 0
+    #: per-merge observability (stage timings, padding efficiency)
+    stats: MergeStats = field(default_factory=MergeStats)
 
 
 class DocBatch:
@@ -116,29 +120,59 @@ class DocBatch:
 
     def merge(self, workloads: Sequence[Workload]) -> MergeReport:
         """Converge every workload; returns per-doc formatted spans."""
+        stats = MergeStats(docs=len(workloads))
+        t0 = time.perf_counter()
         encoded = self.encode(workloads)
+        stats.encode_seconds = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
         state = self.apply_encoded(encoded)
+        np.asarray(state.num_slots)  # host sync: time the apply honestly
+        stats.apply_seconds = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
         resolved = self._resolve(state, self.comment_capacity)
         # One whole-array transfer per field, up front: decoding per doc on
         # the raw (possibly mesh-sharded) arrays would do 5 device gathers
         # per document.
         resolved = type(resolved)(*(np.asarray(x) for x in resolved))
+        stats.resolve_seconds = time.perf_counter() - t0
 
         overflow = np.asarray(resolved.overflow)
         fallback = set(encoded.fallback_docs) | {
             int(d) for d in np.nonzero(overflow)[0] if d < len(workloads)
         }
 
+        t0 = time.perf_counter()
         spans: List[List[FormatSpan]] = []
         device_ops = 0
+        fallback_ops = 0
         for d, workload in enumerate(workloads):
             if d in fallback:
                 spans.append(_oracle_spans(workload))
+                fallback_ops += int(encoded.num_ops[d])
             else:
                 spans.append(decode_doc_spans(resolved, d, encoded.attr_tables[d]))
                 device_ops += int(encoded.num_ops[d])
+        stats.decode_seconds = time.perf_counter() - t0
+
+        stream_capacity = encoded.num_docs * (
+            encoded.ins_op.shape[1]
+            + encoded.del_target.shape[1]
+            + next(iter(encoded.marks.values())).shape[1]
+        )
+        stats.device_ops = device_ops
+        stats.fallback_ops = fallback_ops
+        stats.fallback_docs = len(fallback)
+        stats.device_docs = len(workloads) - len(fallback)
+        stats.padding_efficiency = (
+            float(encoded.num_ops.sum()) / stream_capacity if stream_capacity else 0.0
+        )
+        GLOBAL_COUNTERS.add("merge.calls")
+        GLOBAL_COUNTERS.add("merge.device_ops", device_ops)
+        GLOBAL_COUNTERS.add("merge.fallback_docs", len(fallback))
         return MergeReport(
-            spans=spans, fallback_docs=sorted(fallback), device_ops=device_ops
+            spans=spans, fallback_docs=sorted(fallback), device_ops=device_ops, stats=stats
         )
 
 

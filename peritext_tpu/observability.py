@@ -1,0 +1,184 @@
+"""Metrics, structured event logging, and profiling hooks.
+
+The reference's observability is ``console.log`` plus demo DOM panels (SURVEY
+§5.5); this module supplies the framework-grade replacements it calls for:
+
+* :class:`Counters` — process-local counters/timers for the north-star
+  metrics (ops applied per second per chip, convergence wall-clock, padding
+  efficiency of the static-shape batches).
+* :class:`EventLog` — structured, append-only JSON-lines event stream
+  (replaces the reference's DOM change log, ``outputDebugForChange``
+  src/bridge.ts:235-242); works as an ``Editor.on_event`` sink and a general
+  framework event bus.
+* :func:`profile_trace` — context manager around ``jax.profiler`` traces for
+  TensorBoard/Perfetto viewing; no-ops cleanly when profiling is unavailable
+  so library code can call it unconditionally.
+* :class:`MergeStats` — per-merge report: device vs fallback op counts,
+  stage wall-clocks, and padding efficiency (the fraction of padded device
+  work that was real), attached to ``DocBatch.merge`` results.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, IO, Iterator, Optional
+
+
+class Counters:
+    """Thread-safe named counters and accumulated timings."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Dict[str, float] = defaultdict(float)
+
+    def add(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counts[name] += value
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._counts.get(name, 0.0)
+
+    @contextlib.contextmanager
+    def timed(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - start)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+#: Default process-wide counters.
+GLOBAL_COUNTERS = Counters()
+
+
+class EventLog:
+    """Append-only structured event stream.
+
+    Events are plain dicts with a ``kind``; every record gets a monotonic
+    sequence number and a wall-clock timestamp.  Optionally tees each record
+    to a JSON-lines file.  Usable directly as an ``Editor.on_event`` sink.
+    """
+
+    def __init__(self, path: Optional[str | Path] = None, capacity: Optional[int] = 10000):
+        self._lock = threading.Lock()
+        self._events: list = []
+        self._seq = 0
+        self.capacity = capacity
+        self._file: Optional[IO[str]] = open(path, "a") if path is not None else None
+
+    def emit(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        record = {"seq": None, "ts": time.time(), "kind": kind, **fields}
+        with self._lock:
+            self._seq += 1
+            record["seq"] = self._seq
+            self._events.append(record)
+            if self.capacity is not None and len(self._events) > self.capacity:
+                self._events = self._events[-self.capacity :]
+            if self._file is not None:
+                self._file.write(json.dumps(record, default=str) + "\n")
+                self._file.flush()
+        return record
+
+    # Editor.on_event sink (bridge.EditorEvent)
+    def __call__(self, editor_event) -> None:
+        self.emit(
+            f"editor.{editor_event.kind}", actor=editor_event.actor, **editor_event.detail
+        )
+
+    def events(self, kind: Optional[str] = None) -> list:
+        with self._lock:
+            evs = list(self._events)
+        return [e for e in evs if kind is None or e["kind"] == kind] if kind else evs
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str | Path, enabled: bool = True) -> Iterator[None]:
+    """Capture a JAX profiler trace (viewable in TensorBoard / Perfetto) for
+    the enclosed block.  Silently degrades to a no-op if the profiler is
+    unavailable on the current platform."""
+    if not enabled:
+        yield
+        return
+    try:
+        import jax
+
+        jax.profiler.start_trace(str(log_dir))
+        started = True
+    except Exception:
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+@dataclass
+class MergeStats:
+    """Per-merge observability (attached to ``api.batch.MergeReport``)."""
+
+    docs: int = 0
+    device_docs: int = 0
+    fallback_docs: int = 0
+    device_ops: int = 0
+    fallback_ops: int = 0
+    encode_seconds: float = 0.0
+    apply_seconds: float = 0.0
+    resolve_seconds: float = 0.0
+    decode_seconds: float = 0.0
+    #: real ops / padded op-stream capacity across the batch (0..1)
+    padding_efficiency: float = 0.0
+    extras: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def total_seconds(self) -> float:
+        return (
+            self.encode_seconds
+            + self.apply_seconds
+            + self.resolve_seconds
+            + self.decode_seconds
+        )
+
+    @property
+    def device_ops_per_sec(self) -> float:
+        wall = self.apply_seconds
+        return self.device_ops / wall if wall > 0 else 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "docs": self.docs,
+            "device_docs": self.device_docs,
+            "fallback_docs": self.fallback_docs,
+            "device_ops": self.device_ops,
+            "fallback_ops": self.fallback_ops,
+            "encode_seconds": round(self.encode_seconds, 6),
+            "apply_seconds": round(self.apply_seconds, 6),
+            "resolve_seconds": round(self.resolve_seconds, 6),
+            "decode_seconds": round(self.decode_seconds, 6),
+            "padding_efficiency": round(self.padding_efficiency, 4),
+            "device_ops_per_sec": round(self.device_ops_per_sec, 1),
+            **self.extras,
+        }

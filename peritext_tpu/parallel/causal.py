@@ -27,12 +27,16 @@ def _admissible(change: Change, clock: Clock) -> bool:
     return all(clock.get(actor, 0) >= dep for actor, dep in (change.deps or {}).items())
 
 
-def causal_sort(
+def causal_schedule(
     changes: Iterable[Change], base_clock: Optional[Clock] = None
-) -> List[Change]:
-    """Order changes so every change's deps precede it.  Deterministic:
-    among ready changes, smallest (actor, seq) first.  Raises if the set has a
-    causal gap relative to ``base_clock``."""
+) -> Tuple[List[Change], List[Change]]:
+    """Schedule as many changes as causally possible.
+
+    Returns ``(ordered, stuck)``: ``ordered`` is a deterministic admissible
+    order (smallest (actor, seq) among ready first); ``stuck`` are changes
+    whose dependencies are absent from the set (e.g. lost in transit) —
+    callers under faulty delivery leave them for the next anti-entropy round.
+    """
     clock: Clock = dict(base_clock or {})
     pending: Dict[Tuple[str, int], Change] = {}
     for ch in changes:
@@ -70,10 +74,21 @@ def causal_sort(
             if cand is not None and _admissible(cand, clock):
                 heapq.heappush(ready, waiter)
 
-    if pending:
-        missing = sorted(pending.keys())[:5]
+    stuck = [pending[k] for k in sorted(pending.keys())]
+    return out, stuck
+
+
+def causal_sort(
+    changes: Iterable[Change], base_clock: Optional[Clock] = None
+) -> List[Change]:
+    """Order changes so every change's deps precede it; raises if the set has
+    a causal gap relative to ``base_clock`` (strict variant of
+    :func:`causal_schedule`)."""
+    ordered, stuck = causal_schedule(changes, base_clock)
+    if stuck:
+        missing = sorted((c.actor, c.seq) for c in stuck)[:5]
         raise PeritextError(f"Causal gap: cannot schedule changes {missing}")
-    return out
+    return ordered
 
 
 def causal_waves(

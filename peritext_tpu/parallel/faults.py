@@ -1,0 +1,103 @@
+"""Delivery fault injection (SURVEY §5.3 *Build* item).
+
+The reference's only "fault tolerance" artifacts are the causal retry loop
+(test/merge.ts:4-23) and manually dropping the sync timer (src/index.ts:117).
+This module injects the full space of delivery faults the replication layer
+must survive:
+
+* **reorder** — arbitrary permutation of a delivery batch (the causal layer
+  must hold back / resequence);
+* **duplication** — redelivered changes must be idempotent;
+* **drop** — lost changes must be repaired by a later anti-entropy round
+  (vector-clock diffs re-ship anything missing, so drops delay but never
+  prevent convergence).
+
+Two entry points: :func:`perturb_delivery` for harnesses that move changes by
+hand (the fuzzer's sync step), and :class:`FaultyPublisher`, a drop-in
+``Publisher`` that applies per-subscriber faults and records what it lost so
+tests can assert repair actually happened.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from ..core.types import Change
+from .pubsub import Publisher
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """Probabilities for one delivery hop."""
+
+    drop_p: float = 0.0
+    dup_p: float = 0.0
+    reorder: bool = True
+
+    def any_faults(self) -> bool:
+        return self.drop_p > 0 or self.dup_p > 0 or self.reorder
+
+
+def perturb_delivery(
+    changes: List[Change], rng: random.Random, spec: FaultSpec
+) -> List[Change]:
+    """Apply drop / duplicate / reorder faults to one delivery batch.
+
+    Returns the perturbed batch; dropped changes are simply absent (the
+    caller's next anti-entropy round will re-ship them)."""
+    delivered: List[Change] = []
+    for change in changes:
+        if rng.random() < spec.drop_p:
+            continue
+        delivered.append(change)
+        while rng.random() < spec.dup_p:
+            delivered.append(change)
+    if spec.reorder:
+        rng.shuffle(delivered)
+    return delivered
+
+
+class FaultyPublisher(Publisher):
+    """A ``Publisher`` whose deliveries suffer per-subscriber faults.
+
+    Dropped updates are recorded per subscriber; :meth:`redeliver_lost`
+    models the transport-level retransmission that a real deployment gets
+    from anti-entropy, letting tests assert convergence-after-repair.
+    """
+
+    def __init__(self, spec: FaultSpec, seed: int = 0) -> None:
+        super().__init__()
+        self.spec = spec
+        self.rng = random.Random(seed)
+        self.lost: Dict[str, List[List[Change]]] = {}
+        self.delivered_count = 0
+        self.dropped_count = 0
+
+    def publish(self, sender: str, update: List[Change]) -> None:
+        for key, callback in list(self._subscribers.items()):
+            if key == sender:
+                continue
+            perturbed = perturb_delivery(list(update), self.rng, self.spec)
+            dropped = [c for c in update if c not in perturbed]
+            if dropped:
+                self.lost.setdefault(key, []).append(dropped)
+                self.dropped_count += len(dropped)
+            self.delivered_count += len(perturbed)
+            if perturbed:
+                callback(perturbed)
+
+    def redeliver_lost(self) -> int:
+        """Re-deliver every recorded drop (faithfully, no new faults);
+        returns how many changes were retransmitted."""
+        count = 0
+        for key, batches in list(self.lost.items()):
+            callback = self._subscribers.get(key)
+            if callback is None:
+                continue
+            for batch in batches:
+                callback(list(batch))
+                count += len(batch)
+            self.lost[key] = []
+        return count

@@ -33,6 +33,8 @@ from typing import Dict, List, Optional
 from ..core.doc import Doc
 from ..core.types import Change, InputOperation, Patch
 from ..parallel.anti_entropy import ChangeStore, apply_changes
+from ..parallel.causal import causal_schedule
+from ..parallel.faults import FaultSpec, perturb_delivery
 from .accumulate import accumulate_patches
 from .generate import generate_docs
 
@@ -102,9 +104,18 @@ def random_input_op(state: FuzzState, doc: Doc) -> Optional[InputOperation]:
     return op
 
 
-def fuzz_step(state: FuzzState, check: bool = True) -> None:
+def fuzz_step(
+    state: FuzzState, check: bool = True, faults: Optional[FaultSpec] = None
+) -> None:
     """One fuzz iteration: a random edit on a random replica, then a random
-    pairwise sync with convergence checks."""
+    pairwise sync with convergence checks.
+
+    With ``faults``, each delivery hop suffers drop/dup/reorder faults
+    (SURVEY §5.3): changes lost in transit stay in the store and are re-shipped
+    by a later round's vector-clock diff, so convergence is delayed, never
+    lost.  Cross-replica convergence is asserted only for clean (lossless)
+    syncs; the per-replica patch/batch oracle must hold regardless.
+    """
     rng = state.rng
     target = rng.randrange(len(state.docs))
     doc = state.docs[target]
@@ -122,20 +133,32 @@ def fuzz_step(state: FuzzState, check: bool = True) -> None:
         return
     state.syncs += 1
 
+    clean = True
     for src, dst in ((left, right), (right, left)):
         missing = state.store.missing_changes(
             state.docs[src].clock, state.docs[dst].clock
         )
-        rng.shuffle(missing)  # delivery order must not matter
-        state.patch_lists[dst].extend(apply_changes(state.docs[dst], missing))
+        if faults is not None and faults.any_faults():
+            delivered = perturb_delivery(missing, rng, faults)
+            ordered, stuck = causal_schedule(delivered, state.docs[dst].clock)
+            for ch in ordered:
+                state.patch_lists[dst].extend(state.docs[dst].apply_change(ch))
+            if len(ordered) < len(missing) or stuck:
+                clean = False  # losses repair on a later anti-entropy round
+        else:
+            rng.shuffle(missing)  # delivery order must not matter
+            state.patch_lists[dst].extend(apply_changes(state.docs[dst], missing))
 
     if check:
-        left_spans = state.docs[left].get_text_with_formatting(["text"])
-        right_spans = state.docs[right].get_text_with_formatting(["text"])
-        assert left_spans == right_spans, (
-            f"replica divergence after sync #{state.syncs}:\n{left_spans}\n{right_spans}"
-        )
-        assert state.docs[left].clock == state.docs[right].clock
+        if clean:
+            left_spans = state.docs[left].get_text_with_formatting(["text"])
+            right_spans = state.docs[right].get_text_with_formatting(["text"])
+            assert left_spans == right_spans, (
+                f"replica divergence after sync #{state.syncs}:\n{left_spans}\n{right_spans}"
+            )
+            assert state.docs[left].clock == state.docs[right].clock
+        # The incremental-vs-batch oracle holds on every replica even when a
+        # faulty sync left the pair divergent.
         for idx in (left, right):
             acc = accumulate_patches(state.patch_lists[idx])
             batch = state.docs[idx].get_text_with_formatting(["text"])
@@ -145,10 +168,25 @@ def fuzz_step(state: FuzzState, check: bool = True) -> None:
             )
 
 
-def run_fuzz(seed: int, iterations: int, num_replicas: int = 3, check: bool = True) -> FuzzState:
+def full_sync(state: FuzzState) -> None:
+    """Bring every replica to the store's global frontier with clean
+    (fault-free) delivery — the repair round that ends a faulty session."""
+    frontier = state.store.clock()
+    for idx, doc in enumerate(state.docs):
+        missing = state.store.missing_changes(frontier, doc.clock)
+        state.patch_lists[idx].extend(apply_changes(doc, missing))
+
+
+def run_fuzz(
+    seed: int,
+    iterations: int,
+    num_replicas: int = 3,
+    check: bool = True,
+    faults: Optional[FaultSpec] = None,
+) -> FuzzState:
     state = make_fuzz_state(seed, num_replicas)
     for _ in range(iterations):
-        fuzz_step(state, check=check)
+        fuzz_step(state, check=check, faults=faults)
     return state
 
 
