@@ -126,9 +126,92 @@ def run(args) -> dict:
     }
 
 
+def run_streaming(args) -> dict:
+    """BASELINE config 5: multi-round streaming merge on carried device state."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    from peritext_tpu.parallel.streaming import StreamingMerge
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    d, rounds = args.docs, args.rounds
+    gen_start = time.perf_counter()
+    workloads = generate_workload(seed=args.seed, num_docs=d, ops_per_doc=args.ops_per_doc)
+    gen_time = time.perf_counter() - gen_start
+
+    import random
+
+    rng = random.Random(args.seed)
+    arrival = []
+    for w in workloads:
+        changes = [ch for log in w.values() for ch in log]
+        rng.shuffle(changes)
+        size = -(-len(changes) // rounds)
+        arrival.append([changes[i : i + size] for i in range(0, len(changes), size)])
+
+    def session():
+        return StreamingMerge(
+            num_docs=d,
+            actors=("doc1", "doc2", "doc3"),
+            slot_capacity=args.slots,
+            mark_capacity=args.marks,
+            tomb_capacity=args.slots,
+            round_insert_capacity=256,
+            round_delete_capacity=128,
+            round_mark_capacity=128,
+        )
+
+    # warmup compile
+    s = session()
+    for r in range(rounds):
+        for doc, batches in enumerate(arrival):
+            if r < len(batches):
+                s.ingest(doc, batches[r])
+        s.drain()
+    digest0 = s.digest()
+
+    t0 = time.perf_counter()
+    s = session()
+    for r in range(rounds):
+        for doc, batches in enumerate(arrival):
+            if r < len(batches):
+                s.ingest(doc, batches[r])
+        s.drain()
+    digest = s.digest()  # sync point
+    elapsed = time.perf_counter() - t0
+    assert digest == digest0
+
+    total_ops = sum(
+        len(ch.ops) for w in workloads for log in w.values() for ch in log
+    )
+    baseline = measure_scalar_baseline()
+    value = total_ops / elapsed
+    return {
+        "metric": "streaming_crdt_ops_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(value / baseline, 2),
+        "baseline_ops_per_sec": round(baseline, 1),
+        "docs": d,
+        "rounds": rounds,
+        "ops_per_doc": args.ops_per_doc,
+        "workload_gen_seconds": round(gen_time, 1),
+        "wall_seconds": round(elapsed, 3),
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--smoke", action="store_true", help="small fast config")
+    parser.add_argument(
+        "--mode",
+        choices=("batch", "streaming"),
+        default="batch",
+        help="batch = one-shot converge (configs 2-4); streaming = config 5",
+    )
+    parser.add_argument("--rounds", type=int, default=4, help="streaming arrival rounds")
     parser.add_argument("--docs", type=int, default=None)
     parser.add_argument("--ops-per-doc", type=int, default=None)
     parser.add_argument("--slots", type=int, default=None)
@@ -140,13 +223,16 @@ def main() -> None:
     )
     args = parser.parse_args()
 
-    defaults = (64, 128, 192, 64) if args.smoke else (8192, 256, 384, 96)
+    if args.mode == "streaming":
+        defaults = (64, 96, 256, 64) if args.smoke else (2048, 192, 384, 96)
+    else:
+        defaults = (64, 128, 192, 64) if args.smoke else (8192, 256, 384, 96)
     args.docs = args.docs or defaults[0]
     args.ops_per_doc = args.ops_per_doc or defaults[1]
     args.slots = args.slots or defaults[2]
     args.marks = args.marks or defaults[3]
 
-    result = run(args)
+    result = run_streaming(args) if args.mode == "streaming" else run(args)
     print(json.dumps(result))
 
 

@@ -109,11 +109,17 @@ def _pack_boundary(b: Boundary, actors: OrderedActorTable) -> Tuple[int, int]:
     return _BK[b.kind], 0
 
 
-def encode_doc(changes: Sequence[Change], actors: OrderedActorTable, attrs: Interner):
+def encode_doc(
+    changes: Sequence[Change],
+    actors: OrderedActorTable,
+    attrs: Interner,
+    text_obj=None,
+):
     """Split one document's causally-sorted changes into three streams.
-    Returns (_DocStreams, ok); ok=False -> host fallback."""
+    Returns (_DocStreams, ok, text_obj); ok=False -> host fallback.
+    ``text_obj`` (the op id of the document's text list) carries across
+    incremental rounds for streaming sessions."""
     streams = _DocStreams()
-    text_obj = None
 
     for change in changes:
         for op in change.ops:
@@ -121,7 +127,7 @@ def encode_doc(changes: Sequence[Change], actors: OrderedActorTable, attrs: Inte
                 text_obj = op.opid
                 continue
             if op.obj != text_obj:
-                return streams, False
+                return streams, False, text_obj
             if op.action == "set" and op.insert:
                 ref = 0 if op.elem_id is HEAD else _pack_opid(op.elem_id, actors)
                 streams.ins.append((ref, _pack_opid(op.opid, actors), ord(op.value)))
@@ -150,8 +156,41 @@ def encode_doc(changes: Sequence[Change], actors: OrderedActorTable, attrs: Inte
                     )
                 )
             else:
-                return streams, False  # makeMap / map ops: host fallback
-    return streams, True
+                return streams, False, text_obj  # makeMap / map ops: host fallback
+    return streams, True, text_obj
+
+
+class DocEncoder:
+    """Persistent per-document encoder for incremental (streaming) rounds.
+
+    The actor table must be declared up front: packed int32 op-ID comparison
+    equals (counter, actor-string) order only when actor indices follow string
+    order, and a table that grows mid-session could violate that
+    (utils/interning.OrderedActorTable).  A change from an undeclared actor
+    marks the encoder failed; the streaming layer then falls back to scalar
+    replay for that document.
+    """
+
+    def __init__(self, actor_names) -> None:
+        self.actors = OrderedActorTable(actor_names)
+        self.attrs = Interner()
+        self.text_obj = None
+        self.ok = len(self.actors) - 1 <= MAX_ACTORS
+
+    def encode_increment(self, ordered_changes: Sequence[Change]):
+        """Encode one round's causally-ordered new changes.  Returns
+        (_DocStreams, ok); once not ok, the encoder stays failed."""
+        if not self.ok:
+            return _DocStreams(), False
+        try:
+            streams, ok, self.text_obj = encode_doc(
+                ordered_changes, self.actors, self.attrs, self.text_obj
+            )
+        except (OverflowError, KeyError):  # ctr overflow / undeclared actor
+            ok = False
+            streams = _DocStreams()
+        self.ok = ok
+        return streams, ok
 
 
 def _round8(n: int) -> int:
@@ -184,7 +223,7 @@ def encode_workloads(
         streams = _DocStreams()
         if ok:
             try:
-                streams, ok = encode_doc(ordered, actors, attrs)
+                streams, ok, _ = encode_doc(ordered, actors, attrs)
             except OverflowError:
                 ok = False
         if not ok:
@@ -194,6 +233,29 @@ def encode_workloads(
         actor_tables.append(actors)
         attr_tables.append(attrs)
 
+    return pad_doc_streams(
+        per_doc,
+        fallback,
+        actor_tables,
+        attr_tables,
+        insert_capacity=insert_capacity,
+        delete_capacity=delete_capacity,
+        mark_capacity=mark_capacity,
+    )
+
+
+def pad_doc_streams(
+    per_doc: Sequence[_DocStreams],
+    fallback: List[int],
+    actor_tables: List[OrderedActorTable],
+    attr_tables: List[Interner],
+    insert_capacity: Optional[int] = None,
+    delete_capacity: Optional[int] = None,
+    mark_capacity: Optional[int] = None,
+) -> EncodedBatch:
+    """Pad per-doc split streams into dense (D, K) arrays.  Docs exceeding a
+    fixed capacity are appended to ``fallback`` (shape buckets are static so
+    XLA compiles once per bucket)."""
     d = len(per_doc)
     ki = insert_capacity or _round8(max((len(s.ins) for s in per_doc), default=0))
     kd = delete_capacity or _round8(max((len(s.dels) for s in per_doc), default=0))

@@ -1,0 +1,137 @@
+"""Streaming merge tests (BASELINE config 5): incremental rounds on carried
+device state must equal one-shot oracle replay; static round widths defer
+excess; fallbacks replay; sharded sessions agree via the digest collective."""
+
+import random
+
+import numpy as np
+import pytest
+
+from peritext_tpu.api.batch import oracle_merge
+from peritext_tpu.parallel.mesh import make_mesh
+from peritext_tpu.parallel.streaming import StreamingMerge, rebalance
+from peritext_tpu.testing.fuzz import generate_workload
+
+ACTORS = ("doc1", "doc2", "doc3")
+
+
+def interleave_rounds(workload, rounds, rng):
+    """Split one doc's change logs into `rounds` arrival batches (shuffled
+    within a batch — delivery order must not matter)."""
+    changes = [ch for log in workload.values() for ch in log]
+    rng.shuffle(changes)
+    size = -(-len(changes) // rounds)
+    return [changes[i : i + size] for i in range(0, len(changes), size)]
+
+
+class TestIncrementalEqualsOracle:
+    @pytest.mark.parametrize("rounds", [1, 4])
+    def test_multi_round_convergence(self, rounds):
+        rng = random.Random(0)
+        workloads = generate_workload(seed=31, num_docs=8, ops_per_doc=40)
+        session = StreamingMerge(
+            num_docs=8,
+            actors=ACTORS,
+            round_insert_capacity=256,
+            round_delete_capacity=128,
+            round_mark_capacity=128,
+        )
+        arrival = [interleave_rounds(w, rounds, rng) for w in workloads]
+        for r in range(rounds):
+            for d, batches in enumerate(arrival):
+                if r < len(batches):
+                    session.ingest(d, batches[r])
+            session.drain()
+        assert session.pending_count() == 0
+        assert session.read_all() == oracle_merge(workloads)
+
+    def test_tiny_round_widths_defer_and_still_converge(self):
+        rng = random.Random(1)
+        workloads = generate_workload(seed=7, num_docs=4, ops_per_doc=30)
+        session = StreamingMerge(
+            num_docs=4,
+            actors=ACTORS,
+            round_insert_capacity=8,
+            round_delete_capacity=8,
+            round_mark_capacity=8,
+        )
+        for d, w in enumerate(workloads):
+            batches = interleave_rounds(w, 1, rng)
+            session.ingest(d, batches[0])
+        rounds = session.drain()
+        assert rounds > 1  # the narrow widths forced multiple rounds
+        assert session.read_all() == oracle_merge(workloads)
+
+    def test_duplicate_ingestion_idempotent(self):
+        rng = random.Random(2)
+        workloads = generate_workload(seed=3, num_docs=2, ops_per_doc=25)
+        session = StreamingMerge(num_docs=2, actors=ACTORS)
+        for d, w in enumerate(workloads):
+            changes = [ch for log in w.values() for ch in log]
+            session.ingest(d, changes)
+            session.ingest(d, list(changes))  # full duplicate delivery
+        session.drain()
+        assert session.read_all() == oracle_merge(workloads)
+
+
+class TestFallbacks:
+    def test_undeclared_actor_falls_back_to_replay(self):
+        workloads = generate_workload(seed=5, num_docs=2, ops_per_doc=25)
+        session = StreamingMerge(num_docs=2, actors=("doc1",))  # missing doc2/3
+        for d, w in enumerate(workloads):
+            session.ingest(d, [ch for log in w.values() for ch in log])
+        session.drain()
+        assert all(s.fallback for s in session.docs)
+        assert session.read_all() == oracle_merge(workloads)
+
+    def test_device_overflow_falls_back_to_replay(self):
+        workloads = generate_workload(seed=6, num_docs=2, ops_per_doc=60)
+        session = StreamingMerge(
+            num_docs=2, actors=ACTORS, slot_capacity=16, tomb_capacity=8, mark_capacity=8
+        )
+        for d, w in enumerate(workloads):
+            session.ingest(d, [ch for log in w.values() for ch in log])
+        session.drain()
+        assert bool(np.asarray(session.state.overflow).any())
+        assert session.read_all() == oracle_merge(workloads)
+
+
+class TestShardedStreaming:
+    def test_mesh_session_matches_oracle_and_digest_agrees(self):
+        workloads = generate_workload(seed=8, num_docs=16, ops_per_doc=30)
+        mesh = make_mesh(8)
+        rng = random.Random(3)
+
+        def run_session(order_seed):
+            r = random.Random(order_seed)
+            s = StreamingMerge(num_docs=16, actors=ACTORS, mesh=mesh)
+            for d, w in enumerate(workloads):
+                batches = interleave_rounds(w, 3, r)
+                for b in batches:
+                    s.ingest(d, b)
+                    s.drain()
+            return s
+
+        s1, s2 = run_session(1), run_session(2)
+        assert s1.read_all() == oracle_merge(workloads)
+        # different ingestion orders, same fixpoint: digests agree (with the
+        # mesh this reduction is an XLA all-reduce across the 8 shards)
+        assert s1.digest() == s2.digest()
+
+    def test_frontier_merged(self):
+        workloads = generate_workload(seed=9, num_docs=2, ops_per_doc=20)
+        session = StreamingMerge(num_docs=2, actors=ACTORS)
+        for d, w in enumerate(workloads):
+            session.ingest(d, [ch for log in w.values() for ch in log])
+        session.drain()
+        frontier = session.frontier()
+        assert set(frontier) <= set(ACTORS) and max(frontier.values()) > 0
+
+
+class TestRebalance:
+    def test_greedy_balance(self):
+        sizes = [100, 1, 1, 1, 97, 1, 1, 1]
+        shards = rebalance(sizes, 2)
+        loads = [sum(sizes[i] for i in s) for s in shards]
+        assert abs(loads[0] - loads[1]) <= 4
+        assert sorted(i for s in shards for i in s) == list(range(8))
