@@ -1,0 +1,140 @@
+"""Native host-runtime loader.
+
+Compiles ``src/native.cpp`` into a shared library on first use (g++ is in the
+image; there is no pybind11, so the boundary is a plain C ABI bound with
+ctypes) and exposes typed wrappers.  The build is cached next to the source
+keyed by a source hash; set ``PERITEXT_TPU_NO_NATIVE=1`` to force the pure
+Python fallbacks (every native entry point has one — the native layer is an
+accelerator, never a requirement).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_SRC = Path(__file__).parent / "src" / "native.cpp"
+_BUILD_DIR = Path(__file__).parent / "_build"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> Optional[Path]:
+    source = _SRC.read_bytes()
+    tag = hashlib.sha256(source).hexdigest()[:16]
+    out = _BUILD_DIR / f"libptnative-{tag}.so"
+    if out.exists():
+        return out
+    _BUILD_DIR.mkdir(exist_ok=True)
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        str(_SRC), "-o", str(out) + ".tmp",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    os.replace(str(out) + ".tmp", out)
+    return out
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, or None when unavailable/disabled."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PERITEXT_TPU_NO_NATIVE") == "1":
+            return None
+        path = _compile()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            return None
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.pt_causal_schedule.restype = ctypes.c_int32
+        lib.pt_causal_schedule.argtypes = [
+            ctypes.c_int32, i32p, i32p, i32p, i32p, i32p,
+            ctypes.c_int32, i32p, i32p,
+        ]
+        lib.pt_varint_encode.restype = ctypes.c_int64
+        lib.pt_varint_encode.argtypes = [i32p, ctypes.c_int64, u8p, ctypes.c_int64]
+        lib.pt_varint_decode.restype = ctypes.c_int64
+        lib.pt_varint_decode.argtypes = [u8p, ctypes.c_int64, i32p, ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def causal_schedule_indices(
+    actor: np.ndarray,
+    seq: np.ndarray,
+    dep_off: np.ndarray,
+    dep_actor: np.ndarray,
+    dep_seq: np.ndarray,
+    n_actors: int,
+    base_clock: np.ndarray,
+) -> Optional[np.ndarray]:
+    """Native schedule; returns ordered change indices or None if no native."""
+    lib = load()
+    if lib is None:
+        return None
+    n = int(actor.shape[0])
+    out = np.empty(n, np.int32)
+    count = lib.pt_causal_schedule(
+        n,
+        np.ascontiguousarray(actor, np.int32),
+        np.ascontiguousarray(seq, np.int32),
+        np.ascontiguousarray(dep_off, np.int32),
+        np.ascontiguousarray(dep_actor, np.int32),
+        np.ascontiguousarray(dep_seq, np.int32),
+        int(n_actors),
+        np.ascontiguousarray(base_clock, np.int32),
+        out,
+    )
+    return out[:count]
+
+
+def varint_encode(values: np.ndarray) -> Optional[bytes]:
+    lib = load()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, np.int32)
+    cap = int(values.size) * 5 + 16
+    out = np.empty(cap, np.uint8)
+    written = lib.pt_varint_encode(values, int(values.size), out, cap)
+    if written < 0:
+        raise ValueError("varint encode overflow")
+    return out[:written].tobytes()
+
+
+def varint_decode(data: bytes, expected: int) -> Optional[np.ndarray]:
+    lib = load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, np.uint8)
+    out = np.empty(expected, np.int32)
+    count = lib.pt_varint_decode(
+        np.ascontiguousarray(buf), int(buf.size), out, expected
+    )
+    if count < 0 or count != expected:
+        raise ValueError("malformed varint payload")
+    return out

@@ -1,0 +1,151 @@
+// Native host runtime for peritext-tpu.
+//
+// The TPU owns op application (JAX/XLA kernels); the host owns the
+// irregular work around it.  Two of those paths are hot enough at pod scale
+// to be native (SURVEY §5.8: host-side causal scheduling runs per document
+// per round; the wire codec runs per change batch on every DCN hop):
+//
+//  1. pt_causal_schedule — deterministic topological schedule of a change
+//     set against a vector clock (the C++ twin of
+//     peritext_tpu/parallel/causal.py::causal_schedule; the reference's
+//     catch-and-requeue loop is test/merge.ts:4-23).
+//  2. pt_varint_encode / pt_varint_decode — zigzag-varint packing of int32
+//     streams, the payload core of the binary change-frame codec
+//     (peritext_tpu/parallel/codec.py).
+//
+// Plain C ABI throughout: the Python side binds with ctypes (no pybind11 in
+// the image), and everything crossing the boundary is int32/uint8 arrays.
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+inline int64_t key_of(int32_t actor, int32_t seq) {
+    return (static_cast<int64_t>(actor) << 32) | static_cast<uint32_t>(seq);
+}
+}  // namespace
+
+extern "C" {
+
+// Deterministic causal schedule.
+//
+//   n         : number of candidate changes
+//   actor[i]  : actor index of change i (indices follow actor-string order)
+//   seq[i]    : per-actor sequence number (1-based, contiguous per actor)
+//   deps for change i live at dep_actor/dep_seq[dep_off[i] .. dep_off[i+1])
+//   n_actors  : actor table size
+//   base_clock: per-actor applied frontier (length n_actors)
+//   out_order : caller-allocated, capacity n; receives scheduled change
+//               indices in application order
+//
+// Returns the number scheduled; the remaining changes are causally stuck
+// (their dependencies are not in the set).  Duplicates of one (actor, seq)
+// and changes already below the clock are skipped (not scheduled, not stuck):
+// mirrored from causal.py so the two implementations are interchangeable.
+int32_t pt_causal_schedule(int32_t n, const int32_t* actor, const int32_t* seq,
+                           const int32_t* dep_off, const int32_t* dep_actor,
+                           const int32_t* dep_seq, int32_t n_actors,
+                           const int32_t* base_clock, int32_t* out_order) {
+    std::vector<int32_t> clock(base_clock, base_clock + n_actors);
+    std::unordered_map<int64_t, int32_t> pending;  // (actor,seq) -> change idx
+    pending.reserve(static_cast<size_t>(n) * 2);
+
+    for (int32_t i = 0; i < n; ++i) {
+        if (seq[i] <= clock[actor[i]]) continue;           // already applied
+        pending.emplace(key_of(actor[i], seq[i]), i);      // first wins (dup skip)
+    }
+
+    auto admissible = [&](int32_t i) -> bool {
+        if (seq[i] != clock[actor[i]] + 1) return false;
+        for (int32_t d = dep_off[i]; d < dep_off[i + 1]; ++d) {
+            if (clock[dep_actor[d]] < dep_seq[d]) return false;
+        }
+        return true;
+    };
+
+    // waiters: blocker (actor, seq) -> change indices waiting on it
+    std::unordered_map<int64_t, std::vector<int32_t>> waiters;
+    waiters.reserve(pending.size());
+    for (const auto& [key, i] : pending) {
+        if (seq[i] > 1 && clock[actor[i]] < seq[i] - 1) {
+            waiters[key_of(actor[i], seq[i] - 1)].push_back(i);
+        }
+        for (int32_t d = dep_off[i]; d < dep_off[i + 1]; ++d) {
+            if (dep_actor[d] != actor[i] && clock[dep_actor[d]] < dep_seq[d]) {
+                waiters[key_of(dep_actor[d], dep_seq[d])].push_back(i);
+            }
+        }
+    }
+
+    // min-heap over (actor, seq): smallest ready first == Python determinism
+    using HeapKey = std::pair<int64_t, int32_t>;  // (key, change idx)
+    std::priority_queue<HeapKey, std::vector<HeapKey>, std::greater<HeapKey>> ready;
+    for (const auto& [key, i] : pending) {
+        if (admissible(i)) ready.emplace(key, i);
+    }
+
+    int32_t count = 0;
+    while (!ready.empty()) {
+        auto [key, i] = ready.top();
+        ready.pop();
+        auto it = pending.find(key);
+        if (it == pending.end()) continue;  // woken more than once
+        pending.erase(it);
+        out_order[count++] = i;
+        clock[actor[i]] = seq[i];
+        auto w = waiters.find(key);
+        if (w != waiters.end()) {
+            for (int32_t j : w->second) {
+                auto pj = pending.find(key_of(actor[j], seq[j]));
+                if (pj != pending.end() && admissible(j)) {
+                    ready.emplace(key_of(actor[j], seq[j]), j);
+                }
+            }
+            waiters.erase(w);
+        }
+    }
+    return count;
+}
+
+// Zigzag-varint encode int32 stream into out (capacity cap bytes).
+// Returns bytes written, or -1 if cap is insufficient.
+int64_t pt_varint_encode(const int32_t* in, int64_t n, uint8_t* out, int64_t cap) {
+    int64_t pos = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        uint32_t z = (static_cast<uint32_t>(in[i]) << 1) ^
+                     static_cast<uint32_t>(in[i] >> 31);
+        do {
+            if (pos >= cap) return -1;
+            uint8_t byte = z & 0x7F;
+            z >>= 7;
+            out[pos++] = byte | (z ? 0x80 : 0);
+        } while (z);
+    }
+    return pos;
+}
+
+// Decode nbytes of zigzag-varint into out (capacity cap ints).
+// Returns ints written, or -1 on malformed/overflowing input.
+int64_t pt_varint_decode(const uint8_t* in, int64_t nbytes, int32_t* out,
+                         int64_t cap) {
+    int64_t pos = 0, count = 0;
+    while (pos < nbytes) {
+        uint32_t z = 0;
+        int shift = 0;
+        while (true) {
+            if (pos >= nbytes || shift > 28) return -1;
+            uint8_t byte = in[pos++];
+            z |= static_cast<uint32_t>(byte & 0x7F) << shift;
+            if (!(byte & 0x80)) break;
+            shift += 7;
+        }
+        if (count >= cap) return -1;
+        out[count++] = static_cast<int32_t>((z >> 1) ^ (~(z & 1) + 1));
+    }
+    return count;
+}
+
+}  // extern "C"

@@ -17,8 +17,14 @@ from __future__ import annotations
 import heapq
 from typing import Dict, Iterable, List, Optional, Tuple
 
+import numpy as np
+
+from .. import native
 from ..core.errors import PeritextError
 from ..core.types import Change, Clock
+
+#: Below this many changes the Python scheduler wins (array setup overhead).
+_NATIVE_THRESHOLD = 64
 
 
 def _admissible(change: Change, clock: Clock) -> bool:
@@ -36,7 +42,15 @@ def causal_schedule(
     order (smallest (actor, seq) among ready first); ``stuck`` are changes
     whose dependencies are absent from the set (e.g. lost in transit) —
     callers under faulty delivery leave them for the next anti-entropy round.
+
+    Large sets route through the native C++ scheduler (peritext_tpu/native)
+    when it is available; both implementations produce identical output.
     """
+    changes = list(changes)
+    if len(changes) >= _NATIVE_THRESHOLD:
+        result = _native_schedule(changes, base_clock)
+        if result is not None:
+            return result
     clock: Clock = dict(base_clock or {})
     pending: Dict[Tuple[str, int], Change] = {}
     for ch in changes:
@@ -76,6 +90,67 @@ def causal_schedule(
 
     stuck = [pending[k] for k in sorted(pending.keys())]
     return out, stuck
+
+
+def _native_schedule(
+    changes: List[Change], base_clock: Optional[Clock]
+) -> Optional[Tuple[List[Change], List[Change]]]:
+    """Array form of the schedule for the C++ core (peritext_tpu/native).
+    Actor indices are assigned in sorted-string order so the native heap's
+    integer ordering reproduces the Python tie-break exactly."""
+    if not native.available():
+        return None
+    actors = sorted(
+        {ch.actor for ch in changes} | set(base_clock or {})
+    )
+    index = {a: i for i, a in enumerate(actors)}
+    n = len(changes)
+    actor_arr = np.fromiter((index[ch.actor] for ch in changes), np.int32, n)
+    seq_arr = np.fromiter((ch.seq for ch in changes), np.int32, n)
+    dep_off = np.zeros(n + 1, np.int32)
+    dep_actor: List[int] = []
+    dep_seq: List[int] = []
+    for i, ch in enumerate(changes):
+        for a, s in (ch.deps or {}).items():
+            if a in index:
+                dep_actor.append(index[a])
+                dep_seq.append(s)
+            elif s > 0:
+                # dep on an actor absent from clock and set: never satisfiable
+                # in this call; encode as an impossible self-dep
+                dep_actor.append(index[ch.actor])
+                dep_seq.append(np.iinfo(np.int32).max)
+        dep_off[i + 1] = len(dep_actor)
+    clock_arr = np.zeros(len(actors), np.int32)
+    for a, s in (base_clock or {}).items():
+        clock_arr[index[a]] = s
+
+    order = native.causal_schedule_indices(
+        actor_arr,
+        seq_arr,
+        dep_off,
+        np.asarray(dep_actor, np.int32),
+        np.asarray(dep_seq, np.int32),
+        len(actors),
+        clock_arr,
+    )
+    if order is None:
+        return None
+    ordered = [changes[i] for i in order]
+    if len(ordered) == len(changes):
+        return ordered, []  # nothing dropped: skip the stuck reconstruction
+    scheduled = set(int(i) for i in order)
+    clock0: Clock = dict(base_clock or {})
+    pending: Dict[Tuple[str, int], int] = {}
+    for i, ch in enumerate(changes):
+        key = (ch.actor, ch.seq)
+        if key in pending or ch.seq <= clock0.get(ch.actor, 0):
+            continue
+        pending[key] = i
+    stuck = [
+        changes[i] for k, i in sorted(pending.items()) if i not in scheduled
+    ]
+    return ordered, stuck
 
 
 def causal_sort(

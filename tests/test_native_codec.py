@@ -1,0 +1,161 @@
+"""Native C++ host-runtime tests: scheduler equivalence with the Python
+implementation, varint byte-compatibility, and binary frame codec round-trips."""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from peritext_tpu import native
+from peritext_tpu.core.types import Change
+from peritext_tpu.parallel import causal
+from peritext_tpu.parallel.codec import (
+    _py_varint_decode,
+    _py_varint_encode,
+    decode_frame,
+    encode_frame,
+)
+from peritext_tpu.testing.fuzz import run_fuzz
+
+
+def fuzz_changes(seed, iterations=60):
+    state = run_fuzz(seed=seed, iterations=iterations)
+    return [ch for a in state.store.actors() for ch in state.store.log(a)]
+
+
+def python_schedule(changes, base_clock=None):
+    """Force the pure-Python scheduler path."""
+    old = causal._NATIVE_THRESHOLD
+    causal._NATIVE_THRESHOLD = 10**9
+    try:
+        return causal.causal_schedule(changes, base_clock)
+    finally:
+        causal._NATIVE_THRESHOLD = old
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native library unavailable")
+    return lib
+
+
+class TestNativeBuild:
+    def test_builds_and_loads(self, native_lib):
+        assert native.available()
+
+
+class TestSchedulerEquivalence:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_full_set_matches_python(self, native_lib, seed):
+        changes = fuzz_changes(seed)
+        rng = random.Random(seed)
+        for _ in range(5):
+            rng.shuffle(changes)
+            py_ordered, py_stuck = python_schedule(list(changes))
+            nat = causal._native_schedule(list(changes), None)
+            assert nat is not None
+            nat_ordered, nat_stuck = nat
+            assert [(c.actor, c.seq) for c in nat_ordered] == [
+                (c.actor, c.seq) for c in py_ordered
+            ]
+            assert nat_stuck == py_stuck == []
+
+    def test_with_base_clock_and_duplicates(self, native_lib):
+        changes = fuzz_changes(3)
+        base = {"doc1": 2}  # pretend doc1's first two changes are applied
+        doubled = changes + list(changes)
+        py_ordered, py_stuck = python_schedule(list(doubled), dict(base))
+        nat_ordered, nat_stuck = causal._native_schedule(list(doubled), dict(base))
+        assert [(c.actor, c.seq) for c in nat_ordered] == [
+            (c.actor, c.seq) for c in py_ordered
+        ]
+        assert [(c.actor, c.seq) for c in nat_stuck] == [
+            (c.actor, c.seq) for c in py_stuck
+        ]
+
+    def test_gaps_leave_identical_stuck_sets(self, native_lib):
+        changes = fuzz_changes(5)
+        rng = random.Random(7)
+        # drop 30%: later changes of the same actor become stuck
+        kept = [ch for ch in changes if rng.random() > 0.3]
+        py_ordered, py_stuck = python_schedule(list(kept))
+        nat_ordered, nat_stuck = causal._native_schedule(list(kept), None)
+        assert [(c.actor, c.seq) for c in nat_ordered] == [
+            (c.actor, c.seq) for c in py_ordered
+        ]
+        assert [(c.actor, c.seq) for c in nat_stuck] == [
+            (c.actor, c.seq) for c in py_stuck
+        ]
+
+    def test_dep_on_unknown_actor_is_stuck(self, native_lib):
+        ch = Change(actor="a", seq=1, deps={"ghost": 4}, start_op=1, ops=[])
+        filler = fuzz_changes(1)  # push past the native threshold
+        ordered, stuck = causal._native_schedule(filler + [ch], None)
+        assert ch in stuck
+
+
+class TestVarint:
+    def test_native_and_python_bytes_identical(self, native_lib):
+        rng = np.random.default_rng(0)
+        values = rng.integers(-(2**31), 2**31 - 1, size=5000, dtype=np.int32)
+        nat = native.varint_encode(values)
+        py = _py_varint_encode(values.tolist())
+        assert nat == py
+        assert native.varint_decode(nat, len(values)).tolist() == values.tolist()
+        assert _py_varint_decode(py, len(values)) == values.tolist()
+
+    def test_malformed_rejected(self, native_lib):
+        with pytest.raises(ValueError):
+            native.varint_decode(b"\xff\xff\xff\xff\xff\xff", 1)
+        with pytest.raises(ValueError):
+            _py_varint_decode(b"\xff\xff\xff\xff\xff\xff", 1)
+
+
+class TestFrameCodec:
+    @pytest.mark.parametrize("seed", [0, 4])
+    def test_round_trip_equals_input(self, seed):
+        changes = fuzz_changes(seed)
+        frame = encode_frame(changes)
+        decoded = decode_frame(frame)
+        assert decoded == changes
+
+    def test_round_trip_matches_json_wire(self):
+        changes = fuzz_changes(2)
+        decoded = decode_frame(encode_frame(changes))
+        assert [c.to_json() for c in decoded] == [c.to_json() for c in changes]
+
+    def test_smaller_than_json(self):
+        changes = fuzz_changes(6, iterations=150)
+        frame = encode_frame(changes)
+        as_json = json.dumps([c.to_json() for c in changes]).encode()
+        assert len(frame) < len(as_json) / 2  # at least 2x denser
+
+    def test_map_ops_spill_to_json_path(self):
+        from peritext_tpu.core.comment import Comment, put_comment
+        from peritext_tpu.core.doc import Doc
+
+        doc = Doc("alice")
+        change, _ = put_comment(doc, Comment(id="c1", actor="alice", content="hey"))
+        decoded = decode_frame(encode_frame([change]))
+        assert decoded == [change]
+
+    def test_corrupt_frames_raise(self):
+        changes = fuzz_changes(1, iterations=20)
+        frame = encode_frame(changes)
+        with pytest.raises(ValueError):
+            decode_frame(frame[: len(frame) // 2])
+        with pytest.raises(ValueError):
+            decode_frame(b"XXXX" + frame[4:])
+        with pytest.raises(ValueError):
+            decode_frame(frame[:-3])
+
+    def test_python_fallback_bytes_compatible(self, monkeypatch):
+        changes = fuzz_changes(3, iterations=30)
+        with_native = encode_frame(changes)
+        monkeypatch.setattr(native, "available", lambda: False)
+        without = encode_frame(changes)
+        assert with_native == without
+        assert decode_frame(with_native) == changes
