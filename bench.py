@@ -71,9 +71,13 @@ def run(args) -> dict:
     total_ops = synth_total_ops(streams)
     gen_time = time.perf_counter() - gen_start
 
-    apply_jit = apply_batch_jit
     state0 = empty_docs(d, s, max(m, km), tomb_capacity=max(kd, 8))
     ops_dev = jax.device_put(streams)
+
+    # Docs start empty here, so the insert loop can be statically bounded to
+    # the insert-stream width (pallas_insert loop_slots contract).
+    def apply_jit(st, ops):
+        return apply_batch_jit(st, ops, insert_loop_slots=ki)
 
     # NOTE: jax.block_until_ready does not actually block on the axon TPU
     # platform; force a small host transfer to synchronize honestly.
@@ -85,24 +89,37 @@ def run(args) -> dict:
     sync(result)
     compile_time = time.perf_counter() - compile_start
 
+    # Single-call wall time includes the platform's fixed dispatch latency
+    # (~tens of ms through the axon tunnel); report it separately.
+    t0 = time.perf_counter()
+    sync(apply_jit(state0, ops_dev))
+    single_call = time.perf_counter() - t0
+
+    # Steady-state throughput (the headline): enqueue iters applies
+    # back-to-back — the device executes queued programs serially — and
+    # sync once, amortizing dispatch latency exactly as a streaming
+    # deployment does.
     times = []
-    for _ in range(args.iters):
+    for _ in range(3):
         t0 = time.perf_counter()
-        result = apply_jit(state0, ops_dev)
+        for _ in range(args.iters):
+            result = apply_jit(state0, ops_dev)
         sync(result)
         times.append(time.perf_counter() - t0)
-    best = min(times)
+    best = min(times) / args.iters
 
     overflow = int(np.asarray(result.overflow).sum())
     device_ops_per_sec = total_ops / best
 
-    # resolution (read path) timing, reported as extra context
+    # resolution (read path) timing, reported as extra context; sync on a
+    # small field (visible is (D,S) and would measure the host transfer).
     resolved = resolve_jit(result, 32)
-    np.asarray(resolved.visible)
+    np.asarray(resolved.overflow)
     t0 = time.perf_counter()
-    resolved = resolve_jit(result, 32)
-    np.asarray(resolved.visible)
-    resolve_time = time.perf_counter() - t0
+    for _ in range(args.iters):
+        resolved = resolve_jit(result, 32)
+    np.asarray(resolved.overflow)
+    resolve_time = (time.perf_counter() - t0) / args.iters
 
     baseline = measure_scalar_baseline()
 
@@ -117,6 +134,7 @@ def run(args) -> dict:
         "ops_per_doc": k,
         "slot_capacity": s,
         "apply_seconds": round(best, 4),
+        "single_call_seconds": round(single_call, 4),
         "resolve_seconds": round(resolve_time, 4),
         "compile_seconds": round(compile_time, 1),
         "workload_gen_seconds": round(gen_time, 1),
@@ -216,7 +234,7 @@ def main() -> None:
     parser.add_argument("--ops-per-doc", type=int, default=None)
     parser.add_argument("--slots", type=int, default=None)
     parser.add_argument("--marks", type=int, default=None)
-    parser.add_argument("--iters", type=int, default=3)
+    parser.add_argument("--iters", type=int, default=10)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--platform", default=None, help="force a jax platform (e.g. cpu)"

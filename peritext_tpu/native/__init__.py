@@ -35,15 +35,21 @@ def _compile() -> Optional[Path]:
     if out.exists():
         return out
     _BUILD_DIR.mkdir(exist_ok=True)
+    # Unique tmp name per process: concurrent first-use builds (pytest
+    # workers, shared FS) must not interleave writes before the atomic
+    # rename installs the hash-keyed artifact.
+    tmp = _BUILD_DIR / f".libptnative-{tag}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-        str(_SRC), "-o", str(out) + ".tmp",
+        str(_SRC), "-o", str(tmp),
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
     except (OSError, subprocess.SubprocessError):
         return None
-    os.replace(str(out) + ".tmp", out)
+    finally:
+        tmp.unlink(missing_ok=True)
     return out
 
 

@@ -97,6 +97,15 @@ def _apply_doc(state: PackedDocs, ins_ref, ins_op, ins_char, del_target, mark_ro
         state.elem_id, state.char, state.num_slots, state.overflow,
         ins_ref, ins_op, ins_char,
     )
+    return _post_insert_doc(
+        state._replace(elem_id=elem, char=char, num_slots=n, overflow=ov),
+        del_target, mark_rows, mark_count,
+    )
+
+
+def _post_insert_doc(state: PackedDocs, del_target, mark_rows, mark_count):
+    """Phases 2+3 (deletes, marks) for one doc, after the insert phase."""
+    elem, n, ov = state.elem_id, state.num_slots, state.overflow
 
     # Deletes: validate targets exist, then append to the tombstone table
     # (dedup against rows already there keeps re-delivery idempotent).
@@ -127,10 +136,7 @@ def _apply_doc(state: PackedDocs, ins_ref, ins_op, ins_char, del_target, mark_ro
         marks_in, state.num_marks, mark_rows, mark_count
     )
     return state._replace(
-        elem_id=elem,
-        char=char,
         tomb_id=tomb_id,
-        num_slots=n,
         num_tombs=num_tombs,
         num_marks=num_marks,
         overflow=ov | del_err | tomb_ov | mark_ov,
@@ -138,14 +144,42 @@ def _apply_doc(state: PackedDocs, ins_ref, ins_op, ins_char, del_target, mark_ro
     )
 
 
-def apply_batch(state: PackedDocs, encoded_arrays) -> PackedDocs:
+def apply_batch(
+    state: PackedDocs,
+    encoded_arrays,
+    *,
+    insert_impl: str = "auto",
+    insert_loop_slots: int | None = None,
+) -> PackedDocs:
     """Batched apply: vmap of the two-phase pipeline over the doc axis.
 
     ``encoded_arrays`` is the tuple
     (ins_ref, ins_op, ins_char, del_target, marks_dict, mark_count)
     with leading doc axes, as produced by :func:`encoded_arrays_of`.
+
+    ``insert_impl`` selects the sequential-phase implementation:
+    ``"auto"`` (pallas on TPU, lax elsewhere), ``"lax"``, ``"pallas"``, or
+    ``"pallas_interpret"`` (CPU-debuggable pallas, for differential tests).
+    ``insert_loop_slots`` optionally bounds the slot window the insert loop
+    touches (see pallas_insert.insert_batch_pallas); ignored on the lax path.
     """
     ins_ref, ins_op, ins_char, del_target, marks, mark_count = encoded_arrays
+    impl = insert_impl
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "lax"
+    if impl in ("pallas", "pallas_interpret"):
+        from .pallas_insert import insert_batch_pallas
+
+        elem, char, n, ov = insert_batch_pallas(
+            state.elem_id, state.char, state.num_slots, state.overflow,
+            ins_ref, ins_op, ins_char,
+            interpret=(impl == "pallas_interpret"),
+            loop_slots=insert_loop_slots,
+        )
+        state = state._replace(elem_id=elem, char=char, num_slots=n, overflow=ov)
+        return jax.vmap(_post_insert_doc)(state, del_target, marks, mark_count)
+    if impl != "lax":
+        raise ValueError(f"unknown insert_impl: {insert_impl!r}")
     return jax.vmap(_apply_doc)(
         state, ins_ref, ins_op, ins_char, del_target, marks, mark_count
     )
@@ -163,4 +197,6 @@ def encoded_arrays_of(encoded: EncodedBatch):
     )
 
 
-apply_batch_jit = jax.jit(apply_batch)
+apply_batch_jit = jax.jit(
+    apply_batch, static_argnames=("insert_impl", "insert_loop_slots")
+)

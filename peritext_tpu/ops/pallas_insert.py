@@ -1,0 +1,157 @@
+"""Pallas TPU kernel for the sequential RGA insert phase.
+
+This is the hot loop of the whole framework (kernel.py phase 1, reference
+``applyListInsert`` src/micromerge.ts:1187-1245).  The plain-XLA formulation
+(`kernel._insert_loop` under vmap) carries the full ``(D, S)`` element-id and
+character tensors through HBM on every one of the K insert steps; at the
+BASELINE config-4 scale that is ~K x 4 x D x S bytes of traffic and the loop
+is purely bandwidth bound.
+
+The Pallas kernel instead blocks the doc axis onto the grid and keeps each
+block's entire document state resident in VMEM across the WHOLE K-step loop:
+HBM traffic drops from O(K * D * S) to O(D * (S + K)) — read the state and
+the op streams once, write the state once.
+
+Layout: everything is transposed so **documents ride the 128-wide lane
+axis** and slots/ops ride sublanes.  That makes the per-step stream access a
+dynamic *sublane* slice (cheap on TPU; dynamic lane indexing would force a
+relayout every iteration), reductions over slots are sublane reductions, and
+the RGA splice is a sublane rotate.  ``argmax`` is avoided (unsupported for
+int32 in mosaic): the reference-element position comes from a masked integer
+max, which is exact because element ids are unique so at most one slot
+matches.
+
+Semantics are identical to ``kernel._insert_loop`` (the CPU/differential
+path); tests assert equality between the two in interpreter mode and
+``kernel.apply_batch`` selects this kernel automatically on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+
+
+def _insert_kernel(ins_ref, ins_op, ins_char, elem_in, char_in, n_in, ov_in,
+                   elem_out, char_out, n_out, ov_out):
+    """One grid cell: all K inserts for an (S, L) block of documents.
+
+    Mask algebra exploits two invariants to keep per-step VPU work minimal:
+    real element ids are never 0, and empty slots hold id 0.  So the
+    reference match needs no ``pos < n`` guard (a non-HEAD ref can't match a
+    padding slot), and the convergence skip needs none either — the first
+    padding slot (id 0 < any op id) acts as a natural sentinel at exactly
+    ``pos == n``, which is the append position.  The no-op case folds into
+    the splice select by forcing the insert position to S (never matched by
+    ``pos``), so the carry needs no final where.
+    """
+    s_cap, lanes = elem_in.shape
+    k_total = ins_ref.shape[0]
+    pos = lax.broadcasted_iota(jnp.int32, (s_cap, lanes), 0)
+
+    def body(k, carry):
+        elem, chars, n, ov = carry  # (S,L) (S,L) (1,L) (1,L)
+        ref = ins_ref[pl.ds(k, 1), :]  # (1,L)
+        op = ins_op[pl.ds(k, 1), :]
+        ch = ins_char[pl.ds(k, 1), :]
+        live = op != 0
+        is_head = ref == 0
+
+        # Locate the reference element.  Ids are unique, so the masked max
+        # IS the match position; no match (or HEAD) yields -1.
+        p = jnp.max(jnp.where(elem == ref, pos, -1), axis=0, keepdims=True)
+        found = is_head | (p >= 0)
+        p = jnp.where(is_head, jnp.int32(-1), p)
+        ok = live & found & (n < s_cap)
+
+        # Convergence skip (reference :1201-1208): first position right of
+        # the reference whose element id is NOT greater than the new op id.
+        q = jnp.min(
+            jnp.where((pos > p) & (elem < op), pos, s_cap), axis=0, keepdims=True
+        )
+        q = jnp.where(ok, q, s_cap)  # no-op => splice position out of range
+
+        lt, eq = pos < q, pos == q
+        new_elem = jnp.where(lt, elem, jnp.where(eq, op, jnp.roll(elem, 1, axis=0)))
+        new_char = jnp.where(lt, chars, jnp.where(eq, ch, jnp.roll(chars, 1, axis=0)))
+        return (
+            new_elem,
+            new_char,
+            n + ok.astype(jnp.int32),
+            ov | ((live & ~found) | (live & (n >= s_cap))).astype(jnp.int32),
+        )
+
+    init = (elem_in[:], char_in[:], n_in[:], ov_in[:])
+    elem, chars, n, ov = lax.fori_loop(0, k_total, body, init)
+    elem_out[:] = elem
+    char_out[:] = chars
+    n_out[:] = n
+    ov_out[:] = ov
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "loop_slots"))
+def insert_batch_pallas(elem_id, char, num_slots, overflow,
+                        ins_ref, ins_op, ins_char, *, interpret: bool = False,
+                        loop_slots: int | None = None):
+    """Pallas-accelerated equivalent of ``vmap(kernel._insert_loop)``.
+
+    Args mirror the lax path: (D,S) elem_id/char, (D,) num_slots, (D,) bool
+    overflow, (D,K) insert streams.  Returns the same tuple of updated
+    arrays.  The doc axis is padded up to a multiple of 128 lanes (padded
+    docs carry op id 0 == not live, so they are untouched no-ops).
+
+    ``loop_slots``: static upper bound on ``max(num_slots) + live inserts``
+    known by the caller (e.g. K for a batch built from empty docs).  The
+    K-step loop then runs on only the first ``loop_slots`` slot rows — the
+    splice can never move an element across that boundary when the bound
+    holds — roughly halving VPU work for fresh batches.  If the bound is
+    violated the kernel flags ``overflow`` (the API's scalar-fallback path),
+    so a bad bound degrades performance, never correctness.
+    """
+    d, s_cap = elem_id.shape
+    k = ins_ref.shape[1]
+    s_loop = s_cap if loop_slots is None else max(8, min(-(-loop_slots // 8) * 8, s_cap))
+    dp = -(-d // LANES) * LANES
+    pad = dp - d
+
+    def t(x):  # (D, W) -> (W, Dp)
+        return jnp.pad(x.T.astype(jnp.int32), ((0, 0), (0, pad)))
+
+    col = lambda width: pl.BlockSpec(  # noqa: E731
+        (width, LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+
+    elem, chars, n, ov = pl.pallas_call(
+        _insert_kernel,
+        grid=(dp // LANES,),
+        in_specs=[
+            col(k), col(k), col(k),
+            col(s_loop), col(s_loop), col(1), col(1),
+        ],
+        out_specs=[col(s_loop), col(s_loop), col(1), col(1)],
+        out_shape=[
+            jax.ShapeDtypeStruct((s_loop, dp), jnp.int32),
+            jax.ShapeDtypeStruct((s_loop, dp), jnp.int32),
+            jax.ShapeDtypeStruct((1, dp), jnp.int32),
+            jax.ShapeDtypeStruct((1, dp), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        t(ins_ref), t(ins_op), t(ins_char),
+        t(elem_id[:, :s_loop]), t(char[:, :s_loop]),
+        t(num_slots.reshape(d, 1)), t(overflow.reshape(d, 1)),
+    )
+
+    elem_new, char_new = elem[:, :d].T, chars[:, :d].T
+    if s_loop < s_cap:
+        # Slots past the loop window are untouched by construction.
+        elem_new = jnp.concatenate([elem_new, elem_id[:, s_loop:]], axis=1)
+        char_new = jnp.concatenate([char_new, char[:, s_loop:]], axis=1)
+    return elem_new, char_new, n[0, :d], ov[0, :d] != 0

@@ -112,15 +112,25 @@ def _flatten_op(op: Operation, table: _StringTable, ints: List[int]) -> None:
     elif op.action == "del" and op.elem_id is not None and op.obj is not ROOT:
         ints += [_OP_DEL, *obj_triple(op.obj), *opid_pair(op.opid), *opid_pair(op.elem_id)]
     elif op.action in ("addMark", "removeMark") and op.mark_type in MARK_INDEX:
+        # Fast path only for the exact attr shape the decoder reconstructs
+        # ({"url": str} on link, {"id": str} on comment); everything else —
+        # extra keys, {}, attrs on other mark types — spills to JSON so the
+        # round-trip stays lossless.
+        expected_key = {"link": "url", "comment": "id"}.get(op.mark_type)
         attr_idx = 0
         if op.attrs:
-            if "url" in op.attrs and isinstance(op.attrs["url"], str):
-                attr_idx = table.intern(op.attrs["url"]) + 1
-            elif "id" in op.attrs and isinstance(op.attrs["id"], str):
-                attr_idx = table.intern(op.attrs["id"]) + 1
+            if (
+                expected_key is not None
+                and set(op.attrs) == {expected_key}
+                and isinstance(op.attrs[expected_key], str)
+            ):
+                attr_idx = table.intern(op.attrs[expected_key]) + 1
             else:  # exotic attrs: JSON spillover
                 ints += [_OP_JSON, table.intern(json.dumps(op.to_json()))]
                 return
+        elif op.attrs is not None:  # attrs == {} must round-trip as {}
+            ints += [_OP_JSON, table.intern(json.dumps(op.to_json()))]
+            return
 
         def boundary(b: Boundary):
             kind = _BK_TO_INT[b.kind]
@@ -184,45 +194,61 @@ class _IntReader:
         return [int(v) for v in vals]
 
 
+def _string(strings: List[str], idx: int) -> str:
+    # Explicit bounds check: a corrupt (e.g. zigzag-negative) index must be a
+    # ValueError, never a silent strings[-1] hit or an IndexError.
+    if not 0 <= idx < len(strings):
+        raise ValueError("string-table index out of range")
+    return strings[idx]
+
+
 def _read_op(r: _IntReader, strings: List[str]) -> Operation:
     (kind,) = r.take()
     if kind == _OP_JSON:
         (idx,) = r.take()
-        return Operation.from_json(json.loads(strings[idx]))
+        return Operation.from_json(json.loads(_string(strings, idx)))
 
     def obj_of(vals):
         flag, ctr, actor = vals
-        return ROOT if flag == 0 else (ctr, strings[actor])
+        return ROOT if flag == 0 else (ctr, _string(strings, actor))
 
     obj = obj_of(r.take(3))
     ctr, actor = r.take(2)
-    opid = (ctr, strings[actor])
+    opid = (ctr, _string(strings, actor))
     if kind == _OP_INSERT:
         flag, rctr, ractor, cp = r.take(4)
-        elem = HEAD if flag == 0 else (rctr, strings[ractor])
+        elem = HEAD if flag == 0 else (rctr, _string(strings, ractor))
         return Operation(
             action="set", obj=obj, opid=opid, elem_id=elem, insert=True, value=chr(cp)
         )
     if kind == _OP_DEL:
         ectr, eactor = r.take(2)
-        return Operation(action="del", obj=obj, opid=opid, elem_id=(ectr, strings[eactor]))
+        return Operation(
+            action="del", obj=obj, opid=opid, elem_id=(ectr, _string(strings, eactor))
+        )
+    if kind not in (_OP_ADDMARK, _OP_REMOVEMARK):
+        raise ValueError(f"unknown op kind {kind}")
     # marks
     (mark_idx,) = r.take()
     sk, sctr, sactor = r.take(3)
     ek, ectr, eactor = r.take(3)
     (attr_idx,) = r.take()
+    if not 0 <= mark_idx < len(ALL_MARKS):
+        raise ValueError("mark type index out of range")
     mark_type = ALL_MARKS[mark_idx]
 
     def boundary(kind_int, bctr, bactor) -> Boundary:
+        if kind_int not in _INT_TO_BK:
+            raise ValueError("bad boundary kind")
         bk = _INT_TO_BK[kind_int]
         if bk in (BEFORE, AFTER):
-            return Boundary(bk, (bctr, strings[bactor]))
+            return Boundary(bk, (bctr, _string(strings, bactor)))
         return Boundary(bk)
 
     attrs = None
     if attr_idx > 0:
         key = "url" if mark_type == "link" else "id"
-        attrs = {key: strings[attr_idx - 1]}
+        attrs = {key: _string(strings, attr_idx - 1)}
     return Operation(
         action="addMark" if kind == _OP_ADDMARK else "removeMark",
         obj=obj,
@@ -236,11 +262,29 @@ def _read_op(r: _IntReader, strings: List[str]) -> Operation:
 
 def decode_frame(data: bytes) -> List[Change]:
     """Inverse of :func:`encode_frame`; raises ValueError on corrupt frames."""
+    try:
+        return _decode_frame(data)
+    except ValueError:
+        raise
+    except (IndexError, KeyError, TypeError, OverflowError, UnicodeDecodeError,
+            struct.error) as exc:
+        # Normalize every corruption symptom to the documented contract.
+        raise ValueError(f"corrupt frame: {exc!r}") from exc
+
+
+def _decode_frame(data: bytes) -> List[Change]:
     if len(data) < _HEADER.size:
         raise ValueError("frame too short")
     magic, version, n_changes, n_strings, n_ints, payload_len = _HEADER.unpack_from(data)
     if magic != _MAGIC or version != _VERSION:
         raise ValueError("bad frame magic/version")
+    body = len(data) - _HEADER.size
+    # Every header count costs at least one body byte, so any count larger
+    # than the body is corrupt — checked BEFORE sizing any allocation from it.
+    if payload_len > body or n_ints > payload_len or n_strings > body:
+        raise ValueError("frame header counts exceed frame size")
+    if n_changes * 5 > n_ints:  # a change costs >= 5 ints
+        raise ValueError("frame header counts exceed frame size")
 
     pos = _HEADER.size
     strings: List[str] = []
@@ -274,13 +318,22 @@ def decode_frame(data: bytes) -> List[Change]:
     for _ in range(n_changes):
         actor_idx, seq, start_op = r.take(3)
         (n_deps,) = r.take()
+        if n_deps < 0:
+            raise ValueError("negative dep count")
         deps = {}
         for _ in range(n_deps):
             a, s = r.take(2)
-            deps[strings[a]] = s
+            deps[_string(strings, a)] = s
         (n_ops,) = r.take()
+        if n_ops < 0:
+            raise ValueError("negative op count")
         ops = [_read_op(r, strings) for _ in range(n_ops)]
         changes.append(
-            Change(actor=strings[actor_idx], seq=seq, deps=deps, start_op=start_op, ops=ops)
+            Change(
+                actor=_string(strings, actor_idx), seq=seq, deps=deps,
+                start_op=start_op, ops=ops,
+            )
         )
+    if r.pos != len(r.values):
+        raise ValueError("trailing garbage in frame payload")
     return changes

@@ -32,14 +32,22 @@ def synth_streams(
     marks_per_doc: int = 0,
     num_actors: int = 4,
     seed: int = 0,
+    ctr_offset: int = 0,
 ) -> SynthStreams:
     """Split-stream tuple (ins_ref, ins_op, ins_char, del_target, marks,
-    mark_count) shaped for ops/kernel.apply_batch."""
+    mark_count) shaped for ops/kernel.apply_batch.
+
+    ``ctr_offset`` shifts all op-id counters; pass the number of ops already
+    applied when synthesizing a follow-up round for carried state, so ids
+    stay unique per document (the kernel's invariant).
+    """
     rng = np.random.default_rng(seed)
     d, ki, kd, km = num_docs, inserts_per_doc, deletes_per_doc, marks_per_doc
 
     actors = rng.integers(1, num_actors + 1, size=(d, ki), dtype=np.int32)
-    ctrs = np.broadcast_to(np.arange(1, ki + 1, dtype=np.int32), (d, ki))
+    ctrs = np.broadcast_to(
+        np.arange(ctr_offset + 1, ctr_offset + ki + 1, dtype=np.int32), (d, ki)
+    )
     ins_op = (ctrs << ACTOR_BITS) | actors
 
     # ref for insert k: HEAD (5%) or a uniformly random earlier insert
@@ -70,7 +78,8 @@ def synth_streams(
         marks["m_end_elem"][:] = np.take_along_axis(ins_op, b_idx, axis=1)
         # mark op ids continue the counter space above the inserts
         m_ctrs = np.broadcast_to(
-            np.arange(ki + 1, ki + km + 1, dtype=np.int32), (d, km)
+            np.arange(ctr_offset + ki + 1, ctr_offset + ki + km + 1, dtype=np.int32),
+            (d, km),
         )
         m_actors = rng.integers(1, num_actors + 1, size=(d, km), dtype=np.int32)
         marks["m_op"][:] = (m_ctrs << ACTOR_BITS) | m_actors

@@ -159,3 +159,73 @@ class TestFrameCodec:
         without = encode_frame(changes)
         assert with_native == without
         assert decode_frame(with_native) == changes
+
+
+class TestCodecRobustness:
+    """Regression tests for lossless attrs and the corrupt-frame contract."""
+
+    def _mark_change(self, mark_type, attrs):
+        from peritext_tpu.core.types import Boundary, Operation
+        from peritext_tpu.core.types import BEFORE, END_OF_TEXT
+
+        op = Operation(
+            action="addMark",
+            obj=(1, "alice"),
+            opid=(7, "alice"),
+            start=Boundary(BEFORE, (2, "alice")),
+            end=Boundary(END_OF_TEXT),
+            mark_type=mark_type,
+            attrs=attrs,
+        )
+        return Change(actor="alice", seq=1, deps={}, start_op=7, ops=[op])
+
+    @pytest.mark.parametrize(
+        "mark_type,attrs",
+        [
+            ("link", {"url": "http://x", "title": "extra"}),  # extra key
+            ("strong", {"url": "http://x"}),  # attrs on attr-less type
+            ("link", {}),  # empty dict must stay {}
+            ("comment", {"id": "c1", "resolved": True}),
+            ("link", {"url": 42}),  # non-string value
+        ],
+    )
+    def test_attr_shapes_round_trip_lossless(self, mark_type, attrs):
+        changes = [self._mark_change(mark_type, attrs)]
+        decoded = decode_frame(encode_frame(changes))
+        assert decoded == changes
+        assert decoded[0].ops[0].attrs == attrs
+
+    def test_fast_path_attrs_round_trip(self):
+        for mark_type, attrs in [("link", {"url": "http://x"}), ("comment", {"id": "c9"})]:
+            changes = [self._mark_change(mark_type, attrs)]
+            decoded = decode_frame(encode_frame(changes))
+            assert decoded == changes
+
+    def test_byte_flip_fuzz_raises_valueerror_only(self):
+        changes = fuzz_changes(4, iterations=40)
+        frame = bytearray(encode_frame(changes))
+        rng = random.Random(0)
+        flips = 0
+        for _ in range(400):
+            i = rng.randrange(len(frame))
+            old = frame[i]
+            frame[i] ^= 1 << rng.randrange(8)
+            try:
+                out = decode_frame(bytes(frame))
+                assert isinstance(out, list)
+            except ValueError:
+                flips += 1
+            finally:
+                frame[i] = old
+        assert flips > 0  # most flips must be detected
+
+    def test_truncated_and_giant_headers_rejected(self):
+        frame = encode_frame(fuzz_changes(5, iterations=10))
+        import struct as _struct
+
+        # blow up n_ints to something that would drive a giant allocation
+        hdr = list(_struct.Struct("<4sBIIQQ").unpack_from(frame))
+        hdr[4] = 1 << 40
+        bad = _struct.Struct("<4sBIIQQ").pack(*hdr) + frame[_struct.Struct("<4sBIIQQ").size:]
+        with pytest.raises(ValueError):
+            decode_frame(bad)
