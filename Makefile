@@ -1,0 +1,41 @@
+# Developer entry points (the reference's package.json scripts analog).
+# Tests and dryruns run on CPU with 8 virtual devices; bench targets the
+# real TPU when one is attached.
+
+PY ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test fuzz bench bench-smoke bench-streaming entry dryrun lint clean
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+fuzz:
+	$(CPU_ENV) $(PY) -m peritext_tpu.testing.fuzz
+
+bench:
+	$(PY) bench.py
+
+bench-smoke:
+	$(PY) bench.py --smoke
+
+bench-streaming:
+	$(PY) bench.py --mode streaming
+
+entry:
+	$(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); \
+	import __graft_entry__ as g; fn, a = g.entry(); \
+	jax.block_until_ready(jax.jit(fn)(*a)); print('entry OK')"
+
+dryrun:
+	$(CPU_ENV) $(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); \
+	import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# No linter is baked into the image; syntax-compile everything as a floor.
+# CI runs ruff with the config in pyproject.toml.
+lint:
+	$(PY) -m compileall -q peritext_tpu tests demos bench.py __graft_entry__.py
+
+clean:
+	rm -rf peritext_tpu/native/_build .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +
