@@ -166,7 +166,7 @@ def apply_batch(
     ins_ref, ins_op, ins_char, del_target, marks, mark_count = encoded_arrays
     impl = insert_impl
     if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "lax"
+        impl = resolve_insert_impl(state.elem_id)
     if impl in ("pallas", "pallas_interpret"):
         from .pallas_insert import insert_batch_pallas
 
@@ -197,6 +197,49 @@ def encoded_arrays_of(encoded: EncodedBatch):
     )
 
 
-apply_batch_jit = jax.jit(
+def resolve_insert_impl(*arrays, platform: str | None = None) -> str:
+    """Pick the insert-phase implementation for where the data actually lives.
+
+    ``jax.default_backend()`` alone is wrong on machines where a TPU plugin is
+    the default platform but the computation targets a CPU mesh (the driver's
+    multi-chip dry run uses ``--xla_force_host_platform_device_count`` virtual
+    CPU devices while a real TPU stays registered): Pallas TPU kernels cannot
+    lower for CPU.  So prefer the platform of the concrete input arrays'
+    shardings; tracers carry no devices, so under an outer jit fall back to
+    the default backend — callers jitting over a non-default mesh must pass
+    ``insert_impl`` explicitly.
+    """
+    if platform is None:
+        for a in arrays:
+            sharding = getattr(a, "sharding", None)
+            device_set = getattr(sharding, "device_set", None)
+            if device_set:
+                platform = next(iter(device_set)).platform
+                break
+    if platform is None:
+        platform = jax.default_backend()
+    return "pallas" if platform == "tpu" else "lax"
+
+
+_apply_batch_jit = jax.jit(
     apply_batch, static_argnames=("insert_impl", "insert_loop_slots")
 )
+
+
+def apply_batch_jit(
+    state: PackedDocs,
+    encoded_arrays,
+    *,
+    insert_impl: str = "auto",
+    insert_loop_slots: int | None = None,
+) -> PackedDocs:
+    """jit-compiled :func:`apply_batch`, resolving ``"auto"`` at the jit
+    boundary where input shardings are still observable."""
+    if insert_impl == "auto":
+        insert_impl = resolve_insert_impl(state.elem_id)
+    return _apply_batch_jit(
+        state,
+        encoded_arrays,
+        insert_impl=insert_impl,
+        insert_loop_slots=insert_loop_slots,
+    )

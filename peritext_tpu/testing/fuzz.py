@@ -204,3 +204,34 @@ def generate_workload(
             {actor: list(state.store.log(actor)) for actor in state.store.actors()}
         )
     return workloads
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    """CLI for ``make fuzz`` (the reference's ``npm run fuzz`` analog,
+    test/fuzz.ts:167 — but bounded by default and with real removeMark fuzzing)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="Peritext convergence fuzzer")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--iterations", type=int, default=2000)
+    parser.add_argument("--replicas", type=int, default=3)
+    parser.add_argument(
+        "--forever", action="store_true",
+        help="loop over fresh seeds until interrupted or a failure is found",
+    )
+    args = parser.parse_args(argv)
+
+    seed = args.seed
+    while True:
+        state = run_fuzz(seed, args.iterations, num_replicas=args.replicas)
+        print(
+            f"fuzz seed={seed}: {state.ops_generated} ops, "
+            f"{state.syncs} syncs, all convergence oracles passed"
+        )
+        if not args.forever:
+            break
+        seed += 1
+
+
+if __name__ == "__main__":
+    main()
