@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..core.doc import Doc
+from ..core.errors import IndexOutOfBounds, MissingObject
 from ..core.types import Change, FormatSpan
 from ..observability import GLOBAL_COUNTERS, MergeStats
 from ..ops.decode import decode_doc_spans
@@ -48,6 +49,9 @@ class MergeReport:
     device_ops: int = 0
     #: per-merge observability (stage timings, padding efficiency)
     stats: MergeStats = field(default_factory=MergeStats)
+    #: resolved cursor indices (aligned with merge()'s ``cursors`` argument);
+    #: -1 = cursor's element does not exist in the converged document
+    cursor_positions: Optional[List[List[int]]] = None
 
 
 class DocBatch:
@@ -118,8 +122,20 @@ class DocBatch:
             state = shard_docs(state, self.mesh)
         return self._apply(state, arrays)
 
-    def merge(self, workloads: Sequence[Workload]) -> MergeReport:
-        """Converge every workload; returns per-doc formatted spans."""
+    def merge(
+        self,
+        workloads: Sequence[Workload],
+        cursors: Optional[Sequence[Sequence[dict]]] = None,
+    ) -> MergeReport:
+        """Converge every workload; returns per-doc formatted spans.
+
+        ``cursors`` optionally gives, per document, stable cursors
+        (``{"objectId", "elemId"}``, the reference's ``Cursor`` shape,
+        src/micromerge.ts:859-870) to resolve against the converged state;
+        resolved visible indices land in ``MergeReport.cursor_positions``
+        (-1 when the cursor's element is absent).  Device docs resolve on
+        device (ops/resolve.resolve_cursors); fallback docs via the oracle.
+        """
         stats = MergeStats(docs=len(workloads))
         t0 = time.perf_counter()
         encoded = self.encode(workloads)
@@ -131,11 +147,11 @@ class DocBatch:
         stats.apply_seconds = time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        resolved = self._resolve(state, self.comment_capacity)
+        resolved_dev = self._resolve(state, self.comment_capacity)
         # One whole-array transfer per field, up front: decoding per doc on
         # the raw (possibly mesh-sharded) arrays would do 5 device gathers
         # per document.
-        resolved = type(resolved)(*(np.asarray(x) for x in resolved))
+        resolved = type(resolved_dev)(*(np.asarray(x) for x in resolved_dev))
         stats.resolve_seconds = time.perf_counter() - t0
 
         overflow = np.asarray(resolved.overflow)
@@ -143,13 +159,28 @@ class DocBatch:
             int(d) for d in np.nonzero(overflow)[0] if d < len(workloads)
         }
 
+        # Fallback docs may be replayed for both cursors and spans; build each
+        # oracle doc at most once per merge.
+        oracle_docs: Dict[int, Doc] = {}
+
+        def oracle_doc_for(d: int) -> Doc:
+            if d not in oracle_docs:
+                oracle_docs[d] = _oracle_doc(workloads[d])
+            return oracle_docs[d]
+
+        cursor_positions: Optional[List[List[int]]] = None
+        if cursors is not None:
+            cursor_positions = self._resolve_cursor_batch(
+                state, resolved_dev.visible, encoded, cursors, fallback, oracle_doc_for
+            )
+
         t0 = time.perf_counter()
         spans: List[List[FormatSpan]] = []
         device_ops = 0
         fallback_ops = 0
         for d, workload in enumerate(workloads):
             if d in fallback:
-                spans.append(_oracle_spans(workload))
+                spans.append(oracle_doc_for(d).get_text_with_formatting(["text"]))
                 fallback_ops += int(encoded.num_ops[d])
             else:
                 spans.append(decode_doc_spans(resolved, d, encoded.attr_tables[d]))
@@ -172,15 +203,67 @@ class DocBatch:
         GLOBAL_COUNTERS.add("merge.device_ops", device_ops)
         GLOBAL_COUNTERS.add("merge.fallback_docs", len(fallback))
         return MergeReport(
-            spans=spans, fallback_docs=sorted(fallback), device_ops=device_ops, stats=stats
+            spans=spans,
+            fallback_docs=sorted(fallback),
+            device_ops=device_ops,
+            stats=stats,
+            cursor_positions=cursor_positions,
         )
 
+    def _resolve_cursor_batch(
+        self, state, visible_dev, encoded, cursors, fallback, oracle_doc_for
+    ) -> List[List[int]]:
+        """Pack per-doc cursor element ids with each doc's actor table and
+        resolve them on device in one batched call; fallback docs replay
+        through the oracle."""
+        from ..ops.packed import MAX_CTR, pack_id
+        from ..ops.resolve import resolve_cursors_jit
 
-def _oracle_spans(workload: Workload) -> List[FormatSpan]:
+        num_docs = state.elem_id.shape[0]
+        # Bucket the cursor-axis width to a power of two so varying cursor
+        # counts across merge() calls reuse one compiled program.
+        needed = max([len(c) for c in cursors] + [1])
+        width = 4
+        while width < needed:
+            width *= 2
+        cursor_elem = np.zeros((num_docs, width), np.int32)
+        for d, doc_cursors in enumerate(cursors):
+            if d in fallback:
+                continue
+            actors = encoded.actor_tables[d]
+            for j, cur in enumerate(doc_cursors):
+                ctr, actor = cur["elemId"]
+                idx = actors.get(actor)
+                if idx is not None and ctr <= MAX_CTR:
+                    cursor_elem[d, j] = pack_id(ctr, idx)
+        positions = np.asarray(
+            resolve_cursors_jit(state, visible_dev, cursor_elem)
+        )
+        out: List[List[int]] = []
+        for d, doc_cursors in enumerate(cursors):
+            if d in fallback:
+                doc = oracle_doc_for(d)
+                row = []
+                for cur in doc_cursors:
+                    try:
+                        row.append(doc.resolve_cursor(cur))
+                    except (IndexOutOfBounds, MissingObject):
+                        row.append(-1)  # device semantics: absent element -> -1
+                out.append(row)
+            else:
+                out.append([int(p) for p in positions[d, : len(doc_cursors)]])
+        return out
+
+
+def _oracle_doc(workload: Workload) -> Doc:
     doc = Doc("batch-fallback")
     for change in causal_sort([ch for log in workload.values() for ch in log]):
         doc.apply_change(change)
-    return doc.get_text_with_formatting(["text"])
+    return doc
+
+
+def _oracle_spans(workload: Workload) -> List[FormatSpan]:
+    return _oracle_doc(workload).get_text_with_formatting(["text"])
 
 
 def oracle_merge(workloads: Sequence[Workload]) -> List[List[FormatSpan]]:

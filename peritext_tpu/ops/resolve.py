@@ -203,3 +203,34 @@ def resolve(state: PackedDocs, comment_capacity: int = 32) -> ResolvedDocs:
 
 
 resolve_jit = jax.jit(resolve, static_argnums=1)
+
+
+def resolve_cursors(state: PackedDocs, visible, cursor_elem):
+    """Batched stable-cursor resolution.
+
+    Reference ``resolveCursor`` (src/micromerge.ts:868-870) returns
+    ``findListElement(elemId).visible`` — the count of visible elements
+    strictly before the cursor's element in metadata order, which collapses
+    the cursor leftward when its anchor character has been deleted
+    (src/micromerge.ts:1304-1328; tests test/micromerge.ts:1291-1418).
+
+    ``cursor_elem`` is (D, C) packed element ids, 0 = padding; ``visible`` is
+    the (D, S) visibility plane from :func:`resolve`.  Returns (D, C) int32
+    visible indices, -1 for padding or element ids absent from the doc.
+    """
+
+    def one(elem_id, n, vis, cur):
+        s_cap = elem_id.shape[0]
+        pos = jnp.arange(s_cap, dtype=jnp.int32)
+        match = (elem_id[None, :] == cur[:, None]) & (pos[None, :] < n)  # (C, S)
+        found = jnp.any(match, axis=1)
+        p = jnp.argmax(match, axis=1).astype(jnp.int32)
+        before = jnp.sum(
+            vis[None, :] & (pos[None, :] < p[:, None]), axis=1
+        ).astype(jnp.int32)
+        return jnp.where((cur != 0) & found, before, jnp.int32(-1))
+
+    return jax.vmap(one)(state.elem_id, state.num_slots, visible, cursor_elem)
+
+
+resolve_cursors_jit = jax.jit(resolve_cursors)

@@ -206,9 +206,58 @@ def generate_workload(
     return workloads
 
 
+def run_differential(
+    seed: int, num_docs: int, ops_per_doc: int, batch=None, cursors_per_doc: int = 4
+) -> int:
+    """Device-vs-oracle differential round: generate ``num_docs`` fuzz
+    workloads, converge them through the batched device path AND the scalar
+    oracle, and assert identical spans plus identical resolved cursors.
+    Returns the number of device-resolved docs (0 would mean the batch config
+    routed everything to fallback — a test-setup bug, so it raises)."""
+    import random
+
+    from ..api.batch import DocBatch, _oracle_doc
+
+    if batch is None:
+        batch = DocBatch(slot_capacity=512, mark_capacity=128, comment_capacity=32)
+    workloads = generate_workload(seed, num_docs=num_docs, ops_per_doc=ops_per_doc)
+
+    rng = random.Random(seed ^ 0x5EED)
+    oracle_docs = [_oracle_doc(w) for w in workloads]
+    cursors = []
+    for doc in oracle_docs:
+        n = sum(len(span["text"]) for span in doc.get_text_with_formatting(["text"]))
+        indices = [rng.randrange(n) for _ in range(cursors_per_doc)] if n else []
+        cursors.append([doc.get_cursor(["text"], i) for i in indices])
+
+    report = batch.merge(workloads, cursors=cursors)
+    for d, doc in enumerate(oracle_docs):
+        expected = doc.get_text_with_formatting(["text"])
+        assert report.spans[d] == expected, (
+            f"seed={seed} doc={d}: device spans diverge from oracle\n"
+            f"device: {report.spans[d]}\noracle: {expected}"
+        )
+        expected_cursors = [doc.resolve_cursor(c) for c in cursors[d]]
+        got = report.cursor_positions[d]
+        assert got == expected_cursors, (
+            f"seed={seed} doc={d}: cursor positions diverge: "
+            f"device {got} != oracle {expected_cursors}"
+        )
+    device_docs = num_docs - len(report.fallback_docs)
+    if num_docs and device_docs == 0:
+        raise RuntimeError(
+            f"seed={seed}: every doc fell back to the oracle; raise capacities"
+        )
+    return device_docs
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     """CLI for ``make fuzz`` (the reference's ``npm run fuzz`` analog,
-    test/fuzz.ts:167 — but bounded by default and with real removeMark fuzzing)."""
+    test/fuzz.ts:167 — but bounded by default and with real removeMark fuzzing).
+
+    ``--differential`` switches to device-vs-oracle differential fuzzing:
+    each round converges a fresh batch of fuzz workloads through the batched
+    TPU path and asserts span + cursor equality against the scalar oracle."""
     import argparse
 
     parser = argparse.ArgumentParser(description="Peritext convergence fuzzer")
@@ -216,18 +265,41 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument("--iterations", type=int, default=2000)
     parser.add_argument("--replicas", type=int, default=3)
     parser.add_argument(
+        "--differential", action="store_true",
+        help="fuzz the batched device path against the scalar oracle",
+    )
+    parser.add_argument("--docs", type=int, default=32, help="docs per differential round")
+    parser.add_argument(
+        "--ops-per-doc", type=int, default=160, help="ops per doc per differential round"
+    )
+    parser.add_argument(
         "--forever", action="store_true",
         help="loop over fresh seeds until interrupted or a failure is found",
     )
     args = parser.parse_args(argv)
 
+    batch = None
+    if args.differential:
+        from ..api.batch import DocBatch
+
+        batch = DocBatch(slot_capacity=512, mark_capacity=128, comment_capacity=32)
+
     seed = args.seed
     while True:
-        state = run_fuzz(seed, args.iterations, num_replicas=args.replicas)
-        print(
-            f"fuzz seed={seed}: {state.ops_generated} ops, "
-            f"{state.syncs} syncs, all convergence oracles passed"
-        )
+        if args.differential:
+            device_docs = run_differential(
+                seed, args.docs, args.ops_per_doc, batch=batch
+            )
+            print(
+                f"differential seed={seed}: {args.docs} docs x {args.ops_per_doc} ops "
+                f"({device_docs} on device) match the oracle", flush=True,
+            )
+        else:
+            state = run_fuzz(seed, args.iterations, num_replicas=args.replicas)
+            print(
+                f"fuzz seed={seed}: {state.ops_generated} ops, "
+                f"{state.syncs} syncs, all convergence oracles passed", flush=True,
+            )
         if not args.forever:
             break
         seed += 1

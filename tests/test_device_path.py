@@ -139,3 +139,69 @@ def test_change_queue_backoff_on_persistent_failure():
     q.drop()
     assert errors  # reported, not leaked into the timer thread
     assert len(q) == 1  # change retained for when the network returns
+
+
+# -- device-side cursor resolution (reference getCursor/resolveCursor,
+# src/micromerge.ts:859-870; stability tests test/micromerge.ts:1291-1418) --
+
+
+def test_cursor_resolution_matches_oracle(batch):
+    from peritext_tpu.testing.fuzz import run_differential
+
+    # run_differential itself asserts span AND cursor equality per doc
+    assert run_differential(seed=42, num_docs=10, ops_per_doc=60, batch=batch) > 0
+    assert run_differential(seed=99, num_docs=6, ops_per_doc=100, batch=batch) > 0
+
+
+def test_cursor_collapses_left_over_deleted_anchor(batch):
+    from peritext_tpu.api.batch import _oracle_doc
+
+    docs, _, initial = generate_docs("abcdef", 2)
+    d1, d2 = docs
+    cursor = d1.get_cursor(["text"], 3)  # anchored on 'd'
+    # concurrently: d1 deletes the cursor char itself, d2 deletes before it
+    c1, _ = d1.change([{"path": ["text"], "action": "delete", "index": 3, "count": 1}])
+    c2, _ = d2.change([{"path": ["text"], "action": "delete", "index": 0, "count": 2}])
+    workload = {"doc1": [initial, c1], "doc2": [c2]}
+    report = batch.merge([workload], cursors=[[cursor]])
+    assert report.fallback_docs == []
+    expected = _oracle_doc(workload).resolve_cursor(cursor)
+    assert report.cursor_positions == [[expected]]
+    assert expected == 1  # "cf" remains; cursor collapsed onto 'f' index 1
+
+
+def test_cursor_moves_with_concurrent_insert_before(batch):
+    from peritext_tpu.api.batch import _oracle_doc
+
+    docs, _, initial = generate_docs("abc", 2)
+    d1, d2 = docs
+    cursor = d1.get_cursor(["text"], 2)  # anchored on 'c'
+    c2, _ = d2.change(
+        [{"path": ["text"], "action": "insert", "index": 0, "values": list("xy")}]
+    )
+    workload = {"doc1": [initial], "doc2": [c2]}
+    report = batch.merge([workload], cursors=[[cursor]])
+    expected = _oracle_doc(workload).resolve_cursor(cursor)
+    assert report.cursor_positions == [[expected]]
+    assert expected == 4
+
+
+def test_cursor_on_fallback_doc_resolves_via_oracle():
+    from peritext_tpu.api.batch import _oracle_doc
+
+    tiny = DocBatch(slot_capacity=8, mark_capacity=8, comment_capacity=4, op_capacity=64)
+    docs, _, initial = generate_docs("overflowing text", 1)  # > 8 slots
+    (d1,) = docs
+    cursor = d1.get_cursor(["text"], 5)
+    workload = {"doc1": [initial]}
+    report = tiny.merge([workload], cursors=[[cursor]])
+    assert report.fallback_docs == [0]
+    assert report.cursor_positions == [[_oracle_doc(workload).resolve_cursor(cursor)]]
+
+
+def test_cursor_for_unknown_element_is_minus_one(batch):
+    docs, _, initial = generate_docs("abc", 1)
+    workload = {"doc1": [initial]}
+    bogus = {"objectId": (1, "doc1"), "elemId": (999, "nowhere")}
+    report = batch.merge([workload], cursors=[[bogus]])
+    assert report.cursor_positions == [[-1]]
