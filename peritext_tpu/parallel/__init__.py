@@ -4,6 +4,7 @@ and (device) mesh sharding of the document axis."""
 from .anti_entropy import ChangeStore, apply_changes, get_missing_changes, sync
 from .causal import causal_sort, causal_waves
 from .change_queue import ChangeQueue
+from .multihost import ReplicaServer, merge_changes, sync_with
 from .pubsub import Publisher
 
 __all__ = [
@@ -15,4 +16,7 @@ __all__ = [
     "causal_waves",
     "ChangeQueue",
     "Publisher",
+    "ReplicaServer",
+    "merge_changes",
+    "sync_with",
 ]

@@ -1,0 +1,245 @@
+"""Multi-host replication transport: vector-clock anti-entropy over TCP.
+
+The reference's replication never leaves one process — ``Publisher`` is an
+in-memory fan-out (reference src/pubsub.ts:4-25) and the anti-entropy clock
+diff runs between two in-process replicas (test/merge.ts:25-38).  This module
+is the multi-host equivalent: each host exposes its append-only
+:class:`~.anti_entropy.ChangeStore` on a TCP endpoint, and one
+``sync_with`` round performs a full bidirectional anti-entropy exchange —
+frontiers are swapped, and each side ships exactly the changes the other is
+missing, packed as binary codec frames (:mod:`.codec`, the DCN wire format).
+
+Division of labour with the device path: this transport only converges the
+*change logs* across hosts (cheap, irregular, host-side).  Each host then
+feeds its converged logs to its own device mesh via the normal batched path
+(api.DocBatch / parallel.streaming) — cross-host traffic rides DCN once per
+change, while all per-op work stays on the chips.
+
+Protocol (all messages length-prefixed: 4-byte big-endian length, 1-byte
+type, body):
+
+* ``F`` frontier — JSON vector clock ``{actor: seq}``.
+* ``C`` changes  — one binary codec frame.
+
+Exchange, from the client's side::
+
+    connect -> send F(mine) -> recv C(what I lack) + F(theirs)
+            -> send C(what they lack) -> close
+
+Both sides merge with :func:`merge_changes`, which tolerates duplicates and
+out-of-order arrival (per-actor seq ordering restores log order), so repeated
+or concurrent syncs against many peers are safe — the store is a CRDT of
+append-only logs.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from ..core.errors import PeritextError
+from ..core.types import Change, Clock
+from .anti_entropy import ChangeStore
+from .codec import decode_frame, encode_frame
+
+_LEN = struct.Struct(">I")
+_MAX_MESSAGE = 1 << 28  # 256 MiB: far above any sane frame, guards corrupt peers
+
+MSG_FRONTIER = b"F"
+MSG_CHANGES = b"C"
+
+
+# -- framing ----------------------------------------------------------------
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _send_message(sock: socket.socket, kind: bytes, body: bytes) -> None:
+    sock.sendall(_LEN.pack(len(body) + 1) + kind + body)
+
+
+def _recv_message(sock: socket.socket) -> Tuple[bytes, bytes]:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if not 1 <= length <= _MAX_MESSAGE:
+        raise ConnectionError(f"bad message length {length}")
+    payload = _recv_exact(sock, length)
+    return payload[:1], payload[1:]
+
+
+def _send_frontier(sock: socket.socket, clock: Clock) -> None:
+    _send_message(sock, MSG_FRONTIER, json.dumps(clock).encode("utf-8"))
+
+
+def _parse_frontier(body: bytes) -> Clock:
+    """Decode and validate a frontier message: must be ``{actor: seq}`` with
+    string keys and int seqs — anything else is a protocol error, normalized
+    to ValueError so both endpoints' error contracts stay uniform."""
+    try:
+        clock = json.loads(body)
+    except json.JSONDecodeError as exc:
+        raise ValueError(f"bad frontier: {exc}") from exc
+    if not isinstance(clock, dict) or not all(
+        isinstance(k, str) and isinstance(v, int) and not isinstance(v, bool)
+        for k, v in clock.items()
+    ):
+        raise ValueError("bad frontier: expected {actor: seq}")
+    return clock
+
+
+def _expect(sock: socket.socket, expected: bytes) -> bytes:
+    kind, body = _recv_message(sock)
+    if kind != expected:
+        raise ConnectionError(f"expected message {expected!r}, got {kind!r}")
+    return body
+
+
+# -- store merge ------------------------------------------------------------
+
+
+def merge_changes(store: ChangeStore, changes: List[Change]) -> List[Change]:
+    """Merge remotely-received changes into ``store``; returns the changes
+    that were actually new.  Duplicates (seq already present) are skipped;
+    per-actor seq sorting restores append order, so arbitrary arrival order
+    is fine as long as each actor's suffix is contiguous — which the clock
+    diff guarantees (reference getMissingChanges ships ``log[have:seq]``)."""
+    fresh: List[Change] = []
+    for change in sorted(changes, key=lambda c: (c.actor, c.seq)):
+        have = len(store.log(change.actor))
+        if change.seq <= have:
+            continue  # duplicate from a concurrent sync
+        store.append(change)  # raises on a genuine gap
+        fresh.append(change)
+    return fresh
+
+
+# -- server -----------------------------------------------------------------
+
+
+class ReplicaServer:
+    """Serves one host's ChangeStore for anti-entropy pulls from peers.
+
+    ``on_changes`` (optional) is invoked with each batch of newly-merged
+    remote changes — the hook where a host forwards fresh changes into its
+    device pipeline (e.g. ``StreamingMerge.ingest``).  It runs on the
+    connection-handler thread; keep it quick or hand off to a queue.
+    """
+
+    def __init__(
+        self,
+        store: ChangeStore,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        on_changes: Optional[Callable[[List[Change]], None]] = None,
+    ) -> None:
+        self.store = store
+        self.on_changes = on_changes
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen()
+        self.address: Tuple[str, int] = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> Tuple[str, int]:
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+        return self.address
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # internals
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed by stop()
+            threading.Thread(
+                target=self._serve_one, args=(conn,), daemon=True
+            ).start()
+
+    def sync_with(self, host: str, port: int, timeout: float = 30.0) -> Tuple[int, int]:
+        """Outbound anti-entropy round sharing this server's store lock, so a
+        node that serves peers and pulls from peers concurrently stays
+        consistent."""
+        return sync_with(
+            self.store, host, port,
+            on_changes=self.on_changes, timeout=timeout, lock=self._lock,
+        )
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.settimeout(30)
+                peer_clock = _parse_frontier(_expect(conn, MSG_FRONTIER))
+                with self._lock:
+                    my_clock = self.store.clock()
+                    outbound = self.store.missing_changes(my_clock, peer_clock)
+                _send_message(conn, MSG_CHANGES, encode_frame(outbound))
+                _send_frontier(conn, my_clock)
+                inbound = decode_frame(_expect(conn, MSG_CHANGES))
+                with self._lock:
+                    fresh = merge_changes(self.store, inbound)
+                if fresh and self.on_changes is not None:
+                    self.on_changes(fresh)
+        except (ConnectionError, ValueError, OSError, PeritextError):
+            # a bad peer (bad framing, corrupt frame, malformed frontier, or a
+            # change batch with log gaps) must not take the server down
+            return
+
+
+# -- client -----------------------------------------------------------------
+
+
+def sync_with(
+    store: ChangeStore,
+    host: str,
+    port: int,
+    on_changes: Optional[Callable[[List[Change]], None]] = None,
+    timeout: float = 30.0,
+    lock: Optional[threading.Lock] = None,
+) -> Tuple[int, int]:
+    """One full bidirectional anti-entropy round against a peer.
+
+    Returns ``(pulled, pushed)`` change counts.  Raises ConnectionError /
+    ValueError on transport or frame corruption (the caller retries; the
+    store is never left partially inconsistent because logs are append-only
+    and merge_changes skips duplicates).  Pass ``lock`` when other threads
+    (e.g. a ReplicaServer on the same store) mutate the store concurrently.
+    """
+    lock = lock or threading.Lock()
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        with lock:
+            my_clock = store.clock()
+        _send_frontier(sock, my_clock)
+        inbound = decode_frame(_expect(sock, MSG_CHANGES))
+        peer_clock = _parse_frontier(_expect(sock, MSG_FRONTIER))
+        with lock:
+            outbound = store.missing_changes(store.clock(), peer_clock)
+        _send_message(sock, MSG_CHANGES, encode_frame(outbound))
+    with lock:
+        fresh = merge_changes(store, inbound)
+    if fresh and on_changes is not None:
+        on_changes(fresh)
+    return len(fresh), len(outbound)

@@ -130,13 +130,16 @@ class TestCommentModel:
 
 
 class TestDemoScripts:
-    @pytest.mark.parametrize("script", ["demos/two_editors.py", "demos/essay_demo.py"])
+    @pytest.mark.parametrize(
+        "script",
+        ["demos/two_editors.py", "demos/essay_demo.py", "demos/multihost_demo.py"],
+    )
     def test_demo_runs_clean(self, script):
         proc = subprocess.run(
             [sys.executable, str(REPO / script)],
             capture_output=True,
             text=True,
-            timeout=120,
+            timeout=240,
             cwd=REPO,
         )
         assert proc.returncode == 0, proc.stderr
