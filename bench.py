@@ -145,11 +145,17 @@ def run(args) -> dict:
 
 
 def run_streaming(args) -> dict:
-    """BASELINE config 5: multi-round streaming merge on carried device state."""
+    """BASELINE config 5: multi-round streaming merge on carried device state.
+
+    Arrival batches are pre-encoded as binary wire frames (what a host
+    actually receives over DCN, parallel/codec.py); ingestion takes the
+    frame-native fast path (C++ parse + vectorized schedule/split,
+    ops/frames.py) unless --object-ingest forces the Python object path."""
     import jax
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    from peritext_tpu.parallel.codec import encode_frame
     from peritext_tpu.parallel.streaming import StreamingMerge
     from peritext_tpu.testing.fuzz import generate_workload
 
@@ -166,7 +172,10 @@ def run_streaming(args) -> dict:
         changes = [ch for log in w.values() for ch in log]
         rng.shuffle(changes)
         size = -(-len(changes) // rounds)
-        arrival.append([changes[i : i + size] for i in range(0, len(changes), size)])
+        batches = [changes[i : i + size] for i in range(0, len(changes), size)]
+        if not args.object_ingest:
+            batches = [encode_frame(b) for b in batches]
+        arrival.append(batches)
 
     def session():
         return StreamingMerge(
@@ -180,21 +189,28 @@ def run_streaming(args) -> dict:
             round_mark_capacity=128,
         )
 
+    def feed(s, doc, batch):
+        if args.object_ingest:
+            s.ingest(doc, batch)
+        else:
+            s.ingest_frame(doc, batch)
+
     # warmup compile
     s = session()
     for r in range(rounds):
         for doc, batches in enumerate(arrival):
             if r < len(batches):
-                s.ingest(doc, batches[r])
+                feed(s, doc, batches[r])
         s.drain()
     digest0 = s.digest()
+    fallbacks = sum(1 for sess in s.docs if sess.fallback)
 
     t0 = time.perf_counter()
     s = session()
     for r in range(rounds):
         for doc, batches in enumerate(arrival):
             if r < len(batches):
-                s.ingest(doc, batches[r])
+                feed(s, doc, batches[r])
         s.drain()
     digest = s.digest()  # sync point
     elapsed = time.perf_counter() - t0
@@ -214,6 +230,8 @@ def run_streaming(args) -> dict:
         "docs": d,
         "rounds": rounds,
         "ops_per_doc": args.ops_per_doc,
+        "ingest": "objects" if args.object_ingest else "frames",
+        "fallback_docs": fallbacks,
         "workload_gen_seconds": round(gen_time, 1),
         "wall_seconds": round(elapsed, 3),
         "platform": jax.devices()[0].platform,
@@ -230,6 +248,10 @@ def main() -> None:
         help="batch = one-shot converge (configs 2-4); streaming = config 5",
     )
     parser.add_argument("--rounds", type=int, default=4, help="streaming arrival rounds")
+    parser.add_argument(
+        "--object-ingest", action="store_true",
+        help="streaming: force the Python object ingest path (default: wire frames)",
+    )
     parser.add_argument("--docs", type=int, default=None)
     parser.add_argument("--ops-per-doc", type=int, default=None)
     parser.add_argument("--slots", type=int, default=None)

@@ -82,6 +82,29 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pt_varint_encode.argtypes = [i32p, ctypes.c_int64, u8p, ctypes.c_int64]
         lib.pt_varint_decode.restype = ctypes.c_int64
         lib.pt_varint_decode.argtypes = [u8p, ctypes.c_int64, i32p, ctypes.c_int64]
+        lib.pt_parse_changes.restype = ctypes.c_int32
+        lib.pt_parse_changes.argtypes = [
+            i32p, ctypes.c_int64, ctypes.c_int32,  # vals, n_vals, n_changes
+            i32p, ctypes.c_int32,  # str2actor, n_strings
+            ctypes.c_int32, ctypes.c_int32,  # actor_bits, max_ctr
+            i32p, i32p,  # ch_actor, ch_seq
+            i32p, i32p, i32p, ctypes.c_int64,  # dep_off, dep_actor, dep_seq, dep_cap
+            i32p, i32p, ctypes.c_int64,  # ops_off, ops, op_cap
+            i32p, i32p, i32p,  # cnt_ins, cnt_del, cnt_mark
+        ]
+        lib.pt_schedule_split_batch.restype = ctypes.c_int32
+        lib.pt_schedule_split_batch.argtypes = (
+            [ctypes.c_int32, ctypes.c_int32]  # n_docs, n_actors
+            + [i32p] * 3  # ch_off, doc_row, text_obj
+            + [i32p] * 2  # ch_actor, ch_seq
+            + [i32p] * 3  # dep_off, dep_actor, dep_seq
+            + [i32p] * 2  # ops_off, ops
+            + [i32p]  # clock
+            + [ctypes.c_int32] * 3  # ki, kd, km
+            + [i32p] * 12  # ins x3, del, marks x8
+            + [i32p] * 4  # n_ins, n_del, n_mark, n_admitted
+            + [u8p] * 2  # admitted, status
+        )
         _lib = lib
         return _lib
 
@@ -117,6 +140,107 @@ def causal_schedule_indices(
         out,
     )
     return out[:count]
+
+
+def parse_changes(
+    values: np.ndarray,
+    n_changes: int,
+    str2actor: np.ndarray,
+    actor_bits: int,
+    max_ctr: int,
+):
+    """Native frame-payload parse (see pt_parse_changes in native.cpp).
+
+    Returns ``(ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops,
+    cnt_ins, cnt_del, cnt_mark)`` with ``ops`` shaped (n_ops, 10), or None
+    when the native library is unavailable.  Raises ValueError on a
+    malformed payload.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, np.int32)
+    str2actor = np.ascontiguousarray(str2actor, np.int32)
+    n = int(n_changes)
+    dep_cap = int(values.size) // 2 + 1
+    op_cap = int(values.size) // 2 + 1
+    ch_actor = np.empty(n, np.int32)
+    ch_seq = np.empty(n, np.int32)
+    dep_off = np.empty(n + 1, np.int32)
+    dep_actor = np.empty(dep_cap, np.int32)
+    dep_seq = np.empty(dep_cap, np.int32)
+    ops_off = np.empty(n + 1, np.int32)
+    ops = np.empty((op_cap, 10), np.int32)
+    cnt_ins = np.empty(n, np.int32)
+    cnt_del = np.empty(n, np.int32)
+    cnt_mark = np.empty(n, np.int32)
+    rc = lib.pt_parse_changes(
+        values, int(values.size), n,
+        str2actor, int(str2actor.size),
+        int(actor_bits), int(max_ctr),
+        ch_actor, ch_seq,
+        dep_off, dep_actor, dep_seq, dep_cap,
+        ops_off, ops.reshape(-1), op_cap,
+        cnt_ins, cnt_del, cnt_mark,
+    )
+    if rc != 0:
+        raise ValueError(f"malformed change frame payload (native rc={rc})")
+    n_deps = int(dep_off[n])
+    n_ops = int(ops_off[n])
+    return (
+        ch_actor, ch_seq,
+        dep_off, dep_actor[:n_deps].copy(), dep_seq[:n_deps].copy(),
+        ops_off, ops[:n_ops].copy(),
+        cnt_ins, cnt_del, cnt_mark,
+    )
+
+
+def schedule_split_batch(
+    n_actors: int,
+    ch_off: np.ndarray,
+    doc_row: np.ndarray,
+    text_obj: np.ndarray,
+    parsed_cols,  # (ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops)
+    clock: np.ndarray,  # (n_docs, n_actors) int32, updated in place
+    caps,  # (ki, kd, km)
+    ins_arrays,  # (ins_ref, ins_op, ins_char) each (D, ki) int32
+    del_array: np.ndarray,  # (D, kd)
+    mark_arrays,  # dict of 8 (D, km) arrays in MARK_COLS order
+):
+    """One-call round scheduling for every frame-mode doc (see
+    pt_schedule_split_batch).  Returns ``(total, n_ins, n_del, n_mark,
+    n_admitted, admitted, status)`` or None when no native library."""
+    lib = load()
+    if lib is None:
+        return None
+    n_docs = int(ch_off.shape[0]) - 1
+    ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops = parsed_cols
+    n_changes = int(ch_actor.shape[0])
+    n_ins = np.zeros(n_docs, np.int32)
+    n_del = np.zeros(n_docs, np.int32)
+    n_mark = np.zeros(n_docs, np.int32)
+    n_admitted = np.zeros(n_docs, np.int32)
+    admitted = np.zeros(n_changes, np.uint8)
+    status = np.zeros(n_docs, np.uint8)
+    c = lambda a: np.ascontiguousarray(a, np.int32)  # noqa: E731
+    total = lib.pt_schedule_split_batch(
+        n_docs, int(n_actors),
+        c(ch_off), c(doc_row), c(text_obj),
+        c(ch_actor), c(ch_seq),
+        c(dep_off), c(dep_actor), c(dep_seq),
+        c(ops_off), c(ops).reshape(-1),
+        clock,
+        int(caps[0]), int(caps[1]), int(caps[2]),
+        ins_arrays[0], ins_arrays[1], ins_arrays[2],
+        del_array,
+        mark_arrays["m_action"], mark_arrays["m_type"],
+        mark_arrays["m_start_kind"], mark_arrays["m_start_elem"],
+        mark_arrays["m_end_kind"], mark_arrays["m_end_elem"],
+        mark_arrays["m_op"], mark_arrays["m_attr"],
+        n_ins, n_del, n_mark, n_admitted,
+        admitted, status,
+    )
+    return total, n_ins, n_del, n_mark, n_admitted, admitted, status
 
 
 def varint_encode(values: np.ndarray) -> Optional[bytes]:

@@ -16,6 +16,7 @@
 // Plain C ABI throughout: the Python side binds with ctypes (no pybind11 in
 // the image), and everything crossing the boundary is int32/uint8 arrays.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <queue>
@@ -146,6 +147,295 @@ int64_t pt_varint_decode(const uint8_t* in, int64_t nbytes, int32_t* out,
         out[count++] = static_cast<int32_t>((z >> 1) ^ (~(z & 1) + 1));
     }
     return count;
+}
+
+// ---------------------------------------------------------------------------
+// pt_parse_changes — the frame-native ingest fast path.
+//
+// Walks a binary change-frame's decoded int payload (the exact layout
+// written by peritext_tpu/parallel/codec.py::encode_frame) straight into
+// (a) per-change metadata arrays and (b) a uniform 10-column op matrix in
+// device-packed identifier form, skipping Python Change objects entirely.
+// Everything downstream (causal budget, stream splitting, padding) is then
+// vectorizable numpy on these arrays.
+//
+// Column layout of ops[row*10 + c] (kinds: 0 insert, 1 delete, 2 mark,
+// 3 json-spillover, 4 unsupported/undeclared):
+//   c0 kind
+//   c1 obj id, packed (ctr << actor_bits | actor); -1 = ROOT, 0 = n/a
+//   c2 op id, packed
+//   c3 insert: ref elem packed (0 = HEAD) | delete: target elem packed
+//      | mark: action (1 add, 2 remove)   | json: string-table index
+//   c4 insert: codepoint | mark: mark-type index
+//   c5 mark: start boundary kind (0 before, 1 after, 2 startOf, 3 endOf)
+//   c6 mark: start elem packed (0 = none)
+//   c7 mark: end boundary kind
+//   c8 mark: end elem packed
+//   c9 mark: attr string-table index + 1 (0 = none)
+//
+// str2actor maps frame string-table indices to *declared* actor-table
+// indices (-1 = string is not a declared actor): identifier packing must
+// use the session's stable actor numbering, not frame-local order.
+//
+// Returns 0 on success; -1 malformed payload; -2 dep capacity; -3 op
+// capacity.  A change whose actor is undeclared gets ch_actor[i] = -1 and
+// all its ops marked kind 4 (the caller demotes the doc to the object
+// path); an op with an undeclared actor or an over-wide counter is kind 4.
+int32_t pt_parse_changes(
+    const int32_t* vals, int64_t n_vals, int32_t n_changes,
+    const int32_t* str2actor, int32_t n_strings,
+    int32_t actor_bits, int32_t max_ctr,
+    int32_t* ch_actor, int32_t* ch_seq,
+    int32_t* dep_off, int32_t* dep_actor, int32_t* dep_seq, int64_t dep_cap,
+    int32_t* ops_off, int32_t* ops, int64_t op_cap,
+    int32_t* cnt_ins, int32_t* cnt_del, int32_t* cnt_mark) {
+    int64_t p = 0;       // cursor into vals
+    int64_t nd = 0;      // deps written
+    int64_t no = 0;      // op rows written
+    dep_off[0] = 0;
+    ops_off[0] = 0;
+
+    auto take = [&](int64_t k) -> const int32_t* {
+        if (p + k > n_vals) return nullptr;
+        const int32_t* ptr = vals + p;
+        p += k;
+        return ptr;
+    };
+    auto actor_of = [&](int32_t strid) -> int32_t {
+        if (strid < 0 || strid >= n_strings) return -2;  // malformed
+        return str2actor[strid];
+    };
+    // pack an opid pair; returns 0 with *bad set when unsupported
+    auto pack = [&](int32_t ctr, int32_t strid, bool* bad) -> int32_t {
+        int32_t a = actor_of(strid);
+        if (a == -2) { *bad = true; return 0; }
+        if (a < 0 || ctr < 0 || ctr > max_ctr) { *bad = true; return 0; }
+        return (ctr << actor_bits) | a;
+    };
+
+    for (int32_t c = 0; c < n_changes; ++c) {
+        const int32_t* h = take(4);  // actor, seq, start_op, n_deps
+        if (!h) return -1;
+        int32_t a = actor_of(h[0]);
+        if (a == -2) return -1;
+        ch_actor[c] = a;  // may be -1: undeclared actor, caller demotes
+        ch_seq[c] = h[1];
+        int32_t ndeps = h[3];
+        if (ndeps < 0) return -1;
+        for (int32_t d = 0; d < ndeps; ++d) {
+            const int32_t* dp = take(2);
+            if (!dp) return -1;
+            int32_t da = actor_of(dp[0]);
+            if (da == -2) return -1;
+            if (da < 0) { ch_actor[c] = -1; continue; }  // dep on undeclared
+            if (nd >= dep_cap) return -2;
+            dep_actor[nd] = da;
+            dep_seq[nd] = dp[1];
+            ++nd;
+        }
+        dep_off[c + 1] = static_cast<int32_t>(nd);
+
+        const int32_t* nop = take(1);
+        if (!nop) return -1;
+        int32_t nops = nop[0];
+        if (nops < 0) return -1;
+        int32_t ci = 0, cd = 0, cm = 0;
+        for (int32_t k = 0; k < nops; ++k) {
+            if (no >= op_cap) return -3;
+            int32_t* row = ops + no * 10;
+            for (int i = 0; i < 10; ++i) row[i] = 0;
+            const int32_t* kindp = take(1);
+            if (!kindp) return -1;
+            int32_t kind = *kindp;
+            bool bad = (ch_actor[c] < 0);
+            if (kind == 4) {  // JSON spillover: [strid]
+                const int32_t* b = take(1);
+                if (!b) return -1;
+                if (b[0] < 0 || b[0] >= n_strings) return -1;
+                row[0] = 3;
+                row[3] = b[0];
+            } else if (kind == 0) {  // insert: obj(3) opid(2) ref(3) char
+                const int32_t* b = take(9);
+                if (!b) return -1;
+                row[0] = 0;
+                row[1] = b[0] == 0 ? -1 : pack(b[1], b[2], &bad);
+                row[2] = pack(b[3], b[4], &bad);
+                row[3] = b[5] == 0 ? 0 : pack(b[6], b[7], &bad);
+                row[4] = b[8];
+                ++ci;
+            } else if (kind == 1) {  // delete: obj(3) opid(2) elem(2)
+                const int32_t* b = take(7);
+                if (!b) return -1;
+                row[0] = 1;
+                row[1] = b[0] == 0 ? -1 : pack(b[1], b[2], &bad);
+                row[2] = pack(b[3], b[4], &bad);
+                row[3] = pack(b[5], b[6], &bad);
+                ++cd;
+            } else if (kind == 2 || kind == 3) {
+                // mark: obj(3) opid(2) mtype s(3) e(3) attr
+                const int32_t* b = take(13);
+                if (!b) return -1;
+                if (b[6] < 0 || b[6] > 3 || b[9] < 0 || b[9] > 3) return -1;
+                row[0] = 2;
+                row[1] = b[0] == 0 ? -1 : pack(b[1], b[2], &bad);
+                row[2] = pack(b[3], b[4], &bad);
+                row[3] = (kind == 2) ? 1 : 2;  // MA_ADD / MA_REMOVE
+                row[4] = b[5];
+                row[5] = b[6];
+                row[6] = (b[6] <= 1) ? pack(b[7], b[8], &bad) : 0;
+                row[7] = b[9];
+                row[8] = (b[9] <= 1) ? pack(b[10], b[11], &bad) : 0;
+                if (b[12] < 0 || b[12] > n_strings) return -1;
+                row[9] = b[12];
+                ++cm;
+            } else {
+                return -1;  // unknown op kind: frame is corrupt
+            }
+            if (bad) row[0] = 4;
+            ++no;
+        }
+        ops_off[c + 1] = static_cast<int32_t>(no);
+        cnt_ins[c] = ci;
+        cnt_del[c] = cd;
+        cnt_mark[c] = cm;
+    }
+    if (p != n_vals) return -1;  // trailing garbage
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// pt_schedule_split_batch — one call schedules and splits EVERY frame-mode
+// document's pending parsed changes for a round.
+//
+// Per doc d: admit the longest causally-valid prefix (vector-clock admission,
+// same rules as pt_causal_schedule) whose op usage fits the static round
+// widths (ki/kd/km), and scatter its ops into the doc's padded stream rows
+// (row-major (D, K) arrays shared with the object path; doc_row[d] selects
+// the row).  Clocks advance in place.  This replaces ~30 small numpy calls
+// per doc per round with one native call per round (the host-side bottleneck
+// at pod scale — SURVEY §5.8 / BASELINE config 5).
+//
+// Within-round application order may differ from the scalar path's; any
+// causally-valid order converges to the same state (the RGA skip rule and
+// the order-independent mark table), which the differential tests assert.
+//
+// admitted[c]: 1 = applied this round, 2 = stale duplicate (consumed),
+// 0 = deferred (stuck or over budget).  status[d]: 0 = ok, 1 = demote the
+// doc (op on a non-text object, or a change that can never fit the widths).
+// Returns total changes admitted.
+int32_t pt_schedule_split_batch(
+    int32_t n_docs, int32_t n_actors,
+    const int32_t* ch_off, const int32_t* doc_row, const int32_t* text_obj,
+    const int32_t* ch_actor, const int32_t* ch_seq,
+    const int32_t* dep_off, const int32_t* dep_actor, const int32_t* dep_seq,
+    const int32_t* ops_off, const int32_t* ops,
+    int32_t* clock,  // (n_docs, n_actors) row-major, in/out
+    int32_t ki, int32_t kd, int32_t km,
+    int32_t* ins_ref, int32_t* ins_op, int32_t* ins_char,
+    int32_t* del_target,
+    int32_t* m_action, int32_t* m_type, int32_t* m_sk, int32_t* m_se,
+    int32_t* m_ek, int32_t* m_ee, int32_t* m_op, int32_t* m_attr,
+    int32_t* n_ins, int32_t* n_del, int32_t* n_mark, int32_t* n_admitted,
+    uint8_t* admitted, uint8_t* status) {
+    int32_t total_admitted = 0;
+    std::vector<int32_t> order;
+    std::vector<int32_t> clock_save(n_actors);
+
+    for (int32_t d = 0; d < n_docs; ++d) {
+        const int32_t lo = ch_off[d], hi = ch_off[d + 1];
+        int32_t* dclock = clock + static_cast<int64_t>(d) * n_actors;
+        std::memcpy(clock_save.data(), dclock, n_actors * sizeof(int32_t));
+        const int32_t row = doc_row[d];
+        int32_t* r_ins_ref = ins_ref + static_cast<int64_t>(row) * ki;
+        int32_t* r_ins_op = ins_op + static_cast<int64_t>(row) * ki;
+        int32_t* r_ins_char = ins_char + static_cast<int64_t>(row) * ki;
+        int32_t* r_del = del_target + static_cast<int64_t>(row) * kd;
+        int64_t mbase = static_cast<int64_t>(row) * km;
+
+        order.clear();
+        for (int32_t c = lo; c < hi; ++c) order.push_back(c);
+        std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+            if (ch_actor[a] != ch_actor[b]) return ch_actor[a] < ch_actor[b];
+            if (ch_seq[a] != ch_seq[b]) return ch_seq[a] < ch_seq[b];
+            return a < b;
+        });
+
+        int32_t ci = 0, cd = 0, cm = 0, nch = 0;
+        bool demote = false, budget_closed = false, progress = true;
+        while (progress && !demote) {
+            progress = false;
+            for (int32_t c : order) {
+                if (admitted[c] || demote) continue;
+                const int32_t a = ch_actor[c], s = ch_seq[c];
+                if (s <= dclock[a]) { admitted[c] = 2; continue; }  // stale dup
+                if (budget_closed || s != dclock[a] + 1) continue;
+                bool ok = true;
+                for (int32_t dd = dep_off[c]; dd < dep_off[c + 1]; ++dd) {
+                    if (dclock[dep_actor[dd]] < dep_seq[dd]) { ok = false; break; }
+                }
+                if (!ok) continue;
+                // count this change's streams
+                int32_t wi = 0, wd = 0, wm = 0;
+                for (int32_t o = ops_off[c]; o < ops_off[c + 1]; ++o) {
+                    const int32_t k = ops[static_cast<int64_t>(o) * 10];
+                    if (k == 0) ++wi;
+                    else if (k == 1) ++wd;
+                    else if (k == 2) ++wm;
+                    else if (k != 5) { demote = true; break; }  // json/bad left over
+                }
+                if (demote) break;
+                if (wi > ki || wd > kd || wm > km) { demote = true; break; }  // never fits
+                if (ci + wi > ki || cd + wd > kd || cm + wm > km) {
+                    budget_closed = true;  // prefix semantics: round is full
+                    continue;
+                }
+                // validate + scatter the ops
+                for (int32_t o = ops_off[c]; o < ops_off[c + 1] && !demote; ++o) {
+                    const int32_t* r = ops + static_cast<int64_t>(o) * 10;
+                    const int32_t k = r[0];
+                    if (k == 5) continue;
+                    if (r[1] != text_obj[d]) { demote = true; break; }
+                    if (k == 0) {
+                        r_ins_ref[ci] = r[3]; r_ins_op[ci] = r[2]; r_ins_char[ci] = r[4];
+                        ++ci;
+                    } else if (k == 1) {
+                        r_del[cd] = r[3];
+                        ++cd;
+                    } else {
+                        m_action[mbase + cm] = r[3]; m_type[mbase + cm] = r[4];
+                        m_sk[mbase + cm] = r[5]; m_se[mbase + cm] = r[6];
+                        m_ek[mbase + cm] = r[7]; m_ee[mbase + cm] = r[8];
+                        m_op[mbase + cm] = r[2]; m_attr[mbase + cm] = r[9];
+                        ++cm;
+                    }
+                }
+                if (demote) break;
+                dclock[a] = s;
+                admitted[c] = 1;
+                ++nch;
+                progress = true;
+            }
+        }
+
+        if (demote) {
+            // discard this doc's round: zero rows, restore clock, flag it
+            std::memcpy(dclock, clock_save.data(), n_actors * sizeof(int32_t));
+            std::memset(r_ins_ref, 0, ki * sizeof(int32_t));
+            std::memset(r_ins_op, 0, ki * sizeof(int32_t));
+            std::memset(r_ins_char, 0, ki * sizeof(int32_t));
+            std::memset(r_del, 0, kd * sizeof(int32_t));
+            for (int32_t* col : {m_action, m_type, m_sk, m_se, m_ek, m_ee, m_op, m_attr})
+                std::memset(col + mbase, 0, km * sizeof(int32_t));
+            for (int32_t c = lo; c < hi; ++c) admitted[c] = 0;
+            n_ins[d] = n_del[d] = n_mark[d] = n_admitted[d] = 0;
+            status[d] = 1;
+            continue;
+        }
+        n_ins[d] = ci; n_del[d] = cd; n_mark[d] = cm; n_admitted[d] = nch;
+        status[d] = 0;
+        total_admitted += nch;
+    }
+    return total_admitted;
 }
 
 }  // extern "C"

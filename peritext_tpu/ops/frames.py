@@ -1,0 +1,326 @@
+"""Frame-native ingest: wire bytes -> device streams without Python objects.
+
+The object ingest path (parallel/streaming.py) walks Python ``Change``/
+``Operation`` objects per op — fine for editors, but the bottleneck when a
+host streams 100K docs of changes per round (SURVEY §5.8, BASELINE config 5).
+This module is the native data-loader: a binary change frame (the DCN wire
+format, parallel/codec.py) is parsed by the C++ core straight into flat int32
+arrays (native.parse_changes), and everything after that — causal admission,
+round budgeting, stream splitting, padding — is vectorized numpy over those
+arrays.  Python-level objects appear only on slow paths (JSON-spillover ops,
+undeclared actors), which demote a doc to the object/oracle path.
+
+Uniform op-matrix column layout (kind in col 0): see pt_parse_changes in
+native/src/native.cpp.  Identifiers are device-packed
+(``ctr << ACTOR_BITS | actor``) from the moment of parsing.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import native
+from ..core.types import Operation
+from ..schema import ALL_MARKS
+from ..utils.interning import Interner, OrderedActorTable
+from .packed import ACTOR_BITS, MAX_ACTORS, MAX_CTR, pack_id
+
+KIND_INS = 0
+KIND_DEL = 1
+KIND_MARK = 2
+KIND_JSON = 3
+KIND_BAD = 4
+KIND_SKIP = 5  # resolved makeList: consumed at parse time, no device op
+
+#: op-matrix columns (see native.cpp): the mark row in device MARK_COLS order
+#: is cols [3, 4, 5, 6, 7, 8, 2, 9].
+_MARK_COL_ORDER = (3, 4, 5, 6, 7, 8, 2, 9)
+
+
+@dataclass
+class ParsedChanges:
+    """Flat-array form of a set of changes (concatenable, sliceable)."""
+
+    ch_actor: np.ndarray  # (N,) declared actor index
+    ch_seq: np.ndarray  # (N,)
+    dep_off: np.ndarray  # (N+1,)
+    dep_actor: np.ndarray  # (ND,)
+    dep_seq: np.ndarray  # (ND,)
+    ops_off: np.ndarray  # (N+1,)
+    ops: np.ndarray  # (NO, 10)
+    cnt_ins: np.ndarray  # (N,)
+    cnt_del: np.ndarray  # (N,)
+    cnt_mark: np.ndarray  # (N,)
+
+    @property
+    def num_changes(self) -> int:
+        return int(self.ch_actor.shape[0])
+
+    @staticmethod
+    def empty() -> "ParsedChanges":
+        z = lambda *s: np.zeros(s, np.int32)  # noqa: E731
+        return ParsedChanges(
+            z(0), z(0), z(1), z(0), z(0), z(1), z(0, 10), z(0), z(0), z(0)
+        )
+
+    def concat(self, other: "ParsedChanges") -> "ParsedChanges":
+        return ParsedChanges.concat_many([self, other])
+
+    @staticmethod
+    def concat_many(parts: List["ParsedChanges"]) -> "ParsedChanges":
+        parts = [p for p in parts if p.num_changes > 0]
+        if not parts:
+            return ParsedChanges.empty()
+        if len(parts) == 1:
+            return parts[0]
+
+        def offsets(key):
+            offs = [getattr(parts[0], key)]
+            for p in parts[1:]:
+                offs.append(getattr(p, key)[1:] + offs[-1][-1])
+            return np.concatenate(offs)
+
+        cat = lambda key: np.concatenate([getattr(p, key) for p in parts])  # noqa: E731
+        return ParsedChanges(
+            ch_actor=cat("ch_actor"),
+            ch_seq=cat("ch_seq"),
+            dep_off=offsets("dep_off"),
+            dep_actor=cat("dep_actor"),
+            dep_seq=cat("dep_seq"),
+            ops_off=offsets("ops_off"),
+            ops=np.concatenate([p.ops for p in parts]),
+            cnt_ins=cat("cnt_ins"),
+            cnt_del=cat("cnt_del"),
+            cnt_mark=cat("cnt_mark"),
+        )
+
+    def select(self, indices: np.ndarray) -> "ParsedChanges":
+        """Changes at ``indices`` (any order), with deps/ops re-gathered."""
+        indices = np.asarray(indices, np.int32)
+        dep_idx, dep_off = _ragged_gather(self.dep_off, indices)
+        ops_idx, ops_off = _ragged_gather(self.ops_off, indices)
+        return ParsedChanges(
+            ch_actor=self.ch_actor[indices],
+            ch_seq=self.ch_seq[indices],
+            dep_off=dep_off,
+            dep_actor=self.dep_actor[dep_idx],
+            dep_seq=self.dep_seq[dep_idx],
+            ops_off=ops_off,
+            ops=self.ops[ops_idx],
+            cnt_ins=self.cnt_ins[indices],
+            cnt_del=self.cnt_del[indices],
+            cnt_mark=self.cnt_mark[indices],
+        )
+
+
+def _ragged_gather(off: np.ndarray, indices: np.ndarray):
+    """Element indices for the concatenated ranges off[i]..off[i+1] of the
+    selected rows, plus the new offsets array."""
+    lens = (off[indices + 1] - off[indices]).astype(np.int64)
+    total = int(lens.sum())
+    new_off = np.zeros(len(indices) + 1, np.int32)
+    np.cumsum(lens, out=new_off[1:])
+    if total == 0:
+        return np.zeros(0, np.int64), new_off
+    starts = off[indices].astype(np.int64)
+    base = np.repeat(starts - new_off[:-1], lens)
+    return np.arange(total, dtype=np.int64) + base, new_off
+
+
+class FrameIngestError(Exception):
+    """Raised when a frame cannot take the fast path (caller demotes the doc
+    to the object path); carries no partial state."""
+
+
+def parse_frame(
+    data: bytes,
+    actors: OrderedActorTable,
+    attrs: Interner,
+    text_obj: int,
+) -> Tuple[ParsedChanges, int]:
+    """Parse one wire frame into flat arrays on the fast path.
+
+    Returns ``(parsed, text_obj)`` — ``text_obj`` is the packed id of the
+    doc's text list, possibly learned from a ``makeList`` in this frame.
+    Raises FrameIngestError when the frame needs the object path (native
+    core unavailable, JSON-spillover ops other than the initial makeList,
+    undeclared actors) and ValueError on corrupt frames.
+    """
+    from ..parallel.codec import frame_parts
+
+    if not native.available():
+        raise FrameIngestError("native core unavailable")
+    if len(actors) - 1 > MAX_ACTORS:
+        # packed ids collide beyond ACTOR_BITS; the object path demotes the
+        # same way (encode.DocEncoder.ok)
+        raise FrameIngestError("actor table exceeds packed-id capacity")
+    strings, values, n_changes = frame_parts(data)
+    parsed_raw = native.parse_changes(
+        np.asarray(values, np.int32),
+        n_changes,
+        np.asarray([actors.get(s) if actors.get(s) is not None else -1 for s in strings], np.int32),
+        ACTOR_BITS,
+        MAX_CTR,
+    )
+    if parsed_raw is None:  # pragma: no cover - guarded by available() above
+        raise FrameIngestError("native core unavailable")
+    (ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops,
+     cnt_ins, cnt_del, cnt_mark) = parsed_raw
+
+    if np.any(ch_actor < 0):
+        raise FrameIngestError("undeclared actor in frame")
+
+    kinds = ops[:, 0]
+    # JSON-spillover rows: only the doc's makeList is fast-path-able; it
+    # defines the text object and becomes a no-op row.  (A re-delivered copy
+    # of the same makeList is also a no-op: duplicate frames are a routine
+    # anti-entropy condition and must not demote the doc.)
+    for row in np.nonzero(kinds == KIND_JSON)[0]:
+        try:
+            op = Operation.from_json(json.loads(strings[int(ops[row, 3])]))
+        except (ValueError, TypeError, KeyError, AttributeError) as exc:
+            # same normalized contract as codec.decode_frame
+            raise ValueError(f"corrupt frame: {exc!r}") from exc
+        if op.action != "makeList":
+            raise FrameIngestError(f"non-text op on fast path: {op.action}")
+        actor_idx = actors.get(op.opid[1])
+        if actor_idx is None or op.opid[0] > MAX_CTR:
+            raise FrameIngestError("makeList opid outside packed range")
+        packed = pack_id(op.opid[0], actor_idx)
+        if text_obj == 0:
+            text_obj = packed
+        elif packed != text_obj:
+            raise FrameIngestError("second list object on fast path")
+        ops[row, 0] = KIND_SKIP
+        ops[row, 1] = text_obj  # self-describing: skips obj validation
+
+    if np.any(kinds == KIND_BAD):
+        raise FrameIngestError("op outside packed-id range")
+
+    mark_rows = kinds == KIND_MARK
+    if np.any(mark_rows):
+        mtypes = ops[mark_rows, 4]
+        if mtypes.min(initial=0) < 0 or mtypes.max(initial=0) >= len(ALL_MARKS):
+            raise ValueError("mark type index out of range")
+        # translate attr string-table indices -> per-doc interned attr ids
+        attr_col = ops[:, 9]
+        for row in np.nonzero(mark_rows & (attr_col > 0))[0]:
+            ops[row, 9] = attrs.intern(strings[int(attr_col[row]) - 1])
+
+    parsed = ParsedChanges(
+        ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops,
+        cnt_ins, cnt_del, cnt_mark,
+    )
+    return parsed, text_obj
+
+
+def _py_schedule_order(
+    parsed: ParsedChanges, n_actors: int, clock: np.ndarray
+) -> np.ndarray:
+    """Pure-python twin of native causal_schedule_indices (fallback only)."""
+    n = parsed.num_changes
+    clock = clock.copy()
+    remaining = sorted(range(n), key=lambda i: (parsed.ch_actor[i], parsed.ch_seq[i]))
+    order: List[int] = []
+    progress = True
+    done = np.zeros(n, bool)
+    while progress:
+        progress = False
+        for i in remaining:
+            if done[i]:
+                continue
+            a, s = int(parsed.ch_actor[i]), int(parsed.ch_seq[i])
+            if s <= clock[a]:
+                done[i] = True  # stale duplicate
+                continue
+            if s != clock[a] + 1:
+                continue
+            deps = range(parsed.dep_off[i], parsed.dep_off[i + 1])
+            if any(clock[parsed.dep_actor[d]] < parsed.dep_seq[d] for d in deps):
+                continue
+            clock[a] = s
+            done[i] = True
+            order.append(i)
+            progress = True
+    return np.asarray(order, np.int32)
+
+
+def schedule_split(
+    parsed: ParsedChanges,
+    clock: np.ndarray,
+    text_obj: int,
+    caps: Tuple[int, int, int],
+    out_ins: Tuple[np.ndarray, np.ndarray, np.ndarray],
+    out_del: np.ndarray,
+    out_marks: dict,
+    n_actors: int,
+) -> Tuple[int, Tuple[int, int, int], ParsedChanges]:
+    """One round: admit the longest causally-valid prefix that fits the
+    static stream widths, split its ops into the caller's padded row views,
+    and advance ``clock`` in place.
+
+    Returns ``(changes_admitted, (n_ins, n_del, n_mark), deferred)``.
+    Raises FrameIngestError if an admitted op targets an object other than
+    the doc's text list (the caller demotes the doc).
+    """
+    n = parsed.num_changes
+    if n == 0:
+        return 0, (0, 0, 0), parsed
+    ki, kd, km = caps
+
+    stale = parsed.ch_seq <= clock[parsed.ch_actor]
+    order = native.causal_schedule_indices(
+        parsed.ch_actor, parsed.ch_seq, parsed.dep_off,
+        parsed.dep_actor, parsed.dep_seq, n_actors, clock,
+    )
+    if order is None:
+        order = _py_schedule_order(parsed, n_actors, clock)
+
+    # Budget: longest schedulable prefix fitting every stream width.
+    fits = (
+        (np.cumsum(parsed.cnt_ins[order]) <= ki)
+        & (np.cumsum(parsed.cnt_del[order]) <= kd)
+        & (np.cumsum(parsed.cnt_mark[order]) <= km)
+    )
+    cut = int(np.argmax(~fits)) if not fits.all() else len(order)
+    if cut == 0 and len(order) > 0:
+        # The first admissible change alone exceeds a round width: it can
+        # never fit, so deferring would wedge the doc forever — demote it.
+        raise FrameIngestError("a single change exceeds the round stream widths")
+    admitted = order[:cut]
+    if len(admitted) == 0:
+        return 0, (0, 0, 0), parsed.select(np.nonzero(~stale)[0])
+
+    ops_idx, _ = _ragged_gather(parsed.ops_off, admitted)
+    sel = parsed.ops[ops_idx]
+    kinds = sel[:, 0]
+    live = kinds != KIND_SKIP
+    if not np.all((sel[:, 1][live] == text_obj)):
+        raise FrameIngestError("op on non-text object on fast path")
+
+    ins = sel[kinds == KIND_INS]
+    dels = sel[kinds == KIND_DEL]
+    marks = sel[kinds == KIND_MARK]
+    ni, nd, nm = len(ins), len(dels), len(marks)
+    ins_ref, ins_op, ins_char = out_ins
+    ins_ref[:ni] = ins[:, 3]
+    ins_op[:ni] = ins[:, 2]
+    ins_char[:ni] = ins[:, 4]
+    out_del[:nd] = dels[:, 3]
+    for col_name, col in zip(
+        ("m_action", "m_type", "m_start_kind", "m_start_elem",
+         "m_end_kind", "m_end_elem", "m_op", "m_attr"),
+        _MARK_COL_ORDER,
+    ):
+        out_marks[col_name][:nm] = marks[:, col]
+
+    np.maximum.at(clock, parsed.ch_actor[admitted], parsed.ch_seq[admitted])
+
+    admitted_mask = np.zeros(n, bool)
+    admitted_mask[admitted] = True
+    deferred = parsed.select(np.nonzero(~admitted_mask & ~stale)[0])
+    return len(admitted), (ni, nd, nm), deferred

@@ -157,17 +157,20 @@ def resolve_single(state: PackedDocs, comment_capacity: int) -> ResolvedDocs:
             add_rows.append(jnp.maximum(carry.add_op[t], chunk_add))
             rem_rows.append(jnp.maximum(carry.rem_op[t], chunk_rem))
 
-        # Comments: per interned comment id; the chunk is walked row-by-row
-        # (J tiny (C,S) updates, all inside one loop iteration so nothing
-        # extra is loop-carried).
-        c_add_op, c_rem_op = carry.c_add_op, carry.c_rem_op
-        c_ids = jnp.arange(comment_capacity, dtype=jnp.int32)[:, None]  # (C,1)
+        # Comments: per interned comment id, one vectorized segment-max over
+        # the chunk axis — (J, C, S) masks reduce to (C, S) chunk maxima.
         is_comment = mtype == COMMENT_TYPE
-        for u in range(chunk):
-            sel_add = (c_ids == attr[u]) & is_comment[u] & add_mask[u][None, :]
-            sel_rem = (c_ids == attr[u]) & is_comment[u] & rem_mask[u][None, :]
-            c_add_op = jnp.where(sel_add, jnp.maximum(c_add_op, op[u]), c_add_op)
-            c_rem_op = jnp.where(sel_rem, jnp.maximum(c_rem_op, op[u]), c_rem_op)
+        c_ids = jnp.arange(comment_capacity, dtype=jnp.int32)
+        row_sel = is_comment[:, None] & (attr[:, None] == c_ids[None, :])  # (J, C)
+        op3 = op[:, None, None]  # (J, 1, 1)
+        chunk_c_add = jnp.max(
+            jnp.where(row_sel[:, :, None] & add_mask[:, None, :], op3, 0), axis=0
+        )
+        chunk_c_rem = jnp.max(
+            jnp.where(row_sel[:, :, None] & rem_mask[:, None, :], op3, 0), axis=0
+        )
+        c_add_op = jnp.maximum(carry.c_add_op, chunk_c_add)
+        c_rem_op = jnp.maximum(carry.c_rem_op, chunk_c_rem)
 
         error = carry.error | jnp.any(live & ~(s_ok & e_ok))
         error = error | jnp.any(live & is_comment & (attr >= comment_capacity))

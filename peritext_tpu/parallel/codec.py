@@ -272,7 +272,19 @@ def decode_frame(data: bytes) -> List[Change]:
         raise ValueError(f"corrupt frame: {exc!r}") from exc
 
 
-def _decode_frame(data: bytes) -> List[Change]:
+def frame_parts(data: bytes):
+    """Split a frame into ``(strings, payload_ints, n_changes)`` without
+    materializing Change objects — the input to the native frame-ingest fast
+    path (native.parse_changes).  Raises ValueError on corrupt frames."""
+    try:
+        return _frame_parts(data)
+    except ValueError:
+        raise
+    except (IndexError, OverflowError, UnicodeDecodeError, struct.error) as exc:
+        raise ValueError(f"corrupt frame: {exc!r}") from exc
+
+
+def _frame_parts(data: bytes):
     if len(data) < _HEADER.size:
         raise ValueError("frame too short")
     magic, version, n_changes, n_strings, n_ints, payload_len = _HEADER.unpack_from(data)
@@ -312,7 +324,11 @@ def _decode_frame(data: bytes) -> List[Change]:
     values = native.varint_decode(payload, n_ints) if native.available() else None
     if values is None:
         values = _py_varint_decode(payload, n_ints)
+    return strings, values, n_changes
 
+
+def _decode_frame(data: bytes) -> List[Change]:
+    strings, values, n_changes = _frame_parts(data)
     r = _IntReader(values)
     changes: List[Change] = []
     for _ in range(n_changes):

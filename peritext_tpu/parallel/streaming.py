@@ -41,15 +41,24 @@ import numpy as np
 
 import jax
 
+from .. import native
 from ..core.doc import Doc
 from ..core.types import Change, Clock, FormatSpan
 from ..observability import GLOBAL_COUNTERS
 from ..ops.decode import decode_doc_spans
 from ..ops.encode import DocEncoder, _DocStreams, pad_doc_streams
+from ..ops.frames import (
+    FrameIngestError,
+    ParsedChanges,
+    parse_frame,
+    schedule_split,
+)
 from ..ops.kernel import apply_batch_jit, encoded_arrays_of
 from ..ops.packed import PackedDocs, empty_docs
 from ..ops.resolve import resolve_jit
+from ..utils.interning import Interner, OrderedActorTable
 from .causal import causal_schedule
+from .codec import decode_frame, encode_frame
 from .mesh import convergence_digest, shard_docs
 
 
@@ -60,6 +69,14 @@ class _DocSession:
     pending: List[Change] = field(default_factory=list)
     log: List[Change] = field(default_factory=list)
     fallback: bool = False
+    # frame-native mode (ops/frames.py): raw wire frames are the event source
+    # and pending ops live as flat parsed arrays, never Python objects
+    frame_mode: bool = False
+    frames: List[bytes] = field(default_factory=list)
+    parsed: Optional[ParsedChanges] = None
+    clock_arr: Optional[np.ndarray] = None
+    text_obj: int = 0
+    attrs: Optional[Interner] = None
 
 
 class StreamingMerge:
@@ -90,6 +107,7 @@ class StreamingMerge:
         self.comment_capacity = comment_capacity
         self.docs = [_DocSession() for _ in range(num_docs)]
         self.rounds = 0
+        self._actor_table = OrderedActorTable(self.actors)
         state = empty_docs(num_docs, slot_capacity, mark_capacity, tomb_capacity)
         self.state: PackedDocs = shard_docs(state, mesh) if mesh is not None else state
 
@@ -98,7 +116,63 @@ class StreamingMerge:
     def ingest(self, doc_index: int, changes: Iterable[Change]) -> None:
         """Queue newly-arrived changes for one document (any order, dups ok)."""
         sess = self.docs[doc_index]
+        changes = list(changes)
+        if sess.frame_mode:
+            # the doc's pending state lives as parsed arrays; route object
+            # arrivals through the same (cheap) frame parse
+            self.ingest_frame(doc_index, encode_frame(changes))
+            return
         sess.pending.extend(changes)
+
+    def ingest_frame(self, doc_index: int, data: bytes) -> None:
+        """Queue one binary change frame (the wire format a peer host ships,
+        parallel/codec.py) for one document — the native fast path: the C++
+        core parses the payload straight into flat arrays and no Python
+        ``Change`` objects are built unless the doc leaves the fast path.
+        Raises ValueError on corrupt frames (nothing is queued)."""
+        sess = self.docs[doc_index]
+        object_bound = sess.fallback or sess.encoder is not None or bool(
+            sess.pending or sess.log
+        )
+        if (not sess.frame_mode and object_bound) or not native.available():
+            self.ingest(doc_index, decode_frame(data))
+            return
+        if not sess.frame_mode:
+            sess.frame_mode = True
+            sess.attrs = Interner()
+            sess.parsed = ParsedChanges.empty()
+            sess.clock_arr = np.zeros(len(self._actor_table), np.int32)
+        try:
+            parsed, sess.text_obj = parse_frame(
+                data, self._actor_table, sess.attrs, sess.text_obj
+            )
+        except FrameIngestError:
+            self._demote_frame_doc(sess, extra=decode_frame(data))
+            return
+        sess.frames.append(data)
+        sess.parsed = sess.parsed.concat(parsed)
+
+    def _demote_frame_doc(self, sess: _DocSession, extra: List[Change] = ()) -> None:
+        """Leave the fast path: the doc becomes a scalar-replay fallback fed
+        by its decoded frame history (its device rows may already hold applied
+        ops, so only the oracle path is still correct for it)."""
+        changes = [ch for f in sess.frames for ch in decode_frame(f)]
+        changes.extend(extra)
+        sess.log.extend(changes)
+        if sess.clock_arr is not None:
+            # fold the applied frontier into the object-path clock so
+            # frontier() stays truthful across the demotion
+            for idx in np.nonzero(sess.clock_arr)[0]:
+                actor = self._actor_table.lookup(int(idx))
+                sess.clock[actor] = max(sess.clock.get(actor, 0), int(sess.clock_arr[idx]))
+        sess.frame_mode = False
+        sess.frames = []
+        sess.parsed = None
+        sess.clock_arr = None
+        sess.text_obj = 0
+        sess.attrs = None
+        sess.fallback = True
+        GLOBAL_COUNTERS.add("streaming.fallback_docs")
 
     # -- the incremental device round --------------------------------------
 
@@ -116,6 +190,9 @@ class StreamingMerge:
 
         for i, sess in enumerate(self.docs):
             streams = _DocStreams()
+            if sess.frame_mode:
+                per_doc.append(streams)
+                continue  # scheduled in the frame-native pass below
             if sess.pending and not sess.fallback:
                 if sess.encoder is None:
                     sess.encoder = DocEncoder(self.actors)
@@ -146,7 +223,11 @@ class StreamingMerge:
                 fallback_rows.append(i)
             per_doc.append(streams)
 
-        if scheduled == 0:
+        frame_docs = [
+            i for i, s in enumerate(self.docs)
+            if s.frame_mode and s.parsed is not None and s.parsed.num_changes
+        ]
+        if scheduled == 0 and not frame_docs:
             return 0
 
         encoded = pad_doc_streams(
@@ -158,6 +239,16 @@ class StreamingMerge:
             delete_capacity=kd,
             mark_capacity=km,
         )
+
+        # Frame-native pass: schedule + split every frame-mode doc's parsed
+        # arrays directly into the padded rows.  With the native core this is
+        # ONE C++ call for all docs per round (pt_schedule_split_batch); the
+        # per-doc Python version is the no-native fallback.
+        if frame_docs:
+            scheduled += self._step_frame_docs(frame_docs, encoded, (ki, kd, km))
+
+        if scheduled == 0:
+            return 0
         arrays = encoded_arrays_of(encoded)
         if self.mesh is not None:
             arrays = shard_docs(arrays, self.mesh)
@@ -165,6 +256,85 @@ class StreamingMerge:
         self.rounds += 1
         GLOBAL_COUNTERS.add("streaming.rounds")
         GLOBAL_COUNTERS.add("streaming.scheduled_changes", scheduled)
+        return scheduled
+
+    def _step_frame_docs(self, frame_docs, encoded, caps) -> int:
+        """Round-schedule all frame-mode docs into their padded rows."""
+        if not native.available():
+            return self._step_frame_docs_python(frame_docs, encoded, caps)
+
+        merged = ParsedChanges.concat_many([self.docs[i].parsed for i in frame_docs])
+        ch_off = np.concatenate(
+            [[0], np.cumsum([self.docs[i].parsed.num_changes for i in frame_docs])]
+        ).astype(np.int32)
+        # (F, n_actors) clock matrix: mutated in place by the native call
+        clock = np.ascontiguousarray(
+            np.stack([self.docs[i].clock_arr for i in frame_docs]), np.int32
+        )
+        batch = native.schedule_split_batch(
+            len(self._actor_table),
+            ch_off,
+            np.asarray(frame_docs, np.int32),
+            np.asarray([self.docs[i].text_obj for i in frame_docs], np.int32),
+            (merged.ch_actor, merged.ch_seq, merged.dep_off,
+             merged.dep_actor, merged.dep_seq, merged.ops_off, merged.ops),
+            clock,
+            caps,
+            (encoded.ins_ref, encoded.ins_op, encoded.ins_char),
+            encoded.del_target,
+            encoded.marks,
+        )
+        if batch is None:  # pragma: no cover - available() checked above
+            return self._step_frame_docs_python(frame_docs, encoded, caps)
+
+        _, n_ins, n_del, n_mark, n_admitted, admitted, status = batch
+        scheduled = 0
+        for j, i in enumerate(frame_docs):
+            sess = self.docs[i]
+            flags = admitted[ch_off[j] : ch_off[j + 1]]
+            if status[j]:
+                self._demote_frame_doc(sess)  # rows already zeroed natively
+                continue
+            sess.clock_arr = clock[j].copy()
+            if flags.all():  # common case: everything admitted or consumed
+                sess.parsed = ParsedChanges.empty()
+            else:
+                sess.parsed = sess.parsed.select(np.nonzero(flags == 0)[0])
+            encoded.mark_count[i] = int(n_mark[j])
+            encoded.num_ops[i] = int(n_ins[j] + n_del[j] + n_mark[j])
+            scheduled += int(n_admitted[j])
+        return scheduled
+
+    def _step_frame_docs_python(self, frame_docs, encoded, caps) -> int:
+        """Per-doc Python fallback (no native library)."""
+        ki, kd, km = caps
+        scheduled = 0
+        for i in frame_docs:
+            sess = self.docs[i]
+            try:
+                nch, (ni, nd, nm), deferred = schedule_split(
+                    sess.parsed,
+                    sess.clock_arr,
+                    sess.text_obj,
+                    (ki, kd, km),
+                    (encoded.ins_ref[i], encoded.ins_op[i], encoded.ins_char[i]),
+                    encoded.del_target[i],
+                    {col: encoded.marks[col][i] for col in encoded.marks},
+                    len(self._actor_table),
+                )
+            except FrameIngestError:
+                for col in encoded.marks:  # discard any partial row writes
+                    encoded.marks[col][i] = 0
+                encoded.ins_ref[i] = 0
+                encoded.ins_op[i] = 0
+                encoded.ins_char[i] = 0
+                encoded.del_target[i] = 0
+                self._demote_frame_doc(sess)
+                continue
+            sess.parsed = deferred
+            encoded.mark_count[i] = nm
+            encoded.num_ops[i] = ni + nd + nm
+            scheduled += nch
         return scheduled
 
     def drain(self, max_rounds: int = 1_000) -> int:
@@ -192,14 +362,28 @@ class StreamingMerge:
 
     # -- reads (synchronization points) ------------------------------------
 
+    @staticmethod
+    def _replay_changes(sess: _DocSession) -> List[Change]:
+        """A doc's full change history for scalar replay: decoded wire frames
+        in frame mode, the object log otherwise."""
+        if sess.frame_mode:
+            return [ch for f in sess.frames for ch in decode_frame(f)]
+        return sess.log + sess.pending
+
+    @staticmethod
+    def _attr_table(sess: _DocSession):
+        if sess.frame_mode:
+            return sess.attrs
+        return sess.encoder.attrs if sess.encoder else None
+
     def read(self, doc_index: int) -> List[FormatSpan]:
         sess = self.docs[doc_index]
         overflow = bool(np.asarray(self.state.overflow)[doc_index])
         if sess.fallback or overflow:
-            return _replay_spans(sess.log + sess.pending)
+            return _replay_spans(self._replay_changes(sess))
         resolved = resolve_jit(self.state, self.comment_capacity)
         resolved = type(resolved)(*(np.asarray(x) for x in resolved))
-        return decode_doc_spans(resolved, doc_index, sess.encoder.attrs if sess.encoder else None)
+        return decode_doc_spans(resolved, doc_index, self._attr_table(sess))
 
     def read_all(self) -> List[List[FormatSpan]]:
         resolved = resolve_jit(self.state, self.comment_capacity)
@@ -208,11 +392,9 @@ class StreamingMerge:
         out: List[List[FormatSpan]] = []
         for i, sess in enumerate(self.docs):
             if sess.fallback or bool(overflow[i]):
-                out.append(_replay_spans(sess.log + sess.pending))
+                out.append(_replay_spans(self._replay_changes(sess)))
             else:
-                out.append(
-                    decode_doc_spans(resolved, i, sess.encoder.attrs if sess.encoder else None)
-                )
+                out.append(decode_doc_spans(resolved, i, self._attr_table(sess)))
         return out
 
     # -- cross-shard reductions (the ICI/DCN collectives) ------------------
@@ -228,12 +410,20 @@ class StreamingMerge:
         """Merged vector-clock frontier across all docs (host-side metadata)."""
         merged: Clock = {}
         for sess in self.docs:
-            for actor, seq in sess.clock.items():
-                merged[actor] = max(merged.get(actor, 0), seq)
+            if sess.frame_mode:
+                for idx in np.nonzero(sess.clock_arr)[0]:
+                    actor = self._actor_table.lookup(int(idx))
+                    merged[actor] = max(merged.get(actor, 0), int(sess.clock_arr[idx]))
+            else:
+                for actor, seq in sess.clock.items():
+                    merged[actor] = max(merged.get(actor, 0), seq)
         return merged
 
     def pending_count(self) -> int:
-        return sum(len(s.pending) for s in self.docs)
+        return sum(
+            (s.parsed.num_changes if s.frame_mode and s.parsed is not None else len(s.pending))
+            for s in self.docs
+        )
 
 
 def _replay_spans(changes: List[Change]) -> List[FormatSpan]:

@@ -1,0 +1,263 @@
+"""Frame-native ingest: wire bytes -> C++ parse -> vectorized schedule/split.
+
+Differential against both the object ingest path and the scalar oracle.
+"""
+
+import numpy as np
+import pytest
+
+from peritext_tpu import native
+from peritext_tpu.api.batch import _oracle_doc
+from peritext_tpu.parallel.codec import encode_frame
+from peritext_tpu.parallel.streaming import StreamingMerge
+from peritext_tpu.testing.fuzz import generate_workload
+from peritext_tpu.testing.generate import generate_docs
+
+ACTORS = ("doc1", "doc2", "doc3")
+
+
+def _session(num_docs=4, **kw):
+    defaults = dict(
+        num_docs=num_docs,
+        actors=ACTORS,
+        slot_capacity=512,
+        mark_capacity=128,
+        tomb_capacity=256,
+        round_insert_capacity=128,
+        round_delete_capacity=64,
+        round_mark_capacity=64,
+    )
+    defaults.update(kw)
+    return StreamingMerge(**defaults)
+
+
+def _changes_of(workload):
+    return [ch for log in workload.values() for ch in log]
+
+
+def _oracle_spans(workload):
+    return _oracle_doc(workload).get_text_with_formatting(["text"])
+
+
+@pytest.fixture(scope="module")
+def workloads():
+    return generate_workload(seed=55, num_docs=4, ops_per_doc=120)
+
+
+def test_native_parse_available():
+    assert native.available(), "native core should build in this image"
+
+
+def test_frame_ingest_matches_object_ingest_and_oracle(workloads):
+    frames_sess = _session()
+    object_sess = _session()
+    for d, w in enumerate(workloads):
+        frames_sess.ingest_frame(d, encode_frame(_changes_of(w)))
+        object_sess.ingest(d, _changes_of(w))
+    frames_sess.drain()
+    object_sess.drain()
+    assert not any(s.fallback for s in frames_sess.docs)
+    assert frames_sess.digest() == object_sess.digest()
+    fr = frames_sess.read_all()
+    ob = object_sess.read_all()
+    for d, w in enumerate(workloads):
+        assert fr[d] == ob[d] == _oracle_spans(w), f"doc {d}"
+
+
+def test_frame_ingest_multi_round_shuffled_duplicated(workloads):
+    import random
+
+    rng = random.Random(7)
+    sess = _session()
+    # deliver each doc's changes as several shuffled frames, with one frame
+    # duplicated — per-actor suffix contiguity is not required by ingest
+    for d, w in enumerate(workloads):
+        changes = _changes_of(w)
+        rng.shuffle(changes)
+        chunks = [changes[i : i + 7] for i in range(0, len(changes), 7)]
+        frames = [encode_frame(c) for c in chunks]
+        frames.append(frames[0])  # duplicate delivery
+        for f in frames:
+            sess.ingest_frame(d, f)
+            sess.step()
+    sess.drain()
+    assert not any(s.fallback for s in sess.docs)
+    out = sess.read_all()
+    for d, w in enumerate(workloads):
+        assert out[d] == _oracle_spans(w), f"doc {d}"
+    assert sess.pending_count() == 0
+
+
+def test_mixed_object_then_frame_ingest(workloads):
+    w = workloads[0]
+    changes = _changes_of(w)
+    half = len(changes) // 2
+    sess = _session(num_docs=1)
+    sess.ingest(0, changes[:half])  # doc becomes object-bound
+    sess.ingest_frame(0, encode_frame(changes[half:]))  # routed to object path
+    sess.drain()
+    assert sess.read(0) == _oracle_spans(w)
+
+
+def test_mixed_frame_then_object_ingest(workloads):
+    w = workloads[1]
+    changes = _changes_of(w)
+    half = len(changes) // 2
+    sess = _session(num_docs=1)
+    sess.ingest_frame(0, encode_frame(changes[:half]))
+    sess.ingest(0, changes[half:])  # converted to a frame internally
+    sess.drain()
+    assert sess.docs[0].frame_mode
+    assert sess.read(0) == _oracle_spans(w)
+
+
+def test_non_text_ops_demote_to_oracle_replay():
+    docs, _, initial = generate_docs("hello", 2)
+    d1, _ = docs
+    c, _ = d1.change(
+        [{"path": [], "action": "makeMap", "key": "comments"}]
+    )
+    sess = _session(num_docs=1)
+    sess.ingest_frame(0, encode_frame([initial, c]))
+    sess.drain()
+    assert sess.docs[0].fallback
+    w = {"doc1": [initial, c]}
+    assert sess.read(0) == _oracle_spans(w)
+
+
+def test_undeclared_actor_demotes_not_crashes(workloads):
+    w = workloads[2]
+    sess = _session(num_docs=1, actors=("doc1", "doc2"))  # doc3 undeclared
+    sess.ingest_frame(0, encode_frame(_changes_of(w)))
+    sess.drain()
+    assert sess.docs[0].fallback
+    assert sess.read(0) == _oracle_spans(w)
+
+
+def test_oversized_change_demotes_not_wedges():
+    docs, _, initial = generate_docs("x", 1)
+    (d1,) = docs
+    big, _ = d1.change(
+        [{"path": ["text"], "action": "insert", "index": 1, "values": list("y" * 200)}]
+    )
+    sess = _session(num_docs=1, round_insert_capacity=64)
+    sess.ingest_frame(0, encode_frame([initial, big]))
+    rounds = sess.drain()
+    assert rounds < 10  # never wedges
+    w = {"doc1": [initial, big]}
+    assert sess.read(0) == _oracle_spans(w)
+
+
+def test_corrupt_frame_raises_and_queues_nothing(workloads):
+    sess = _session(num_docs=1)
+    good = encode_frame(_changes_of(workloads[0]))
+    with pytest.raises(ValueError):
+        sess.ingest_frame(0, good[:-3])  # truncated
+    assert sess.pending_count() == 0
+
+
+def test_frame_ingest_without_native_uses_object_path(monkeypatch, workloads):
+    monkeypatch.setattr(native, "available", lambda: False)
+    sess = _session(num_docs=1)
+    sess.ingest_frame(0, encode_frame(_changes_of(workloads[3])))
+    assert not sess.docs[0].frame_mode  # took the object path
+    sess.drain()
+    assert sess.read(0) == _oracle_spans(workloads[3])
+
+
+def test_frontier_and_digest_frame_mode(workloads):
+    sess = _session()
+    for d, w in enumerate(workloads):
+        sess.ingest_frame(d, encode_frame(_changes_of(w)))
+    sess.drain()
+    frontier = sess.frontier()
+    expect = {}
+    for w in workloads:
+        for actor, log in w.items():
+            if log:
+                expect[actor] = max(expect.get(actor, 0), max(c.seq for c in log))
+    assert frontier == expect
+
+
+def test_marks_and_comments_through_frames():
+    docs, _, initial = generate_docs("hello world", 2)
+    d1, d2 = docs
+    c1, _ = d1.change(
+        [{"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 5,
+          "markType": "strong"}]
+    )
+    c2, _ = d2.change(
+        [{"path": ["text"], "action": "addMark", "startIndex": 3, "endIndex": 9,
+          "markType": "comment", "attrs": {"id": "abc-1"}},
+         {"path": ["text"], "action": "addMark", "startIndex": 2, "endIndex": 7,
+          "markType": "link", "attrs": {"url": "https://x.test"}}]
+    )
+    w = {"doc1": [initial, c1], "doc2": [c2]}
+    sess = _session(num_docs=1)
+    sess.ingest_frame(0, encode_frame(_changes_of(w)))
+    sess.drain()
+    assert not sess.docs[0].fallback
+    assert sess.read(0) == _oracle_spans(w)
+
+
+def test_python_schedule_fallback_matches(monkeypatch, workloads):
+    """Frames parsed with the native core, but the round scheduled by the
+    pure-python twins (_step_frame_docs_python + _py_schedule_order)."""
+    sess = _session()
+    for d, w in enumerate(workloads):
+        sess.ingest_frame(d, encode_frame(_changes_of(w)))
+    monkeypatch.setattr(native, "available", lambda: False)
+    # causal_schedule_indices loads the library directly; force the pure-
+    # python scheduler too so _py_schedule_order is actually exercised
+    monkeypatch.setattr(native, "causal_schedule_indices", lambda *a, **k: None)
+    sess.drain()
+    assert not any(s.fallback for s in sess.docs)
+    out = sess.read_all()
+    for d, w in enumerate(workloads):
+        assert out[d] == _oracle_spans(w), f"doc {d}"
+
+
+def test_makelist_frame_redelivery_stays_fast_path(workloads):
+    """Duplicate delivery of the frame holding the doc's makeList is a
+    routine anti-entropy event and must not demote the doc."""
+    w = workloads[0]
+    frame = encode_frame(_changes_of(w))
+    sess = _session(num_docs=1)
+    sess.ingest_frame(0, frame)
+    sess.step()
+    sess.ingest_frame(0, frame)  # full retransmission
+    sess.drain()
+    assert sess.docs[0].frame_mode and not sess.docs[0].fallback
+    assert sess.read(0) == _oracle_spans(w)
+
+
+def test_wrong_shape_spillover_json_raises_valueerror():
+    """A frame whose JSON-spillover string is valid JSON of the wrong shape
+    must raise the documented ValueError, matching decode_frame's contract."""
+    from peritext_tpu.core.types import Change, Operation
+    from peritext_tpu.core.opids import ROOT
+
+    bogus = Change(
+        actor="doc1", seq=1, deps={}, start_op=1,
+        ops=[Operation(action="makeMap", obj=ROOT, opid=(1, "doc1"), key="m")],
+    )
+    frame = bytearray(encode_frame([bogus]))
+    # corrupt the spillover string table entry into valid-but-wrong JSON: we
+    # can't easily patch bytes, so instead simulate via a frame whose op JSON
+    # round-trips to a dict missing required fields
+    import json as jsonlib
+
+    from peritext_tpu.ops.frames import parse_frame
+    from peritext_tpu.utils.interning import Interner, OrderedActorTable
+
+    good = jsonlib.dumps(bogus.ops[0].to_json()).encode()
+    # same-length substitution keeps the string-table length prefix valid
+    # (trailing spaces are legal JSON whitespace)
+    raw = b"[1,2,3]" + b" " * (len(good) - 7)
+    patched = bytes(frame).replace(good, raw)
+    if patched == bytes(frame):  # string table stores the op JSON verbatim
+        pytest.skip("frame layout changed; spillover not found")
+    with pytest.raises(ValueError):
+        parse_frame(
+            patched, OrderedActorTable(["doc1"]), Interner(), 0
+        )
