@@ -201,6 +201,15 @@ def parse_frame(
     if np.any(kinds == KIND_BAD):
         raise FrameIngestError("op outside packed-id range")
 
+    ins_rows = kinds == KIND_INS
+    if np.any(ins_rows):
+        cps = ops[ins_rows, 4]
+        # same contract as the object path (decode_frame -> chr(cp) raises):
+        # an out-of-range codepoint is frame corruption, caught at the door
+        # rather than poisoning device state and every later read
+        if cps.min(initial=0) < 0 or cps.max(initial=0) > 0x10FFFF:
+            raise ValueError("corrupt frame: insert codepoint out of range")
+
     mark_rows = kinds == KIND_MARK
     if np.any(mark_rows):
         mtypes = ops[mark_rows, 4]

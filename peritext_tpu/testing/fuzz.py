@@ -32,6 +32,7 @@ from typing import Dict, List, Optional
 
 from ..core.doc import Doc
 from ..core.types import Change, InputOperation, Patch
+from ..native import available as native_available
 from ..parallel.anti_entropy import ChangeStore, apply_changes
 from ..parallel.causal import causal_schedule
 from ..parallel.faults import FaultSpec, perturb_delivery
@@ -251,13 +252,70 @@ def run_differential(
     return device_docs
 
 
+def run_differential_frames(
+    seed: int, num_docs: int, ops_per_doc: int, chunk: int = 9
+) -> int:
+    """Streaming frame-ingest differential: deliver each doc's changes as
+    shuffled, chunked, partially duplicated wire frames interleaved with
+    device rounds, then assert final spans equal the scalar oracle.
+    Returns the number of docs that stayed on the frame fast path."""
+    import random
+
+    from ..api.batch import _oracle_doc
+    from ..parallel.codec import encode_frame
+    from ..parallel.streaming import StreamingMerge
+
+    rng = random.Random(seed ^ 0xF7A3E5)
+    workloads = generate_workload(seed, num_docs=num_docs, ops_per_doc=ops_per_doc)
+    sess = StreamingMerge(
+        num_docs=num_docs,
+        actors=("doc1", "doc2", "doc3"),
+        slot_capacity=max(256, 4 * ops_per_doc),
+        mark_capacity=max(64, ops_per_doc),
+        tomb_capacity=max(128, ops_per_doc),
+        round_insert_capacity=128,
+        round_delete_capacity=64,
+        round_mark_capacity=64,
+    )
+    for d, w in enumerate(workloads):
+        changes = [ch for log in w.values() for ch in log]
+        rng.shuffle(changes)
+        frames = [
+            encode_frame(changes[i : i + chunk]) for i in range(0, len(changes), chunk)
+        ]
+        if frames and rng.random() < 0.5:
+            frames.insert(rng.randrange(len(frames) + 1), rng.choice(frames))
+        for f in frames:
+            sess.ingest_frame(d, f)
+            if rng.random() < 0.5:
+                sess.step()
+    sess.drain()
+    out = sess.read_all()
+    for d, w in enumerate(workloads):
+        expected = _oracle_doc(w).get_text_with_formatting(["text"])
+        assert out[d] == expected, (
+            f"seed={seed} doc={d}: frame-streamed spans diverge from oracle\n"
+            f"device: {out[d]}\noracle: {expected}"
+        )
+    assert sess.pending_count() == 0, f"seed={seed}: undelivered changes remain"
+    on_fast_path = sum(1 for s in sess.docs if s.frame_mode and not s.fallback)
+    # Without the native core every frame legitimately routes to the object
+    # path (the native layer is an accelerator, never a requirement) — only a
+    # genuine all-docs demotion with the core present is a regression.
+    if num_docs and on_fast_path == 0 and native_available():
+        raise RuntimeError(f"seed={seed}: every doc left the frame fast path")
+    return on_fast_path
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     """CLI for ``make fuzz`` (the reference's ``npm run fuzz`` analog,
     test/fuzz.ts:167 — but bounded by default and with real removeMark fuzzing).
 
     ``--differential`` switches to device-vs-oracle differential fuzzing:
     each round converges a fresh batch of fuzz workloads through the batched
-    TPU path and asserts span + cursor equality against the scalar oracle."""
+    TPU path and asserts span + cursor equality against the scalar oracle.
+    ``--differential-frames`` does the same through StreamingMerge's
+    frame-native ingest with shuffled/duplicated wire-frame delivery."""
     import argparse
 
     parser = argparse.ArgumentParser(description="Peritext convergence fuzzer")
@@ -267,6 +325,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument(
         "--differential", action="store_true",
         help="fuzz the batched device path against the scalar oracle",
+    )
+    parser.add_argument(
+        "--differential-frames", action="store_true",
+        help="fuzz the streaming frame-ingest path against the scalar oracle",
     )
     parser.add_argument("--docs", type=int, default=32, help="docs per differential round")
     parser.add_argument(
@@ -286,7 +348,14 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     seed = args.seed
     while True:
-        if args.differential:
+        if args.differential_frames:
+            fast = run_differential_frames(seed, args.docs, args.ops_per_doc)
+            print(
+                f"frames-differential seed={seed}: {args.docs} docs x "
+                f"{args.ops_per_doc} ops ({fast} on fast path) match the oracle",
+                flush=True,
+            )
+        elif args.differential:
             device_docs = run_differential(
                 seed, args.docs, args.ops_per_doc, batch=batch
             )

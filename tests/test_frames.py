@@ -261,3 +261,24 @@ def test_wrong_shape_spillover_json_raises_valueerror():
         parse_frame(
             patched, OrderedActorTable(["doc1"]), Interner(), 0
         )
+
+
+def test_out_of_range_codepoint_rejected_at_ingest(workloads):
+    """A frame whose insert codepoint exceeds chr() range must raise
+    ValueError at the door, not poison device state (object path parity)."""
+    from peritext_tpu.ops.frames import parse_frame
+    from peritext_tpu.utils.interning import Interner, OrderedActorTable
+
+    docs, _, initial = generate_docs("a", 1)
+    frame = bytearray(encode_frame([initial]))
+    # 'a' (0x61) zigzags to 0xC2 0x01 (2-byte varint); swap in a decodable
+    # varint for zigzag(0x200000) — a codepoint beyond chr() range
+    idx = bytes(frame).rindex(b"\xc2\x01")
+    patched = bytes(frame[:idx]) + b"\x80\x80\x80\x02" + bytes(frame[idx + 2:])
+    # fix header payload length (+2 bytes)
+    import struct
+    hdr = struct.Struct("<4sBIIQQ")
+    magic, ver, nc, ns, ni, pl = hdr.unpack_from(patched)
+    patched = hdr.pack(magic, ver, nc, ns, ni, pl + 2) + patched[hdr.size:]
+    with pytest.raises(ValueError, match="codepoint"):
+        parse_frame(patched, OrderedActorTable(["doc1"]), Interner(), 0)
