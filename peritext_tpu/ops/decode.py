@@ -23,6 +23,32 @@ _EM = MARK_INDEX["em"]
 _LINK = MARK_INDEX["link"]
 
 
+def decode_slot_marks(
+    resolved: ResolvedDocs, doc_index: int, slot: int, attr_table: Interner
+) -> dict:
+    """Flattened MarkMap for one visible slot of a (numpy-converted)
+    ResolvedDocs batch — the single source of truth for turning resolved
+    device arrays into mark dicts (shared by the span read path and the
+    patch diff path, ops/patches.py)."""
+    d = doc_index
+    lww = np.asarray(resolved.lww_active[d])
+    marks: dict = {}
+    if lww[_STRONG, slot]:
+        marks["strong"] = {"active": True}
+    if lww[_EM, slot]:
+        marks["em"] = {"active": True}
+    if lww[_LINK, slot]:
+        url = attr_table.lookup(int(np.asarray(resolved.link_attr[d])[slot]))
+        marks["link"] = {"active": True, "url": url}
+    comments = np.asarray(resolved.comment_active[d])
+    active_ids = sorted(
+        attr_table.lookup(int(c)) for c in np.nonzero(comments[:, slot])[0]
+    )
+    if active_ids:
+        marks["comment"] = [{"id": cid} for cid in active_ids]
+    return marks
+
+
 def decode_doc_spans(
     resolved: ResolvedDocs, doc_index: int, attr_table: Interner
 ) -> List[FormatSpan]:
@@ -30,25 +56,10 @@ def decode_doc_spans(
     d = doc_index
     visible = np.asarray(resolved.visible[d])
     chars = np.asarray(resolved.char[d])
-    lww = np.asarray(resolved.lww_active[d])
-    link_attr = np.asarray(resolved.link_attr[d])
-    comments = np.asarray(resolved.comment_active[d])
 
     spans: List[FormatSpan] = []
     for slot in np.nonzero(visible)[0]:
-        marks = {}
-        if lww[_STRONG, slot]:
-            marks["strong"] = {"active": True}
-        if lww[_EM, slot]:
-            marks["em"] = {"active": True}
-        if lww[_LINK, slot]:
-            url = attr_table.lookup(int(link_attr[slot]))
-            marks["link"] = {"active": True, "url": url}
-        active_ids = sorted(
-            attr_table.lookup(int(c)) for c in np.nonzero(comments[:, slot])[0]
-        )
-        if active_ids:
-            marks["comment"] = [{"id": cid} for cid in active_ids]
+        marks = decode_slot_marks(resolved, d, slot, attr_table)
         add_characters_to_spans([chr(int(chars[slot]))], marks, spans)
     return spans
 

@@ -107,6 +107,8 @@ class StreamingMerge:
         self.comment_capacity = comment_capacity
         self.docs = [_DocSession() for _ in range(num_docs)]
         self.rounds = 0
+        self._patch_base: Dict[int, list] = {}
+        self._resolved_cache = None  # (rounds, numpy ResolvedDocs)
         self._actor_table = OrderedActorTable(self.actors)
         state = empty_docs(num_docs, slot_capacity, mark_capacity, tomb_capacity)
         self.state: PackedDocs = shard_docs(state, mesh) if mesh is not None else state
@@ -376,18 +378,58 @@ class StreamingMerge:
             return sess.attrs
         return sess.encoder.attrs if sess.encoder else None
 
+    def _resolved_numpy(self):
+        """Numpy-converted span resolution of the current device state,
+        cached per round: read/read_all/read_patches called per doc between
+        steps share ONE device resolve + host transfer instead of D."""
+        if self._resolved_cache is not None and self._resolved_cache[0] == self.rounds:
+            return self._resolved_cache[1]
+        resolved = resolve_jit(self.state, self.comment_capacity)
+        resolved = type(resolved)(*(np.asarray(x) for x in resolved))
+        self._resolved_cache = (self.rounds, resolved)
+        return resolved
+
     def read(self, doc_index: int) -> List[FormatSpan]:
         sess = self.docs[doc_index]
         overflow = bool(np.asarray(self.state.overflow)[doc_index])
         if sess.fallback or overflow:
             return _replay_spans(self._replay_changes(sess))
-        resolved = resolve_jit(self.state, self.comment_capacity)
-        resolved = type(resolved)(*(np.asarray(x) for x in resolved))
+        resolved = self._resolved_numpy()
         return decode_doc_spans(resolved, doc_index, self._attr_table(sess))
 
+    def read_patches(self, doc_index: int) -> List:
+        """Incremental reference-shaped patches since this doc's previous
+        ``read_patches`` call (the first call builds the doc from empty) —
+        config 5's "async patch scatter": device state is diffed host-side
+        between reads (ops/patches.py), keyed on stable element identities,
+        so editors receive the same patch vocabulary the scalar path emits
+        (insert/delete/addMark/removeMark, testing/accumulate.py model)."""
+        from ..ops.patches import diff_patches
+
+        chars = self._doc_chars(doc_index)
+        base = self._patch_base.get(doc_index, [])
+        patches = diff_patches(base, chars)
+        self._patch_base[doc_index] = chars
+        return patches
+
+    def _doc_chars(self, doc_index: int):
+        from ..ops.patches import doc_chars_device, doc_chars_scalar
+
+        sess = self.docs[doc_index]
+        overflow = bool(np.asarray(self.state.overflow)[doc_index])
+        if sess.fallback or overflow:
+            return doc_chars_scalar(_replay_doc(self._replay_changes(sess)))
+        resolved = self._resolved_numpy()
+        return doc_chars_device(
+            resolved,
+            doc_index,
+            self._attr_table(sess),
+            np.asarray(self.state.elem_id)[doc_index],
+            self._actor_table,
+        )
+
     def read_all(self) -> List[List[FormatSpan]]:
-        resolved = resolve_jit(self.state, self.comment_capacity)
-        resolved = type(resolved)(*(np.asarray(x) for x in resolved))
+        resolved = self._resolved_numpy()
         overflow = np.asarray(resolved.overflow)
         out: List[List[FormatSpan]] = []
         for i, sess in enumerate(self.docs):
@@ -426,12 +468,16 @@ class StreamingMerge:
         )
 
 
-def _replay_spans(changes: List[Change]) -> List[FormatSpan]:
+def _replay_doc(changes: List[Change]) -> Doc:
     doc = Doc("streaming-fallback")
     ordered, stuck = causal_schedule(changes)
     for ch in ordered:
         doc.apply_change(ch)
-    return doc.get_text_with_formatting(["text"])
+    return doc
+
+
+def _replay_spans(changes: List[Change]) -> List[FormatSpan]:
+    return _replay_doc(changes).get_text_with_formatting(["text"])
 
 
 def rebalance(workload_sizes: Sequence[int], num_shards: int) -> List[List[int]]:

@@ -1,0 +1,163 @@
+"""Patch emission from the batched path: identity-keyed host diff.
+
+Oracle: accumulate_patches (the reference's naive patch-replay model) over
+the emitted stream must reproduce the target state's spans exactly.
+"""
+
+import pytest
+
+from peritext_tpu.api.batch import _oracle_doc
+from peritext_tpu.ops.patches import (
+    as_insert_patches,
+    diff_patches,
+    doc_chars_scalar,
+)
+from peritext_tpu.parallel.codec import encode_frame
+from peritext_tpu.parallel.streaming import StreamingMerge
+from peritext_tpu.testing.accumulate import accumulate_patches
+from peritext_tpu.testing.fuzz import generate_workload
+from peritext_tpu.testing.generate import generate_docs
+
+ACTORS = ("doc1", "doc2", "doc3")
+
+
+def _spans_of(chars):
+    """Span form of a CharState list via the accumulate oracle."""
+    return accumulate_patches(as_insert_patches(chars))
+
+
+def _assert_diff_replays(before, after):
+    patches = as_insert_patches(before) + diff_patches(before, after)
+    assert accumulate_patches(patches) == _spans_of(after)
+    return diff_patches(before, after)
+
+
+def test_pure_insert_and_delete():
+    a = [((1, "a"), "h", {}), ((2, "a"), "i", {})]
+    b = [((1, "a"), "h", {}), ((3, "b"), "e", {}), ((2, "a"), "i", {})]
+    patches = _assert_diff_replays(a, b)
+    assert patches == [
+        {"action": "insert", "path": ["text"], "index": 1, "values": ["e"], "marks": {}}
+    ]
+    patches = _assert_diff_replays(b, a)
+    assert patches == [{"action": "delete", "path": ["text"], "index": 1, "count": 1}]
+
+
+def test_replace_and_mark_changes():
+    strong = {"strong": {"active": True}}
+    a = [((1, "a"), "x", {}), ((2, "a"), "y", {}), ((3, "a"), "z", {})]
+    b = [((1, "a"), "x", strong), ((4, "b"), "q", strong), ((3, "a"), "z", {})]
+    patches = _assert_diff_replays(a, b)
+    actions = [p["action"] for p in patches]
+    assert actions == ["delete", "insert", "addMark"]
+    assert patches[2] == {
+        "action": "addMark", "path": ["text"],
+        "startIndex": 0, "endIndex": 1, "markType": "strong",
+    }
+
+
+def test_mark_runs_merge_contiguously():
+    strong = {"strong": {"active": True}}
+    a = [((i, "a"), "x", {}) for i in range(1, 6)]
+    b = [(cid, ch, strong) for cid, ch, _ in a]
+    patches = _assert_diff_replays(a, b)
+    assert patches == [
+        {"action": "addMark", "path": ["text"],
+         "startIndex": 0, "endIndex": 5, "markType": "strong"}
+    ]
+
+
+def test_link_value_change_and_comment_sets():
+    l1 = {"link": {"active": True, "url": "https://a"}}
+    l2 = {"link": {"active": True, "url": "https://b"}}
+    c1 = {"comment": [{"id": "c1"}]}
+    c12 = {"comment": [{"id": "c1"}, {"id": "c2"}]}
+    a = [((1, "a"), "x", l1), ((2, "a"), "y", c1)]
+    b = [((1, "a"), "x", l2), ((2, "a"), "y", c12)]
+    patches = _assert_diff_replays(a, b)
+    assert {"action": "addMark", "path": ["text"], "startIndex": 0, "endIndex": 1,
+            "markType": "link", "attrs": {"url": "https://b"}} in patches
+    assert {"action": "addMark", "path": ["text"], "startIndex": 1, "endIndex": 2,
+            "markType": "comment", "attrs": {"id": "c2"}} in patches
+    # and removal
+    patches = _assert_diff_replays(b, a)
+    assert {"action": "addMark", "path": ["text"], "startIndex": 0, "endIndex": 1,
+            "markType": "link", "attrs": {"url": "https://a"}} in patches
+    assert {"action": "removeMark", "path": ["text"], "startIndex": 1, "endIndex": 2,
+            "markType": "comment", "attrs": {"id": "c2"}} in patches
+
+
+def test_scalar_chars_roundtrip():
+    docs, _, initial = generate_docs("hello world", 2)
+    d1, _ = docs
+    d1.change([{"path": ["text"], "action": "addMark", "startIndex": 0,
+                "endIndex": 5, "markType": "strong"}])
+    chars = doc_chars_scalar(d1)
+    assert _spans_of(chars) == d1.get_text_with_formatting(["text"])
+
+
+@pytest.fixture(scope="module")
+def workloads():
+    return generate_workload(seed=91, num_docs=3, ops_per_doc=110)
+
+
+def _session(num_docs):
+    return StreamingMerge(
+        num_docs=num_docs, actors=ACTORS, slot_capacity=512, mark_capacity=128,
+        round_insert_capacity=128, round_delete_capacity=64, round_mark_capacity=64,
+    )
+
+
+def test_streaming_incremental_patches_accumulate_to_final(workloads):
+    import random
+
+    rng = random.Random(5)
+    sess = _session(len(workloads))
+    streams = {d: [] for d in range(len(workloads))}
+    arrivals = []
+    for d, w in enumerate(workloads):
+        changes = [ch for log in w.values() for ch in log]
+        rng.shuffle(changes)
+        arrivals.append([changes[i : i + 13] for i in range(0, len(changes), 13)])
+    rounds = max(len(a) for a in arrivals)
+    for r in range(rounds):
+        for d, batches in enumerate(arrivals):
+            if r < len(batches):
+                sess.ingest_frame(d, encode_frame(batches[r]))
+        sess.drain()
+        for d in range(len(workloads)):
+            streams[d].extend(sess.read_patches(d))
+
+    for d, w in enumerate(workloads):
+        expected = _oracle_doc(w).get_text_with_formatting(["text"])
+        assert accumulate_patches(streams[d]) == expected, f"doc {d}"
+        assert sess.read_patches(d) == []  # quiescent: no spurious patches
+
+
+def test_streaming_patches_across_fallback_demotion():
+    """A doc that demotes mid-session keeps emitting consistent patches:
+    identities are (ctr, actor) on both the device and scalar paths, so the
+    post-demotion diff is incremental, not a delete-all/re-insert."""
+    docs, _, initial = generate_docs("hello world", 1)
+    (d1,) = docs
+    sess = _session(1)
+    sess.ingest_frame(0, encode_frame([initial]))
+    sess.drain()
+    stream = sess.read_patches(0)  # device path
+    assert not sess.docs[0].fallback
+
+    c1, _ = d1.change(
+        [{"path": ["text"], "action": "insert", "index": 11, "values": list("!")},
+         {"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 5,
+          "markType": "em"}]
+    )
+    c2, _ = d1.change([{"path": [], "action": "makeMap", "key": "comments"}])
+    sess.ingest_frame(0, encode_frame([c1, c2]))  # non-text op: demotes
+    sess.drain()
+    assert sess.docs[0].fallback
+    increment = sess.read_patches(0)  # scalar path
+    # incremental, not a rebuild: no delete of the surviving prefix
+    assert not any(p["action"] == "delete" for p in increment)
+    assert accumulate_patches(stream + increment) == d1.get_text_with_formatting(
+        ["text"]
+    )
