@@ -186,13 +186,48 @@ class Editor:
         start_queue: bool = False,
         on_remote_patch: Optional[Callable[["Editor", Patch], None]] = None,
         on_event: Optional[Callable[[EditorEvent], None]] = None,
+        backend: str = "scalar",
+        actors: Optional[Sequence[str]] = None,
+        backend_config: Optional[Dict[str, Any]] = None,
     ) -> None:
+        """``backend`` selects who maintains the editor view:
+
+        * ``"scalar"`` (default): the reference architecture — patches from
+          the in-process scalar CRDT drive the view.
+        * ``"tpu"``: the batched device engine — every change (local and
+          remote) is also ingested into a :class:`~..parallel.streaming.
+          StreamingMerge` session and the view is driven by its incremental
+          ``read_patches`` stream.  Same ``InputOperation`` in, same
+          ``Patch`` vocabulary out (the BASELINE boundary contract); the
+          scalar ``Doc`` remains the local op *generator* (index→element
+          anchoring needs full local state either way).  ``actors`` must
+          declare the replica set (packed-id order is fixed up front).
+        """
         self.actor_id = actor_id
         self.doc = Doc(actor_id)
         self.view = EditorDoc()
         self.publisher = publisher
         self.on_remote_patch = on_remote_patch
         self.on_event = on_event
+        self.backend = backend
+        self.session = None
+        if backend == "tpu":
+            from ..parallel.streaming import StreamingMerge
+
+            config = dict(backend_config or {})
+            config.setdefault("slot_capacity", 1024)
+            config.setdefault("mark_capacity", 256)
+            # generous round widths (cheap at num_docs=1): a single editor
+            # transaction — e.g. a large paste — must fit one round, else
+            # the session demotes the doc to scalar replay
+            config.setdefault("round_insert_capacity", 512)
+            config.setdefault("round_delete_capacity", 256)
+            config.setdefault("round_mark_capacity", 128)
+            self.session = StreamingMerge(
+                num_docs=1, actors=list(actors or (actor_id,)), **config
+            )
+        elif backend != "scalar":
+            raise ValueError(f"unknown merge backend: {backend!r}")
         self._holdback: List[Change] = []
         self.queue = ChangeQueue(self._flush, interval=queue_interval)
         if publisher is not None:
@@ -209,12 +244,30 @@ class Editor:
         """Apply raw input operations locally (the playback interpreter drives
         editors this way, reference ``executeTraceEvent`` src/playback.ts:102-115)."""
         change, patches = self.doc.change(input_ops)
-        for patch in patches:
-            for step in patch_to_steps(patch):
-                step.apply(self.view)
+        if self.session is not None:
+            self._backend_ingest(change)
+            self._backend_view_sync(remote=False)
+        else:
+            for patch in patches:
+                for step in patch_to_steps(patch):
+                    step.apply(self.view)
         self.queue.enqueue(change)
         self._emit("local-change", ops=len(change.ops), seq=change.seq)
         return change
+
+    # -- tpu backend plumbing ----------------------------------------------
+
+    def _backend_ingest(self, change: Change) -> None:
+        self.session.ingest(0, [change])
+
+    def _backend_view_sync(self, remote: bool) -> None:
+        """Advance the view by the device session's incremental patches."""
+        self.session.drain()
+        for patch in self.session.read_patches(0):
+            for step in patch_to_steps(patch):
+                step.apply(self.view)
+            if remote and self.on_remote_patch is not None:
+                self.on_remote_patch(self, patch)
 
     # -- outbound ----------------------------------------------------------
 
@@ -239,6 +292,7 @@ class Editor:
 
     def _drain_holdback(self) -> None:
         progressed = True
+        applied_remote = False
         while progressed and self._holdback:
             progressed = False
             remaining: List[Change] = []
@@ -252,13 +306,19 @@ class Editor:
                     remaining.append(change)
                     continue
                 progressed = True
-                for patch in patches:
-                    for step in patch_to_steps(patch):
-                        step.apply(self.view)
-                    if self.on_remote_patch is not None:
-                        self.on_remote_patch(self, patch)
+                if self.session is not None:
+                    self._backend_ingest(change)
+                    applied_remote = True
+                else:
+                    for patch in patches:
+                        for step in patch_to_steps(patch):
+                            step.apply(self.view)
+                        if self.on_remote_patch is not None:
+                            self.on_remote_patch(self, patch)
                 self._emit("remote-change", actor=change.actor, seq=change.seq)
             self._holdback = remaining
+        if applied_remote:
+            self._backend_view_sync(remote=True)
 
     def apply_remote(self, *changes: Change) -> None:
         """Directly deliver remote changes (tests / transports without pubsub)."""
@@ -271,8 +331,13 @@ class Editor:
             self.on_event(EditorEvent(kind, self.actor_id, detail))
 
     def rerender(self) -> None:
-        """Full re-render of the view from the CRDT (used after init)."""
-        self.view = editor_doc_from_crdt(self.doc)
+        """Full re-render of the view (used after init).  Scalar backend:
+        from the CRDT; tpu backend: advance by the session's patch stream
+        (the view is exclusively patch-driven there)."""
+        if self.session is not None:
+            self._backend_view_sync(remote=False)
+        else:
+            self.view = editor_doc_from_crdt(self.doc)
 
     @property
     def text(self) -> str:
@@ -315,6 +380,8 @@ def initialize_docs(editors: Sequence[Editor], initial_text: str = DEFAULT_INITI
     for editor in rest:
         editor.doc.apply_change(change)
     for editor in editors:
+        if editor.session is not None:  # tpu backend: the view is session-fed
+            editor._backend_ingest(change)
         editor.rerender()
     return change
 

@@ -87,6 +87,20 @@ def spans_text(spans: Iterable[FormatSpan]) -> str:
     return "".join(s["text"] for s in spans)
 
 
+def copy_marks(marks: MarkMap) -> MarkMap:
+    """One-level-deep copy of a flattened MarkMap (list-valued comment
+    entries copied per item; scalar values passed through)."""
+    out: MarkMap = {}
+    for k, v in marks.items():
+        if isinstance(v, list):
+            out[k] = [dict(item) for item in v]
+        elif isinstance(v, dict):
+            out[k] = dict(v)
+        else:
+            out[k] = v
+    return out
+
+
 def spans_equal(a: List[FormatSpan], b: List[FormatSpan]) -> bool:
     return a == b
 

@@ -23,44 +23,50 @@ _EM = MARK_INDEX["em"]
 _LINK = MARK_INDEX["link"]
 
 
-def decode_slot_marks(
-    resolved: ResolvedDocs, doc_index: int, slot: int, attr_table: Interner
-) -> dict:
-    """Flattened MarkMap for one visible slot of a (numpy-converted)
+class DocMarkDecoder:
+    """Per-slot MarkMap extraction for ONE doc of a (numpy-converted)
     ResolvedDocs batch — the single source of truth for turning resolved
-    device arrays into mark dicts (shared by the span read path and the
-    patch diff path, ops/patches.py)."""
-    d = doc_index
-    lww = np.asarray(resolved.lww_active[d])
-    marks: dict = {}
-    if lww[_STRONG, slot]:
-        marks["strong"] = {"active": True}
-    if lww[_EM, slot]:
-        marks["em"] = {"active": True}
-    if lww[_LINK, slot]:
-        url = attr_table.lookup(int(np.asarray(resolved.link_attr[d])[slot]))
-        marks["link"] = {"active": True, "url": url}
-    comments = np.asarray(resolved.comment_active[d])
-    active_ids = sorted(
-        attr_table.lookup(int(c)) for c in np.nonzero(comments[:, slot])[0]
-    )
-    if active_ids:
-        marks["comment"] = [{"id": cid} for cid in active_ids]
-    return marks
+    device arrays into mark dicts, shared by the span read path and the
+    patch diff path (ops/patches.py).  Per-doc rows are sliced once at
+    construction; ``marks_at`` is then cheap per visible slot."""
+
+    def __init__(self, resolved: ResolvedDocs, doc_index: int, attr_table: Interner):
+        d = doc_index
+        self._attrs = attr_table
+        self.visible = np.asarray(resolved.visible[d])
+        self.chars = np.asarray(resolved.char[d])
+        self._lww = np.asarray(resolved.lww_active[d])
+        self._link_attr = np.asarray(resolved.link_attr[d])
+        self._comments = np.asarray(resolved.comment_active[d])
+
+    def marks_at(self, slot: int) -> dict:
+        marks: dict = {}
+        if self._lww[_STRONG, slot]:
+            marks["strong"] = {"active": True}
+        if self._lww[_EM, slot]:
+            marks["em"] = {"active": True}
+        if self._lww[_LINK, slot]:
+            url = self._attrs.lookup(int(self._link_attr[slot]))
+            marks["link"] = {"active": True, "url": url}
+        active_ids = sorted(
+            self._attrs.lookup(int(c))
+            for c in np.nonzero(self._comments[:, slot])[0]
+        )
+        if active_ids:
+            marks["comment"] = [{"id": cid} for cid in active_ids]
+        return marks
 
 
 def decode_doc_spans(
     resolved: ResolvedDocs, doc_index: int, attr_table: Interner
 ) -> List[FormatSpan]:
     """Decode one document of a (numpy-converted) ResolvedDocs batch."""
-    d = doc_index
-    visible = np.asarray(resolved.visible[d])
-    chars = np.asarray(resolved.char[d])
-
+    dec = DocMarkDecoder(resolved, doc_index, attr_table)
     spans: List[FormatSpan] = []
-    for slot in np.nonzero(visible)[0]:
-        marks = decode_slot_marks(resolved, d, slot, attr_table)
-        add_characters_to_spans([chr(int(chars[slot]))], marks, spans)
+    for slot in np.nonzero(dec.visible)[0]:
+        add_characters_to_spans(
+            [chr(int(dec.chars[slot]))], dec.marks_at(slot), spans
+        )
     return spans
 
 

@@ -42,18 +42,17 @@ def doc_chars_device(
     are unpacked to ``(ctr, actor_string)`` so they are stable across the
     device and scalar paths (a doc that demotes mid-session keeps diffing
     cleanly).  Mark extraction is shared with the span read path
-    (decode.decode_slot_marks) so the two can never diverge."""
-    from .decode import decode_slot_marks
+    (decode.DocMarkDecoder) so the two can never diverge."""
+    from .decode import DocMarkDecoder
 
-    d = doc_index
-    visible = np.asarray(resolved.visible[d])
-    chars = np.asarray(resolved.char[d])
-
+    dec = DocMarkDecoder(resolved, doc_index, attr_table)
     out: List[CharState] = []
-    for slot in np.nonzero(visible)[0]:
-        marks = decode_slot_marks(resolved, d, slot, attr_table)
+    for slot in np.nonzero(dec.visible)[0]:
         ctr, actor_idx = unpack_id(int(elem_ids[slot]))
-        out.append(((ctr, actor_table.lookup(actor_idx)), chr(int(chars[slot])), marks))
+        out.append(
+            ((ctr, actor_table.lookup(actor_idx)), chr(int(dec.chars[slot])),
+             dec.marks_at(slot))
+        )
     return out
 
 
@@ -71,11 +70,7 @@ def doc_chars_scalar(doc, path=("text",)) -> List[CharState]:
     return out
 
 
-def _copy_marks(marks: Dict[str, Any]) -> Dict[str, Any]:
-    return {
-        k: ([dict(c) for c in v] if isinstance(v, list) else dict(v))
-        for k, v in marks.items()
-    }
+from ..core.spans import copy_marks as _copy_marks  # shared MarkMap copy
 
 
 def diff_patches(

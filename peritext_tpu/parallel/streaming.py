@@ -203,6 +203,13 @@ class StreamingMerge:
                 # prefix whose stream usage fits; the rest waits (shapes stay
                 # constant, docs just take extra rounds)
                 admitted, deferred = self._budget(ordered, ki, kd, km)
+                if not admitted and ordered and self._never_fits(ordered[0], ki, kd, km):
+                    # a single change larger than a round width can never be
+                    # admitted: demote instead of wedging the doc (and every
+                    # change behind it) forever — the frame path's batched
+                    # scheduler does the same via its demote status
+                    sess.fallback = True
+                    GLOBAL_COUNTERS.add("streaming.fallback_docs")
                 streams, ok = sess.encoder.encode_increment(admitted)
                 if not ok:
                     sess.fallback = True
@@ -345,6 +352,13 @@ class StreamingMerge:
         while rounds < max_rounds and self.step() > 0:
             rounds += 1
         return rounds
+
+    @staticmethod
+    def _never_fits(change: Change, ki: int, kd: int, km: int) -> bool:
+        ci = sum(1 for op in change.ops if op.action == "set" and op.insert)
+        cd = sum(1 for op in change.ops if op.action == "del")
+        cm = sum(1 for op in change.ops if op.action in ("addMark", "removeMark"))
+        return ci > ki or cd > kd or cm > km
 
     @staticmethod
     def _budget(ordered: List[Change], ki: int, kd: int, km: int):

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-from ..core.spans import add_characters_to_spans
+from ..core.spans import add_characters_to_spans, copy_marks as _copy_marks
 from ..core.types import FormatSpan, Patch
 
 
@@ -76,13 +76,3 @@ def accumulate_patches(patches: List[Patch]) -> List[FormatSpan]:
     return spans
 
 
-def _copy_marks(marks: Dict[str, Any]) -> Dict[str, Any]:
-    out: Dict[str, Any] = {}
-    for k, v in marks.items():
-        if isinstance(v, list):
-            out[k] = [dict(item) for item in v]
-        elif isinstance(v, dict):
-            out[k] = dict(v)
-        else:
-            out[k] = v
-    return out

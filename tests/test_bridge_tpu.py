@@ -1,0 +1,122 @@
+"""The bridge's ``tpu`` merge backend (the BASELINE boundary contract):
+same ``InputOperation`` in, same ``Patch`` vocabulary out, but the editor
+view is driven by the device engine's incremental patch stream instead of
+the scalar CRDT's patches.  Scalar-backend editors are the oracle.
+"""
+
+import pytest
+
+from peritext_tpu.bridge import Editor, create_editor, editor_doc_from_crdt, initialize_docs
+from peritext_tpu.bridge.commands import set_link, toggle_bold, type_text
+from peritext_tpu.bridge.model import Transaction
+from peritext_tpu.parallel.pubsub import Publisher
+
+ACTORS = ("alice", "bob")
+
+
+def make_pair(backends=("tpu", "tpu"), text="The Peritext editor"):
+    pub = Publisher()
+    alice = create_editor("alice", pub, backend=backends[0], actors=ACTORS)
+    bob = create_editor("bob", pub, backend=backends[1], actors=ACTORS)
+    initialize_docs([alice, bob], text)
+    return pub, alice, bob
+
+
+def assert_views_match_scalar_render(*editors):
+    """The session-fed view must equal the full scalar CRDT render — the
+    cross-backend version of the bridge's dual-oracle invariant."""
+    for editor in editors:
+        assert editor.view == editor_doc_from_crdt(editor.doc), editor.actor_id
+
+
+def test_local_typing_updates_view_immediately():
+    _, alice, bob = make_pair()
+    type_text(alice, 1, "Hey! ")
+    assert alice.text == "Hey! The Peritext editor"
+    assert_views_match_scalar_render(alice)
+
+
+def test_concurrent_edits_converge_via_tpu_backend():
+    _, alice, bob = make_pair()
+    type_text(alice, 1, "A")
+    toggle_bold(bob, 2, 10)
+    set_link(bob, 5, 13, "https://x.test")
+    alice.sync()
+    bob.sync()
+    assert alice.view == bob.view
+    assert_views_match_scalar_render(alice, bob)
+
+
+def test_mixed_backends_converge():
+    _, alice, bob = make_pair(backends=("scalar", "tpu"))
+    type_text(alice, 1, "Hello ")
+    toggle_bold(bob, 1, 6)
+    alice.sync()
+    bob.sync()
+    assert alice.view == bob.view
+    assert_views_match_scalar_render(alice, bob)
+
+
+def test_out_of_order_delivery_with_tpu_backend():
+    alice = Editor("alice", backend="tpu", actors=ACTORS)
+    bob = Editor("bob", backend="tpu", actors=ACTORS)
+    initialize_docs([alice, bob], "abc")
+    c1 = alice.dispatch(Transaction().insert_text(1, "x"))
+    c2 = alice.dispatch(Transaction().insert_text(2, "y"))
+    c3 = alice.dispatch(Transaction().insert_text(3, "z"))
+    bob.apply_remote(c3)   # held back (causal gap)
+    bob.apply_remote(c2)   # still held back
+    assert bob.text == "abc"
+    bob.apply_remote(c1)   # releases all three
+    assert bob.text == alice.text == "xyzabc"
+    assert_views_match_scalar_render(alice, bob)
+
+
+def test_map_ops_demote_session_but_views_stay_correct():
+    _, alice, bob = make_pair()
+    # comment bodies live in a nested map: not expressible on the device
+    # fast path, so the backend session demotes to scalar replay — the
+    # patch stream (and therefore the view) must stay correct regardless
+    alice.dispatch_input_ops([{"path": [], "action": "makeMap", "key": "comments"}])
+    type_text(alice, 1, "Q")
+    alice.sync()
+    bob.sync()
+    assert alice.session.docs[0].fallback
+    assert alice.view == bob.view
+    assert_views_match_scalar_render(alice, bob)
+
+
+def test_unknown_backend_rejected():
+    with pytest.raises(ValueError):
+        Editor("zoe", backend="gpu")
+
+
+def test_fuzz_session_through_tpu_editors():
+    import random
+
+    rng = random.Random(11)
+    pub, alice, bob = make_pair()
+    editors = [alice, bob]
+    for step in range(40):
+        ed = editors[rng.randrange(2)]
+        n = len(ed.view)
+        roll = rng.random()
+        if roll < 0.5 or n < 4:
+            pos = rng.randrange(1, n + 2 - 1) if n else 1
+            type_text(ed, pos, rng.choice("abcdef "))
+        elif roll < 0.75:
+            a = rng.randrange(1, n)
+            b = rng.randrange(a + 1, n + 1)
+            toggle_bold(ed, a, b)
+        else:
+            a = rng.randrange(1, n)
+            b = rng.randrange(a + 1, n + 1)
+            ed.dispatch(Transaction().delete(a, b))
+        if rng.random() < 0.3:
+            alice.sync()
+            bob.sync()
+    alice.sync()
+    bob.sync()
+    alice.sync()
+    assert alice.view == bob.view
+    assert_views_match_scalar_render(alice, bob)

@@ -135,3 +135,26 @@ class TestRebalance:
         loads = [sum(sizes[i] for i in s) for s in shards]
         assert abs(loads[0] - loads[1]) <= 4
         assert sorted(i for s in shards for i in s) == list(range(8))
+
+
+def test_object_path_oversized_change_demotes_not_wedges():
+    """A single change exceeding a round width can never be admitted; the
+    object path must demote to scalar replay like the frame path does."""
+    from peritext_tpu.api.batch import _oracle_doc
+    from peritext_tpu.testing.generate import generate_docs
+
+    docs, _, initial = generate_docs("x", 1)
+    (d1,) = docs
+    big, _ = d1.change(
+        [{"path": ["text"], "action": "insert", "index": 1, "values": list("y" * 100)}]
+    )
+    sess = StreamingMerge(
+        num_docs=1, actors=("doc1",), slot_capacity=256, round_insert_capacity=32
+    )
+    sess.ingest(0, [initial, big])
+    rounds = sess.drain()
+    assert rounds < 10
+    assert sess.docs[0].fallback
+    assert sess.pending_count() == 0
+    w = {"doc1": [initial, big]}
+    assert sess.read(0) == _oracle_doc(w).get_text_with_formatting(["text"])
