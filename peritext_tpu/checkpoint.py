@@ -100,6 +100,83 @@ def load_packed(path: str | Path) -> PackedDocs:
 
 
 # ---------------------------------------------------------------------------
+# Streaming-session checkpoints (event-sourced: the frame log IS the state)
+# ---------------------------------------------------------------------------
+
+_LEN = "<I"
+
+
+def _write_frames(path: Path, frames: List[bytes]) -> None:
+    import struct
+
+    with open(path, "wb") as f:
+        for frame in frames:
+            f.write(struct.pack(_LEN, len(frame)))
+            f.write(frame)
+
+
+def _read_frames(path: Path) -> List[bytes]:
+    import struct
+
+    frames: List[bytes] = []
+    data = path.read_bytes()
+    pos = 0
+    while pos < len(data):
+        (length,) = struct.unpack_from(_LEN, data, pos)
+        pos += 4
+        frames.append(data[pos : pos + length])
+        pos += length
+    return frames
+
+
+def save_session(session, directory: str | Path) -> Dict[str, Any]:
+    """Checkpoint a :class:`~.parallel.streaming.StreamingMerge` session.
+
+    Durable form = per-doc wire-frame histories (event sourcing): restoring
+    re-ingests the frames, which reconstructs device state, clocks, attr
+    tables, and fallback routing exactly — no device-state serialization to
+    keep consistent.  Frames are duplicate-tolerant, so overlap between a
+    checkpoint and post-checkpoint redelivery is harmless.
+    """
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    total = 0
+    for i in range(session.num_docs):
+        frames = session.doc_history_frames(i)
+        total += len(frames)
+        _write_frames(directory / f"doc_{i:06d}.frames", frames)
+    meta = {
+        "kind": "streaming-session",
+        "actors": list(session.actors),
+        "rounds": session.rounds,
+        "frames": total,
+        "config": session.config,
+    }
+    (directory / "session.json").write_text(json.dumps(meta, indent=2))
+    return meta
+
+
+def restore_session(directory: str | Path, mesh=None, drain: bool = True):
+    """Rebuild a session from :func:`save_session` output by re-ingesting
+    every doc's frame history (and draining, unless ``drain=False``)."""
+    from .parallel.streaming import StreamingMerge
+
+    directory = Path(directory)
+    meta = json.loads((directory / "session.json").read_text())
+    # the config dict is written verbatim from StreamingMerge.config, so the
+    # key set can never drift between save and restore
+    session = StreamingMerge(actors=meta["actors"], mesh=mesh, **meta["config"])
+    for i in range(session.num_docs):
+        path = directory / f"doc_{i:06d}.frames"
+        if path.exists():
+            for frame in _read_frames(path):
+                session.ingest_frame(i, frame)
+    if drain:
+        session.drain()
+    return session
+
+
+# ---------------------------------------------------------------------------
 # Step-tagged checkpoints with atomic publish + retention
 # ---------------------------------------------------------------------------
 
@@ -121,6 +198,12 @@ class Checkpoint:
         path = self.directory / "packed.npz"
         return load_packed(path) if path.exists() else None
 
+    def session(self, mesh=None, drain: bool = True):
+        """Restore the streaming session saved in this checkpoint (None if
+        the checkpoint holds no session)."""
+        path = self.directory / "session"
+        return restore_session(path, mesh=mesh, drain=drain) if path.exists() else None
+
 
 class CheckpointManager:
     """Directory of step-tagged checkpoints.
@@ -140,10 +223,13 @@ class CheckpointManager:
         step: int,
         store: Optional[ChangeStore] = None,
         packed: Optional[PackedDocs] = None,
+        session=None,
         meta: Optional[Dict[str, Any]] = None,
     ) -> Path:
-        if store is None and packed is None:
-            raise ValueError("nothing to checkpoint: need a store and/or packed state")
+        if store is None and packed is None and session is None:
+            raise ValueError(
+                "nothing to checkpoint: need a store, packed state, or session"
+            )
         final = self.root / f"{_STEP_PREFIX}{step:012d}"
         staging = Path(tempfile.mkdtemp(prefix=".staging_", dir=self.root))
         try:
@@ -154,6 +240,8 @@ class CheckpointManager:
             if packed is not None:
                 save_packed(packed, staging / "packed.npz")
                 payload_meta["num_docs"] = int(packed.num_docs)
+            if session is not None:
+                payload_meta["session"] = save_session(session, staging / "session")
             (staging / "meta.json").write_text(json.dumps(payload_meta, indent=2))
             if final.exists():
                 shutil.rmtree(final)

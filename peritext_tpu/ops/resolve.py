@@ -46,7 +46,7 @@ from .packed import (
 NUM_TYPES = len(ALL_MARKS)
 COMMENT_TYPE = MARK_INDEX["comment"]
 LINK_TYPE = MARK_INDEX["link"]
-MARK_CHUNK = 8
+MARK_CHUNK = 32
 
 
 class ResolvedDocs(NamedTuple):

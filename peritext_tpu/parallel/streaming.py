@@ -40,6 +40,7 @@ from typing import Dict, Iterable, List, Optional, Sequence
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from .. import native
 from ..core.doc import Doc
@@ -354,22 +355,27 @@ class StreamingMerge:
         return rounds
 
     @staticmethod
-    def _never_fits(change: Change, ki: int, kd: int, km: int) -> bool:
+    def _op_counts(change: Change) -> tuple:
+        """(inserts, deletes, marks) — the round-width cost model shared by
+        admission budgeting and the never-fits demotion check."""
         ci = sum(1 for op in change.ops if op.action == "set" and op.insert)
         cd = sum(1 for op in change.ops if op.action == "del")
         cm = sum(1 for op in change.ops if op.action in ("addMark", "removeMark"))
+        return ci, cd, cm
+
+    @classmethod
+    def _never_fits(cls, change: Change, ki: int, kd: int, km: int) -> bool:
+        ci, cd, cm = cls._op_counts(change)
         return ci > ki or cd > kd or cm > km
 
-    @staticmethod
-    def _budget(ordered: List[Change], ki: int, kd: int, km: int):
+    @classmethod
+    def _budget(cls, ordered: List[Change], ki: int, kd: int, km: int):
         """Admit the longest causal prefix whose op streams fit the static
         round widths."""
         ins = dels = marks = 0
         admitted: List[Change] = []
         for idx, ch in enumerate(ordered):
-            ci = sum(1 for op in ch.ops if op.action == "set" and op.insert)
-            cd = sum(1 for op in ch.ops if op.action == "del")
-            cm = sum(1 for op in ch.ops if op.action in ("addMark", "removeMark"))
+            ci, cd, cm = cls._op_counts(ch)
             if ins + ci > ki or dels + cd > kd or marks + cm > km:
                 return admitted, ordered[idx:]
             ins, dels, marks = ins + ci, dels + cd, marks + cm
@@ -456,11 +462,48 @@ class StreamingMerge:
     # -- cross-shard reductions (the ICI/DCN collectives) ------------------
 
     def digest(self) -> int:
-        """Global convergence digest over every doc's visible text: with a
-        mesh, XLA lowers the cross-doc reduction to an all-reduce over ICI.
-        Two sessions that converged hold equal digests."""
+        """Global convergence digest over every DEVICE-RESIDENT doc's visible
+        text: with a mesh, XLA lowers the cross-doc reduction to an all-reduce
+        over ICI.  Two sessions that converged hold equal digests.
+
+        Fallback docs are masked out: their truth lives host-side and their
+        device rows may hold residue from rounds applied before demotion
+        (demotion is deterministic for a given ingest history, so converged
+        sessions mask the same doc set; compare fallback docs via read())."""
         resolved = resolve_jit(self.state, self.comment_capacity)
-        return int(jax.jit(convergence_digest)(resolved.char, resolved.visible))
+        on_device = np.asarray(
+            [not s.fallback for s in self.docs], bool
+        )[:, None]  # (D, 1)
+        visible = jnp.logical_and(resolved.visible, jnp.asarray(on_device))
+        return int(jax.jit(convergence_digest)(resolved.char, visible))
+
+    # -- checkpoint support (peritext_tpu.checkpoint.save_session) ----------
+
+    def doc_history_frames(self, doc_index: int) -> List[bytes]:
+        """The doc's full ingested history as wire frames — the durable,
+        event-sourced form (re-ingesting them reconstructs the doc exactly;
+        duplicate-tolerant, so crash-replay overlap is safe).  Frame-mode
+        docs return their raw frames; object/fallback docs re-encode their
+        log (lossless: the codec JSON-spills anything exotic)."""
+        sess = self.docs[doc_index]
+        if sess.frame_mode:
+            return list(sess.frames)
+        changes = sess.log + sess.pending
+        return [encode_frame(changes)] if changes else []
+
+    @property
+    def config(self) -> Dict[str, int]:
+        """Constructor-shape configuration (for checkpoint restore)."""
+        return {
+            "num_docs": self.num_docs,
+            "slot_capacity": self.state.slot_capacity,
+            "mark_capacity": self.state.mark_capacity,
+            "tomb_capacity": self.state.tomb_capacity,
+            "round_insert_capacity": self.round_caps[0],
+            "round_delete_capacity": self.round_caps[1],
+            "round_mark_capacity": self.round_caps[2],
+            "comment_capacity": self.comment_capacity,
+        }
 
     def frontier(self) -> Clock:
         """Merged vector-clock frontier across all docs (host-side metadata)."""

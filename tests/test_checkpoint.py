@@ -158,3 +158,121 @@ class TestFailedTrace:
         assert doc.get_text_with_formatting(["text"]) == doc_from_store(
             state.store
         ).get_text_with_formatting(["text"])
+
+
+class TestSessionCheckpoint:
+    """Event-sourced streaming-session checkpoints: the frame log IS the
+    state; restore re-ingests and must reproduce digests/spans exactly."""
+
+    def _session(self, workloads, mix=True):
+        from peritext_tpu.parallel.codec import encode_frame
+        from peritext_tpu.parallel.streaming import StreamingMerge
+
+        sess = StreamingMerge(
+            num_docs=len(workloads), actors=("doc1", "doc2", "doc3"),
+            slot_capacity=512, mark_capacity=128,
+            round_insert_capacity=128, round_delete_capacity=64,
+            round_mark_capacity=64,
+        )
+        for d, w in enumerate(workloads):
+            changes = [ch for log in w.values() for ch in log]
+            if mix and d % 2:
+                sess.ingest(d, changes)  # object path
+            else:
+                sess.ingest_frame(d, encode_frame(changes))  # frame path
+        sess.drain()
+        return sess
+
+    def test_save_restore_roundtrip(self, tmp_path):
+        from peritext_tpu.checkpoint import restore_session, save_session
+        from peritext_tpu.testing.fuzz import generate_workload
+
+        workloads = generate_workload(seed=61, num_docs=4, ops_per_doc=90)
+        sess = self._session(workloads)
+        meta = save_session(sess, tmp_path / "ckpt")
+        assert meta["frames"] > 0
+
+        restored = restore_session(tmp_path / "ckpt")
+        assert restored.digest() == sess.digest()
+        assert restored.read_all() == sess.read_all()
+        assert restored.frontier() == sess.frontier()
+
+    def test_restore_then_continue_ingesting(self, tmp_path):
+        from peritext_tpu.api.batch import _oracle_doc
+        from peritext_tpu.checkpoint import restore_session, save_session
+        from peritext_tpu.parallel.codec import encode_frame
+        from peritext_tpu.testing.fuzz import generate_workload
+
+        workloads = generate_workload(seed=62, num_docs=2, ops_per_doc=120)
+        half_workloads = []
+        rest = []
+        for w in workloads:
+            changes = [ch for log in w.values() for ch in log]
+            half = len(changes) // 2
+            half_workloads.append(changes[:half])
+            rest.append(changes[half:])
+
+        from peritext_tpu.parallel.streaming import StreamingMerge
+
+        sess = StreamingMerge(
+            num_docs=2, actors=("doc1", "doc2", "doc3"), slot_capacity=512,
+            mark_capacity=128, round_insert_capacity=128,
+            round_delete_capacity=64, round_mark_capacity=64,
+        )
+        for d, changes in enumerate(half_workloads):
+            sess.ingest_frame(d, encode_frame(changes))
+        sess.drain()
+        save_session(sess, tmp_path / "mid")
+
+        restored = restore_session(tmp_path / "mid")
+        for d, changes in enumerate(rest):
+            restored.ingest_frame(d, encode_frame(changes))
+        restored.drain()
+        for d, w in enumerate(workloads):
+            expected = _oracle_doc(w).get_text_with_formatting(["text"])
+            assert restored.read(d) == expected, f"doc {d}"
+
+    def test_manager_session_checkpoint(self, tmp_path):
+        from peritext_tpu.checkpoint import CheckpointManager
+        from peritext_tpu.testing.fuzz import generate_workload
+
+        workloads = generate_workload(seed=63, num_docs=2, ops_per_doc=60)
+        sess = self._session(workloads, mix=False)
+        mgr = CheckpointManager(tmp_path / "root", keep=2)
+        mgr.save(1, session=sess)
+        ckpt = mgr.latest()
+        restored = ckpt.session()
+        assert restored is not None
+        assert restored.digest() == sess.digest()
+
+    def test_digest_stable_across_demotion_and_restore(self, tmp_path):
+        """A doc demoted AFTER earlier device rounds leaves residue in its
+        device row; digest() must mask fallback docs so a session and its
+        restored checkpoint agree (the restored session demotes the same doc
+        without ever touching the device)."""
+        from peritext_tpu.checkpoint import restore_session, save_session
+        from peritext_tpu.parallel.codec import encode_frame
+        from peritext_tpu.parallel.streaming import StreamingMerge
+        from peritext_tpu.testing.generate import generate_docs
+
+        docs, _, initial = generate_docs("seed text", 1)
+        (d1,) = docs
+        sess = StreamingMerge(
+            num_docs=1, actors=("doc1",), slot_capacity=256,
+            round_insert_capacity=32,
+        )
+        sess.ingest_frame(0, encode_frame([initial]))
+        sess.drain()  # round applied on device
+        big, _ = d1.change(
+            [{"path": ["text"], "action": "insert", "index": 1,
+              "values": list("y" * 100)}]
+        )
+        sess.ingest_frame(0, encode_frame([big]))
+        sess.drain()  # oversized: demotes, device row keeps residue
+        assert sess.docs[0].fallback
+
+        save_session(sess, tmp_path / "demoted")
+        restored = restore_session(tmp_path / "demoted")
+        assert restored.docs[0].fallback
+        assert restored.digest() == sess.digest()
+        assert restored.read_all() == sess.read_all()
