@@ -99,13 +99,16 @@ def run(args) -> dict:
     # back-to-back — the device executes queued programs serially — and
     # sync once, amortizing dispatch latency exactly as a streaming
     # deployment does.
+    from peritext_tpu.observability import profile_trace
+
     times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            result = apply_jit(state0, ops_dev)
-        sync(result)
-        times.append(time.perf_counter() - t0)
+    with profile_trace(args.profile, enabled=args.profile is not None):
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                result = apply_jit(state0, ops_dev)
+            sync(result)
+            times.append(time.perf_counter() - t0)
     best = min(times) / args.iters
 
     overflow = int(np.asarray(result.overflow).sum())
@@ -260,6 +263,10 @@ def main() -> None:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--platform", default=None, help="force a jax platform (e.g. cpu)"
+    )
+    parser.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="capture a jax.profiler trace of the steady-state loop into DIR",
     )
     args = parser.parse_args()
 

@@ -143,6 +143,8 @@ def save_session(session, directory: str | Path) -> Dict[str, Any]:
     total = 0
     for i in range(session.num_docs):
         frames = session.doc_history_frames(i)
+        if not frames:
+            continue  # untouched doc: no file (restore treats absent as empty)
         total += len(frames)
         _write_frames(directory / f"doc_{i:06d}.frames", frames)
     meta = {
@@ -172,7 +174,13 @@ def restore_session(directory: str | Path, mesh=None, drain: bool = True):
             for frame in _read_frames(path):
                 session.ingest_frame(i, frame)
     if drain:
-        session.drain()
+        # drain() caps rounds per call; keep draining until no admissible
+        # work remains so a huge history never silently restores truncated.
+        # Changes still pending after that are causally stuck — normal for a
+        # mid-stream checkpoint (their deps had not arrived at save time);
+        # they stay pending exactly as they did in the saved session.
+        while session.drain() > 0:
+            pass
     return session
 
 

@@ -167,6 +167,14 @@ def apply_batch(
     impl = insert_impl
     if impl == "auto":
         impl = resolve_insert_impl(state.elem_id)
+    if impl == "pallas":
+        # Long-doc shapes whose resident state cannot fit VMEM take the lax
+        # path (streams state through HBM; slower but unbounded).
+        from .pallas_insert import effective_loop_slots, pallas_vmem_ok
+
+        s_loop = effective_loop_slots(state.elem_id.shape[1], insert_loop_slots)
+        if not pallas_vmem_ok(s_loop):
+            impl = "lax"
     if impl in ("pallas", "pallas_interpret"):
         from .pallas_insert import insert_batch_pallas
 

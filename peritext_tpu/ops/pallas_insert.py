@@ -39,9 +39,8 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 
 
-def _insert_kernel(ins_ref, ins_op, ins_char, elem_in, char_in, n_in, ov_in,
-                   elem_out, char_out, n_out, ov_out):
-    """One grid cell: all K inserts for an (S, L) block of documents.
+def _insert_body(ins_ref, ins_op, ins_char, pos, s_cap):
+    """The per-insert step shared by the single-chunk and chunked kernels.
 
     Mask algebra exploits two invariants to keep per-step VPU work minimal:
     real element ids are never 0, and empty slots hold id 0.  So the
@@ -52,9 +51,6 @@ def _insert_kernel(ins_ref, ins_op, ins_char, elem_in, char_in, n_in, ov_in,
     the splice select by forcing the insert position to S (never matched by
     ``pos``), so the carry needs no final where.
     """
-    s_cap, lanes = elem_in.shape
-    k_total = ins_ref.shape[0]
-    pos = lax.broadcasted_iota(jnp.int32, (s_cap, lanes), 0)
 
     def body(k, carry):
         elem, chars, n, ov = carry  # (S,L) (S,L) (1,L) (1,L)
@@ -88,8 +84,46 @@ def _insert_kernel(ins_ref, ins_op, ins_char, elem_in, char_in, n_in, ov_in,
             ov | ((live & ~found) | (live & (n >= s_cap))).astype(jnp.int32),
         )
 
+    return body
+
+
+def _insert_kernel(ins_ref, ins_op, ins_char, elem_in, char_in, n_in, ov_in,
+                   elem_out, char_out, n_out, ov_out):
+    """One grid cell: ALL K inserts for an (S, L) block of documents (the
+    fast path when the whole op stream fits VMEM next to the state)."""
+    s_cap, lanes = elem_in.shape
+    pos = lax.broadcasted_iota(jnp.int32, (s_cap, lanes), 0)
+    body = _insert_body(ins_ref, ins_op, ins_char, pos, s_cap)
     init = (elem_in[:], char_in[:], n_in[:], ov_in[:])
-    elem, chars, n, ov = lax.fori_loop(0, k_total, body, init)
+    elem, chars, n, ov = lax.fori_loop(0, ins_ref.shape[0], body, init)
+    elem_out[:] = elem
+    char_out[:] = chars
+    n_out[:] = n
+    ov_out[:] = ov
+
+
+def _insert_kernel_chunked(ins_ref, ins_op, ins_char, elem_in, char_in, n_in,
+                           ov_in, elem_out, char_out, n_out, ov_out):
+    """One grid cell: one op-stream CHUNK of inserts for an (S, L) block of
+    documents.  The grid is (doc blocks, stream chunks) with the stream axis
+    sequential ("arbitrary"): the state OUTPUT blocks are indexed by doc
+    only, so Pallas keeps them resident in VMEM across all chunk steps —
+    chunk 0 seeds them from the inputs, later chunks continue in place.
+    Chunking bounds VMEM by the chunk width instead of the whole K stream
+    (BASELINE config-4 long docs overflow VMEM otherwise)."""
+    s_cap, lanes = elem_in.shape
+    pos = lax.broadcasted_iota(jnp.int32, (s_cap, lanes), 0)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _seed():
+        elem_out[:] = elem_in[:]
+        char_out[:] = char_in[:]
+        n_out[:] = n_in[:]
+        ov_out[:] = ov_in[:]
+
+    body = _insert_body(ins_ref, ins_op, ins_char, pos, s_cap)
+    init = (elem_out[:], char_out[:], n_out[:], ov_out[:])
+    elem, chars, n, ov = lax.fori_loop(0, ins_ref.shape[0], body, init)
     elem_out[:] = elem
     char_out[:] = chars
     n_out[:] = n
@@ -117,25 +151,49 @@ def insert_batch_pallas(elem_id, char, num_slots, overflow,
     """
     d, s_cap = elem_id.shape
     k = ins_ref.shape[1]
-    s_loop = s_cap if loop_slots is None else max(8, min(-(-loop_slots // 8) * 8, s_cap))
+    s_loop = effective_loop_slots(s_cap, loop_slots)
+    kc = _stream_chunk(s_loop, k)
+    kp = -(-k // kc) * kc  # stream padded to whole chunks (op id 0 = no-op)
     dp = -(-d // LANES) * LANES
     pad = dp - d
+    chunked = kp != kc  # stream larger than one VMEM-resident chunk
 
-    def t(x):  # (D, W) -> (W, Dp)
-        return jnp.pad(x.T.astype(jnp.int32), ((0, 0), (0, pad)))
+    def t(x, extra_rows=0):  # (D, W) -> (W + extra, Dp)
+        return jnp.pad(x.T.astype(jnp.int32), ((0, extra_rows), (0, pad)))
 
-    col = lambda width: pl.BlockSpec(  # noqa: E731
-        (width, LANES), lambda i: (0, i), memory_space=pltpu.VMEM
+    if chunked:
+        grid = (dp // LANES, kp // kc)
+        index = lambda i, j: (0, i)  # noqa: E731
+        stream_index = lambda i, j: (j, i)  # noqa: E731
+        kernel = _insert_kernel_chunked
+        params = dict(
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary"),
+                vmem_limit_bytes=_VMEM_LIMIT,
+            )
+        )
+    else:
+        grid = (dp // LANES,)
+        index = lambda i: (0, i)  # noqa: E731
+        stream_index = index
+        kernel = _insert_kernel
+        params = dict(
+            compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+        )
+
+    state_col = lambda width: pl.BlockSpec(  # noqa: E731
+        (width, LANES), index, memory_space=pltpu.VMEM
     )
+    stream_col = pl.BlockSpec((kc, LANES), stream_index, memory_space=pltpu.VMEM)
 
     elem, chars, n, ov = pl.pallas_call(
-        _insert_kernel,
-        grid=(dp // LANES,),
+        kernel,
+        grid=grid,
         in_specs=[
-            col(k), col(k), col(k),
-            col(s_loop), col(s_loop), col(1), col(1),
+            stream_col, stream_col, stream_col,
+            state_col(s_loop), state_col(s_loop), state_col(1), state_col(1),
         ],
-        out_specs=[col(s_loop), col(s_loop), col(1), col(1)],
+        out_specs=[state_col(s_loop), state_col(s_loop), state_col(1), state_col(1)],
         out_shape=[
             jax.ShapeDtypeStruct((s_loop, dp), jnp.int32),
             jax.ShapeDtypeStruct((s_loop, dp), jnp.int32),
@@ -143,8 +201,9 @@ def insert_batch_pallas(elem_id, char, num_slots, overflow,
             jax.ShapeDtypeStruct((1, dp), jnp.int32),
         ],
         interpret=interpret,
+        **params,
     )(
-        t(ins_ref), t(ins_op), t(ins_char),
+        t(ins_ref, kp - k), t(ins_op, kp - k), t(ins_char, kp - k),
         t(elem_id[:, :s_loop]), t(char[:, :s_loop]),
         t(num_slots.reshape(d, 1)), t(overflow.reshape(d, 1)),
     )
@@ -155,3 +214,50 @@ def insert_batch_pallas(elem_id, char, num_slots, overflow,
         elem_new = jnp.concatenate([elem_new, elem_id[:, s_loop:]], axis=1)
         char_new = jnp.concatenate([char_new, char[:, s_loop:]], axis=1)
     return elem_new, char_new, n[0, :d], ov[0, :d] != 0
+
+
+#: VMEM ceiling requested from the compiler (v5e has 128M per core; the
+#: default scoped limit is only 16M) and the occupancy budget this module
+#: plans against.  The budget leaves a wide margin under the ceiling.
+_VMEM_LIMIT = 100 * 1024 * 1024
+_VMEM_BUDGET = 72 * 1024 * 1024
+
+
+def effective_loop_slots(s_cap: int, loop_slots: int | None) -> int:
+    """The slot-window height the kernel will actually use."""
+    if loop_slots is None:
+        return s_cap
+    return max(8, min(-(-loop_slots // 8) * 8, s_cap))
+
+
+def _state_bytes(s_loop: int) -> int:
+    """Resident bytes attributable to the (elem, char) state at one grid
+    cell, counted conservatively at 6 copies of the 2-array state (pipeline
+    double-buffered inputs, revisited outputs, fori_loop carry, and the
+    chunk-0 seed copy — observed occupancy on v5e is ~6x)."""
+    return 6 * (2 * s_loop * LANES * 4)
+
+
+def _stream_bytes(kc: int) -> int:
+    """Resident bytes for the 3 op-stream blocks (double-buffered inputs)."""
+    return 2 * (3 * kc * LANES * 4)
+
+
+def _stream_chunk(s_loop: int, k: int) -> int:
+    """Op-stream chunk width: the whole stream when it fits the VMEM budget
+    next to the resident state blocks (the fast single-chunk kernel, no
+    padding); otherwise the largest multiple-of-8 chunk that fits."""
+    room = max(_VMEM_BUDGET - _state_bytes(s_loop), 0)
+    kc = room // (2 * 3 * LANES * 4)
+    if kc >= k:
+        return k
+    return max(8, (kc // 8) * 8)
+
+
+def pallas_vmem_ok(s_loop: int) -> bool:
+    """Whether the kernel's resident state for this slot window fits VMEM at
+    all (the op-stream width never matters: chunking bounds it to whatever
+    room remains, down to the minimum chunk of 8).  When False, callers
+    should use the lax path, which streams state through HBM and has no
+    such limit."""
+    return _state_bytes(s_loop) + _stream_bytes(8) <= _VMEM_BUDGET

@@ -466,15 +466,18 @@ class StreamingMerge:
         text: with a mesh, XLA lowers the cross-doc reduction to an all-reduce
         over ICI.  Two sessions that converged hold equal digests.
 
-        Fallback docs are masked out: their truth lives host-side and their
-        device rows may hold residue from rounds applied before demotion
-        (demotion is deterministic for a given ingest history, so converged
-        sessions mask the same doc set; compare fallback docs via read())."""
+        Fallback and overflowed docs are masked out — exactly the docs the
+        read paths route to scalar replay: their truth lives host-side and
+        their device rows may hold residue whose exact content depends on
+        round partitioning (compare those docs via read())."""
         resolved = resolve_jit(self.state, self.comment_capacity)
         on_device = np.asarray(
             [not s.fallback for s in self.docs], bool
         )[:, None]  # (D, 1)
-        visible = jnp.logical_and(resolved.visible, jnp.asarray(on_device))
+        mask = jnp.logical_and(
+            jnp.asarray(on_device), jnp.logical_not(resolved.overflow)[:, None]
+        )
+        visible = jnp.logical_and(resolved.visible, mask)
         return int(jax.jit(convergence_digest)(resolved.char, visible))
 
     # -- checkpoint support (peritext_tpu.checkpoint.save_session) ----------
@@ -488,7 +491,7 @@ class StreamingMerge:
         sess = self.docs[doc_index]
         if sess.frame_mode:
             return list(sess.frames)
-        changes = sess.log + sess.pending
+        changes = self._replay_changes(sess)
         return [encode_frame(changes)] if changes else []
 
     @property

@@ -257,13 +257,16 @@ def run_differential_frames(
 ) -> int:
     """Streaming frame-ingest differential: deliver each doc's changes as
     shuffled, chunked, partially duplicated wire frames interleaved with
-    device rounds, then assert final spans equal the scalar oracle.
-    Returns the number of docs that stayed on the frame fast path."""
+    device rounds; a patch consumer accumulates each doc's incremental
+    ``read_patches`` stream every round.  Final spans AND the accumulated
+    patch streams must equal the scalar oracle.  Returns the number of docs
+    that stayed on the frame fast path."""
     import random
 
     from ..api.batch import _oracle_doc
     from ..parallel.codec import encode_frame
     from ..parallel.streaming import StreamingMerge
+    from .accumulate import accumulate_patches
 
     rng = random.Random(seed ^ 0xF7A3E5)
     workloads = generate_workload(seed, num_docs=num_docs, ops_per_doc=ops_per_doc)
@@ -277,6 +280,7 @@ def run_differential_frames(
         round_delete_capacity=64,
         round_mark_capacity=64,
     )
+    patch_streams = {d: [] for d in range(num_docs)}
     for d, w in enumerate(workloads):
         changes = [ch for log in w.values() for ch in log]
         rng.shuffle(changes)
@@ -289,6 +293,8 @@ def run_differential_frames(
             sess.ingest_frame(d, f)
             if rng.random() < 0.5:
                 sess.step()
+                if rng.random() < 0.3:
+                    patch_streams[d].extend(sess.read_patches(d))
     sess.drain()
     out = sess.read_all()
     for d, w in enumerate(workloads):
@@ -296,6 +302,12 @@ def run_differential_frames(
         assert out[d] == expected, (
             f"seed={seed} doc={d}: frame-streamed spans diverge from oracle\n"
             f"device: {out[d]}\noracle: {expected}"
+        )
+        patch_streams[d].extend(sess.read_patches(d))
+        replayed = accumulate_patches(patch_streams[d])
+        assert replayed == expected, (
+            f"seed={seed} doc={d}: accumulated patch stream diverges\n"
+            f"patches: {replayed}\noracle: {expected}"
         )
     assert sess.pending_count() == 0, f"seed={seed}: undelivered changes remain"
     on_fast_path = sum(1 for s in sess.docs if s.frame_mode and not s.fallback)

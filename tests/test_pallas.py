@@ -108,3 +108,44 @@ def test_apply_batch_rejects_unknown_impl():
     )
     with pytest.raises(ValueError):
         apply_batch(state, streams, insert_impl="cuda")
+
+
+def test_pallas_chunked_stream_matches_lax(monkeypatch):
+    """Force the stream-chunked kernel (the long-doc VMEM path) by shrinking
+    the VMEM budget so the op stream spans several chunks."""
+    from peritext_tpu.ops import pallas_insert
+
+    docs, slots, inserts = 8, 96, 80
+    state, (ins_ref, ins_op, ins_char) = _insert_args(docs, slots, inserts, seed=9)
+    args = (state.elem_id, state.char, state.num_slots, state.overflow,
+            ins_ref, ins_op, ins_char)
+    lax_out = jax.vmap(_insert_loop)(*args)
+
+    budget = pallas_insert._state_bytes(slots) + pallas_insert._stream_bytes(24)
+    monkeypatch.setattr(pallas_insert, "_VMEM_BUDGET", budget)
+    assert pallas_insert._stream_chunk(slots, inserts) < inserts  # really chunked
+    # cache-bust: jit would replay the old trace for identical arg shapes
+    pallas_out = pallas_insert.insert_batch_pallas.__wrapped__(
+        *args, interpret=True, loop_slots=None
+    )
+    _assert_same(lax_out, pallas_out)
+
+
+def test_vmem_guard_routes_oversized_shapes_to_lax():
+    from peritext_tpu.ops.kernel import resolve_insert_impl
+    from peritext_tpu.ops.pallas_insert import pallas_vmem_ok
+
+    assert pallas_vmem_ok(384)                # the bench config
+    assert pallas_vmem_ok(6144)               # BASELINE config-4 long docs
+    assert not pallas_vmem_ok(32768)          # state alone exceeds VMEM
+    # apply_batch falls back to lax for such shapes (no pallas lowering)
+    docs, slots = 4, 32768
+    state = empty_docs(docs, slots, 16, tomb_capacity=8)
+    streams = synth_streams(
+        docs, inserts_per_doc=8, deletes_per_doc=0, marks_per_doc=0, seed=4
+    )
+    out = apply_batch(state, streams, insert_impl="pallas")  # guard: lax used
+    ref = apply_batch(state, streams, insert_impl="lax")
+    for a, b in zip(out, ref):
+        if not isinstance(a, dict):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
