@@ -5,13 +5,22 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test fuzz bench bench-smoke bench-streaming entry dryrun lint clean
+.PHONY: test fuzz fuzz-differential fuzz-frames bench bench-smoke \
+	bench-streaming entry dryrun lint clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
 
 fuzz:
 	$(CPU_ENV) $(PY) -m peritext_tpu.testing.fuzz
+
+# device path vs scalar oracle (spans + cursors)
+fuzz-differential:
+	$(CPU_ENV) $(PY) -m peritext_tpu.testing.fuzz --differential
+
+# streaming frame ingest vs oracle (spans + incremental patch streams)
+fuzz-frames:
+	$(CPU_ENV) $(PY) -m peritext_tpu.testing.fuzz --differential-frames
 
 bench:
 	$(PY) bench.py

@@ -30,6 +30,7 @@ class Host:
 
     def __init__(self, name: str, actor: str, workload):
         from peritext_tpu.parallel import ChangeStore, ReplicaServer
+        from peritext_tpu.parallel.codec import encode_frame
         from peritext_tpu.parallel.streaming import StreamingMerge
 
         self.name = name
@@ -43,14 +44,26 @@ class Host:
         own = workload.get(actor, [])
         for change in own:
             self.store.append(change)
-        self._ingest(own)
-        self.server = ReplicaServer(self.store, on_changes=self._ingest)
+        if own:
+            self._ingest_frame(encode_frame(own), len(own))
+        # wire bytes flow straight into the device session (on_frame): no
+        # Python Change objects on the hot ingest path; on_changes only
+        # counts deliveries for the quiescence check
+        self.server = ReplicaServer(
+            self.store,
+            on_changes=self._count,
+            on_frame=lambda frame: self._ingest_frame(frame, 0),
+        )
         self.address = self.server.start()
 
-    def _ingest(self, changes):
+    def _count(self, changes):
         with self._ingest_lock:
             self._delivered += len(changes)
-            self.session.ingest(0, changes)
+
+    def _ingest_frame(self, frame, count):
+        with self._ingest_lock:
+            self._delivered += count
+            self.session.ingest_frame(0, frame)
             self.session.drain()
 
     def digest(self) -> int:

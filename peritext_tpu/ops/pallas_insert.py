@@ -151,6 +151,8 @@ def insert_batch_pallas(elem_id, char, num_slots, overflow,
     """
     d, s_cap = elem_id.shape
     k = ins_ref.shape[1]
+    if k == 0:  # mark/delete-only batch: the insert phase is a no-op
+        return elem_id, char, num_slots, overflow
     s_loop = effective_loop_slots(s_cap, loop_slots)
     kc = _stream_chunk(s_loop, k)
     kp = -(-k // kc) * kc  # stream padded to whole chunks (op id 0 = no-op)

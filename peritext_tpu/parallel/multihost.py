@@ -141,9 +141,16 @@ class ReplicaServer:
         host: str = "127.0.0.1",
         port: int = 0,
         on_changes: Optional[Callable[[List[Change]], None]] = None,
+        on_frame: Optional[Callable[[bytes], None]] = None,
     ) -> None:
+        """``on_changes`` receives each batch of newly-merged decoded
+        changes; ``on_frame`` receives the RAW inbound frame bytes whenever
+        it carried anything new — the zero-copy hook for feeding a device
+        session's ``ingest_frame`` (frames are duplicate-tolerant, so
+        redelivered changes inside the frame are harmless)."""
         self.store = store
         self.on_changes = on_changes
+        self.on_frame = on_frame
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -186,6 +193,7 @@ class ReplicaServer:
         return sync_with(
             self.store, host, port,
             on_changes=self.on_changes, timeout=timeout, lock=self._lock,
+            on_frame=self.on_frame,
         )
 
     def _serve_one(self, conn: socket.socket) -> None:
@@ -198,11 +206,18 @@ class ReplicaServer:
                     outbound = self.store.missing_changes(my_clock, peer_clock)
                 _send_message(conn, MSG_CHANGES, encode_frame(outbound))
                 _send_frontier(conn, my_clock)
-                inbound = decode_frame(_expect(conn, MSG_CHANGES))
+                frame = _expect(conn, MSG_CHANGES)
+                inbound = decode_frame(frame)
                 with self._lock:
                     fresh = merge_changes(self.store, inbound)
-                if fresh and self.on_changes is not None:
-                    self.on_changes(fresh)
+                if fresh:
+                    # on_frame first: consumers that ingest via on_frame and
+                    # account via on_changes must never observe the count
+                    # ahead of the ingestion
+                    if self.on_frame is not None:
+                        self.on_frame(frame)
+                    if self.on_changes is not None:
+                        self.on_changes(fresh)
         except (ConnectionError, ValueError, OSError, PeritextError):
             # a bad peer (bad framing, corrupt frame, malformed frontier, or a
             # change batch with log gaps) must not take the server down
@@ -219,6 +234,7 @@ def sync_with(
     on_changes: Optional[Callable[[List[Change]], None]] = None,
     timeout: float = 30.0,
     lock: Optional[threading.Lock] = None,
+    on_frame: Optional[Callable[[bytes], None]] = None,
 ) -> Tuple[int, int]:
     """One full bidirectional anti-entropy round against a peer.
 
@@ -233,13 +249,17 @@ def sync_with(
         with lock:
             my_clock = store.clock()
         _send_frontier(sock, my_clock)
-        inbound = decode_frame(_expect(sock, MSG_CHANGES))
+        frame = _expect(sock, MSG_CHANGES)
+        inbound = decode_frame(frame)
         peer_clock = _parse_frontier(_expect(sock, MSG_FRONTIER))
         with lock:
             outbound = store.missing_changes(store.clock(), peer_clock)
         _send_message(sock, MSG_CHANGES, encode_frame(outbound))
     with lock:
         fresh = merge_changes(store, inbound)
-    if fresh and on_changes is not None:
-        on_changes(fresh)
+    if fresh:
+        if on_frame is not None:  # before on_changes; see ReplicaServer
+            on_frame(frame)
+        if on_changes is not None:
+            on_changes(fresh)
     return len(fresh), len(outbound)

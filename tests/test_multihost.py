@@ -149,3 +149,31 @@ def test_server_survives_garbage_peer(workload):
         assert b.clock() == a.clock()
     finally:
         server.stop()
+
+
+def test_on_frame_hook_feeds_device_session(workload):
+    """The raw-frame hook: wire bytes flow into a StreamingMerge without
+    object conversion on the device path."""
+    from peritext_tpu.api.batch import _oracle_doc
+    from peritext_tpu.parallel.streaming import StreamingMerge
+
+    a = _store_from(workload, ["doc1", "doc2", "doc3"])
+    b = ChangeStore()
+    dev = StreamingMerge(
+        num_docs=1, actors=("doc1", "doc2", "doc3"), slot_capacity=512,
+        mark_capacity=128, round_insert_capacity=128,
+        round_delete_capacity=64, round_mark_capacity=64,
+    )
+
+    def on_frame(frame):
+        dev.ingest_frame(0, frame)
+        dev.drain()
+
+    server = ReplicaServer(a)
+    host, port = server.start()
+    try:
+        sync_with(b, host, port, on_frame=on_frame)
+    finally:
+        server.stop()
+    assert dev.docs[0].frame_mode and not dev.docs[0].fallback
+    assert dev.read(0) == _oracle_doc(workload).get_text_with_formatting(["text"])

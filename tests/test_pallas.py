@@ -149,3 +149,16 @@ def test_vmem_guard_routes_oversized_shapes_to_lax():
     for a, b in zip(out, ref):
         if not isinstance(a, dict):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_zero_width_insert_stream_is_noop():
+    import jax.numpy as jnp
+
+    state = empty_docs(4, 32, 16, tomb_capacity=8)
+    z = jnp.zeros((4, 0), jnp.int32)
+    elem, char, n, ov = insert_batch_pallas(
+        state.elem_id, state.char, state.num_slots, state.overflow, z, z, z,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(elem), np.asarray(state.elem_id))
+    np.testing.assert_array_equal(np.asarray(n), np.asarray(state.num_slots))
