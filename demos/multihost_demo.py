@@ -4,8 +4,10 @@
 Each "host" owns one collaborating actor of a fuzz-generated editing session:
 its own append-only ChangeStore, a TCP anti-entropy endpoint
 (parallel/multihost.py) speaking binary codec frames, and its own device
-merge session (parallel/streaming.py) fed through the server's on_changes
-hook.  Gossip rounds around the ring converge all three stores, and each
+merge session (parallel/streaming.py) fed raw wire bytes through the
+server's on_frame hook (frame-native ingest — no Python Change objects on
+the device path; on_changes only counts deliveries for the quiescence
+check).  Gossip rounds around the ring converge all three stores, and each
 host's device state converges to the same digest — the multi-host analog of
 the reference's in-memory Publisher + getMissingChanges sync
 (src/pubsub.ts, test/merge.ts), with DCN traffic carrying only change

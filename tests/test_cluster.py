@@ -1,0 +1,170 @@
+"""Capstone integration: the whole stack in one scenario.
+
+Two "hosts", each with a mesh-sharded StreamingMerge session over 4 virtual
+devices, replicate a set of collaborative documents over real TCP sockets
+(binary codec frames, frame-native ingest).  Midway, one host checkpoints,
+"crashes", restores from the checkpoint, and catches up via anti-entropy.
+Everything must converge to the scalar oracle: spans, digests, and the
+surviving host's accumulated patch streams.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from peritext_tpu.api.batch import _oracle_doc
+from peritext_tpu.checkpoint import restore_session, save_session
+from peritext_tpu.core.types import Change
+from peritext_tpu.parallel import ChangeStore, ReplicaServer, sync_with
+from peritext_tpu.parallel.codec import encode_frame
+from peritext_tpu.parallel.streaming import StreamingMerge
+from peritext_tpu.testing.accumulate import accumulate_patches
+from peritext_tpu.testing.fuzz import generate_workload
+
+NUM_DOCS = 4
+ACTORS = ("doc1", "doc2", "doc3")
+
+
+@pytest.fixture()
+def namespaced_workloads():
+    """Per-doc fuzz workloads with actors renamed per doc so one ChangeStore
+    can hold every doc's logs (actor = 'd{doc}.{replica}')."""
+    raw = generate_workload(seed=130, num_docs=NUM_DOCS, ops_per_doc=80)
+    out = []
+    for d, w in enumerate(raw):
+        mapping = {a: f"d{d}.{a}" for a in ACTORS}
+
+        def rename_id(v):
+            if isinstance(v, str) and "@" in v:
+                ctr, a = v.split("@")
+                return f"{ctr}@{mapping.get(a, a)}"
+            return v
+
+        renamed = {}
+        for actor, log in w.items():
+            new_log = []
+            for ch in log:
+                j = ch.to_json()
+                j["actor"] = mapping[j["actor"]]
+                j["deps"] = {mapping.get(a, a): s for a, s in j["deps"].items()}
+                for op in j["ops"]:
+                    for key in ("opId", "obj", "elemId"):
+                        if key in op:
+                            op[key] = rename_id(op[key])
+                    for bkey in ("start", "end"):
+                        b = op.get(bkey)
+                        if isinstance(b, dict) and "elemId" in b:
+                            b["elemId"] = rename_id(b["elemId"])
+                new_log.append(Change.from_json(j))
+            renamed[mapping[actor]] = new_log
+        out.append(renamed)
+    return out
+
+
+class HostSim:
+    """One simulated host: durable change log + TCP endpoint + a device
+    session sharded over the virtual mesh, fed frame-natively.  Remote
+    pushes are ingested on the server's handler thread, so readers must
+    ``wait_settled`` first (same pattern as demos/multihost_demo.py)."""
+
+    def __init__(self, mesh, actors, doc_of_actor):
+        import threading
+
+        self.store = ChangeStore()
+        self.session = StreamingMerge(
+            num_docs=NUM_DOCS, actors=actors, slot_capacity=512,
+            mark_capacity=128, round_insert_capacity=128,
+            round_delete_capacity=64, round_mark_capacity=64, mesh=mesh,
+        )
+        self.doc_of_actor = doc_of_actor
+        self._lock = threading.Lock()
+        self._delivered = 0
+        self.server = ReplicaServer(self.store, on_changes=self._on_changes)
+        self.address = self.server.start()
+
+    def _on_changes(self, fresh):
+        with self._lock:
+            by_doc = {}
+            for ch in fresh:
+                by_doc.setdefault(self.doc_of_actor[ch.actor], []).append(ch)
+            for d, changes in by_doc.items():
+                self.session.ingest_frame(d, encode_frame(changes))
+            self.session.drain()
+            self._delivered += len(fresh)
+
+    def author(self, d, changes):
+        for ch in changes:
+            self.store.append(ch)
+        self._on_changes(changes)
+
+    def settled(self):
+        in_store = sum(len(self.store.log(a)) for a in self.store.actors())
+        with self._lock:
+            return self._delivered == in_store
+
+    def stop(self):
+        self.server.stop()
+
+
+def wait_settled(*hosts, timeout=30.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while not all(h.settled() for h in hosts):
+        if time.monotonic() > deadline:  # pragma: no cover
+            raise RuntimeError("hosts failed to ingest synced changes in time")
+        time.sleep(0.01)
+
+
+def test_cluster_end_to_end(namespaced_workloads, tmp_path):
+    workloads = namespaced_workloads
+    all_actors = sorted({a for w in workloads for a in w})
+    doc_of_actor = {a: d for d, w in enumerate(workloads) for a in w}
+    mesh = Mesh(np.asarray(jax.devices("cpu")[:4]), ("docs",))
+
+    h0 = HostSim(mesh, all_actors, doc_of_actor)
+    h1 = HostSim(mesh, all_actors, doc_of_actor)
+    try:
+        # each doc's replicas are split between the hosts: doc1+doc2 edits
+        # originate on h0, doc3 edits on h1
+        for d, w in enumerate(workloads):
+            for actor, log in w.items():
+                owner = h1 if actor.endswith(".doc3") else h0
+                if log:
+                    owner.author(d, log)
+
+        # gossip round converges the stores AND both device sessions; the
+        # push side lands on h1's handler thread, so wait for quiescence
+        h0.server.sync_with(*h1.address)
+        wait_settled(h0, h1)
+        assert h0.store.clock() == h1.store.clock()
+
+        # checkpoint h0's session, crash the host, restore, catch up
+        save_session(h0.session, tmp_path / "h0")
+        h0.stop()
+        restored = restore_session(tmp_path / "h0", mesh=mesh)
+
+        # redelivery from the durable store (dups are tolerated everywhere)
+        for d, w in enumerate(workloads):
+            changes = [
+                ch for a in h0.store.actors() if doc_of_actor[a] == d
+                for ch in h0.store.log(a)
+            ]
+            if changes:
+                restored.ingest_frame(d, encode_frame(changes))
+        restored.drain()
+
+        # convergence: restored h0 session == h1 session == oracle
+        assert restored.digest() == h1.session.digest()
+        for d, w in enumerate(workloads):
+            expected = _oracle_doc(w).get_text_with_formatting(["text"])
+            assert restored.read(d) == expected, f"doc {d} (restored)"
+            assert h1.session.read(d) == expected, f"doc {d} (h1)"
+        # the surviving host's patch streams replay to the oracle
+        for d, w in enumerate(workloads):
+            expected = _oracle_doc(w).get_text_with_formatting(["text"])
+            assert accumulate_patches(h1.session.read_patches(d)) == expected
+    finally:
+        h1.stop()
