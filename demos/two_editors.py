@@ -45,12 +45,31 @@ def show(editors, label) -> None:
 
 
 def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--backend", choices=("scalar", "tpu"), default="scalar",
+        help="merge backend for the editor views: 'tpu' drives them from the "
+             "batched device engine's incremental patch stream",
+    )
+    args = parser.parse_args()
+    if args.backend == "tpu":
+        import os
+
+        import jax
+
+        jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS") or "cpu")
+
     events = []
     publisher = Publisher()
-    alice = create_editor("alice", publisher, on_event=events.append)
-    bob = create_editor("bob", publisher, on_event=events.append)
+    kw = dict(on_event=events.append)
+    if args.backend == "tpu":
+        kw.update(backend="tpu", actors=("alice", "bob"))
+    alice = create_editor("alice", publisher, **kw)
+    bob = create_editor("bob", publisher, **kw)
     initialize_docs([alice, bob], "The Peritext editor")
-    show([alice, bob], "seeded (shared origin change)")
+    show([alice, bob], f"seeded (shared origin change; {args.backend} backend)")
 
     # concurrent edits: nothing crosses until a sync
     type_text(alice, 1, "Hey! ")
