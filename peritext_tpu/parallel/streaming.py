@@ -106,6 +106,11 @@ class StreamingMerge:
         self.mesh = mesh
         self.round_caps = (round_insert_capacity, round_delete_capacity, round_mark_capacity)
         self.comment_capacity = comment_capacity
+        if mesh is not None and num_docs % mesh.size:
+            raise ValueError(
+                f"num_docs={num_docs} must be a multiple of the mesh size "
+                f"({mesh.size}): the doc axis shards without padding"
+            )
         self.docs = [_DocSession() for _ in range(num_docs)]
         self.rounds = 0
         self._patch_base: Dict[int, list] = {}

@@ -259,6 +259,10 @@ def run_differential(
             f"seed={seed} doc={d}: cursor positions diverge: "
             f"device {got} != oracle {expected_cursors}"
         )
+    assert injected <= set(report.fallback_docs), (
+        f"seed={seed}: comment-body docs {sorted(injected)} were not routed "
+        f"to oracle fallback (got {report.fallback_docs})"
+    )
     device_docs = num_docs - len(report.fallback_docs)
     uninjected = num_docs - len(injected)
     # injected docs fall back BY DESIGN; only an uninjected doc falling back
@@ -271,7 +275,7 @@ def run_differential(
 
 
 def run_differential_frames(
-    seed: int, num_docs: int, ops_per_doc: int, chunk: int = 9
+    seed: int, num_docs: int, ops_per_doc: int, chunk: int = 9, mesh=None
 ) -> int:
     """Streaming frame-ingest differential: deliver each doc's changes as
     shuffled, chunked, partially duplicated wire frames interleaved with
@@ -297,6 +301,7 @@ def run_differential_frames(
         round_insert_capacity=128,
         round_delete_capacity=64,
         round_mark_capacity=64,
+        mesh=mesh,
     )
     patch_streams = {d: [] for d in range(num_docs)}
     for d, w in enumerate(workloads):
@@ -368,18 +373,49 @@ def main(argv: Optional[List[str]] = None) -> None:
         "--forever", action="store_true",
         help="loop over fresh seeds until interrupted or a failure is found",
     )
+    parser.add_argument(
+        "--mesh", type=int, default=0, metavar="N",
+        help="shard the doc axis over an N-device jax.sharding.Mesh "
+             "(needs XLA_FLAGS=--xla_force_host_platform_device_count=N)",
+    )
     args = parser.parse_args(argv)
+
+    mesh = None
+    if args.mesh:
+        import os
+
+        import jax
+
+        from ..parallel.mesh import make_mesh
+
+        # honor JAX_PLATFORMS at config level too: a TPU plugin that pins
+        # jax_platforms would otherwise override the env var and hand back
+        # its single real chip instead of the N virtual CPU devices
+        if os.environ.get("JAX_PLATFORMS"):
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        if len(jax.devices()) < args.mesh:
+            raise SystemExit(
+                f"--mesh {args.mesh} needs {args.mesh} devices but only "
+                f"{len(jax.devices())} exist; set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={args.mesh}"
+            )
+        mesh = make_mesh(args.mesh)
+        if args.docs % args.mesh:
+            args.docs = -(-args.docs // args.mesh) * args.mesh
+            print(f"rounding --docs up to {args.docs} (multiple of mesh size)")
 
     batch = None
     if args.differential:
         from ..api.batch import DocBatch
 
-        batch = DocBatch(slot_capacity=512, mark_capacity=128, comment_capacity=32)
+        batch = DocBatch(
+            slot_capacity=512, mark_capacity=128, comment_capacity=32, mesh=mesh
+        )
 
     seed = args.seed
     while True:
         if args.differential_frames:
-            fast = run_differential_frames(seed, args.docs, args.ops_per_doc)
+            fast = run_differential_frames(seed, args.docs, args.ops_per_doc, mesh=mesh)
             print(
                 f"frames-differential seed={seed}: {args.docs} docs x "
                 f"{args.ops_per_doc} ops ({fast} on fast path) match the oracle",
