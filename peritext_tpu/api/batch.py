@@ -25,7 +25,6 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..core.doc import Doc
-from ..core.errors import IndexOutOfBounds, MissingObject
 from ..core.types import Change, FormatSpan
 from ..observability import GLOBAL_COUNTERS, MergeStats
 from ..ops.decode import decode_doc_spans
@@ -215,41 +214,29 @@ class DocBatch:
     ) -> List[List[int]]:
         """Pack per-doc cursor element ids with each doc's actor table and
         resolve them on device in one batched call; fallback docs replay
-        through the oracle."""
-        from ..ops.packed import MAX_CTR, pack_id
-        from ..ops.resolve import resolve_cursors_jit
+        through the oracle (shared helpers in ops/resolve.py)."""
+        from ..ops.resolve import (
+            oracle_cursor_positions,
+            pack_cursor_rows,
+            resolve_cursors_jit,
+        )
 
         num_docs = state.elem_id.shape[0]
-        # Bucket the cursor-axis width to a power of two so varying cursor
-        # counts across merge() calls reuse one compiled program.
-        needed = max([len(c) for c in cursors] + [1])
-        width = 4
-        while width < needed:
-            width *= 2
-        cursor_elem = np.zeros((num_docs, width), np.int32)
-        for d, doc_cursors in enumerate(cursors):
-            if d in fallback:
-                continue
-            actors = encoded.actor_tables[d]
-            for j, cur in enumerate(doc_cursors):
-                ctr, actor = cur["elemId"]
-                idx = actors.get(actor)
-                if idx is not None and ctr <= MAX_CTR:
-                    cursor_elem[d, j] = pack_id(ctr, idx)
+        cursor_map = {
+            d: doc_cursors
+            for d, doc_cursors in enumerate(cursors)
+            if d not in fallback
+        }
+        cursor_elem = pack_cursor_rows(
+            cursor_map, num_docs, lambda d: encoded.actor_tables[d]
+        )
         positions = np.asarray(
             resolve_cursors_jit(state, visible_dev, cursor_elem)
         )
         out: List[List[int]] = []
         for d, doc_cursors in enumerate(cursors):
             if d in fallback:
-                doc = oracle_doc_for(d)
-                row = []
-                for cur in doc_cursors:
-                    try:
-                        row.append(doc.resolve_cursor(cur))
-                    except (IndexOutOfBounds, MissingObject):
-                        row.append(-1)  # device semantics: absent element -> -1
-                out.append(row)
+                out.append(oracle_cursor_positions(oracle_doc_for(d), doc_cursors))
             else:
                 out.append([int(p) for p in positions[d, : len(doc_cursors)]])
         return out

@@ -237,3 +237,50 @@ def resolve_cursors(state: PackedDocs, visible, cursor_elem):
 
 
 resolve_cursors_jit = jax.jit(resolve_cursors)
+
+
+def cursor_width_bucket(needed: int) -> int:
+    """Power-of-two cursor-axis width so varying cursor counts across calls
+    reuse one compiled resolve_cursors program."""
+    width = 4
+    while width < needed:
+        width *= 2
+    return width
+
+
+def pack_cursor_rows(cursor_map, num_docs: int, actor_table_for) -> "np.ndarray":
+    """(D, W) packed cursor-element matrix for a per-doc cursor mapping.
+
+    ``cursor_map``: {doc_index: [Cursor, ...]} with reference-shaped Cursor
+    dicts; ``actor_table_for(doc_index)`` returns the doc's actor interner.
+    Unknown actors / over-wide counters pack to 0 (= resolves to -1)."""
+    import numpy as np
+
+    from .packed import MAX_CTR, pack_id
+
+    width = cursor_width_bucket(max([len(c) for c in cursor_map.values()] + [1]))
+    rows = np.zeros((num_docs, width), np.int32)
+    for d, cursors in cursor_map.items():
+        actors = actor_table_for(d)
+        if actors is None:
+            continue
+        for j, cur in enumerate(cursors):
+            ctr, actor = cur["elemId"]
+            idx = actors.get(actor)
+            if idx is not None and ctr <= MAX_CTR:
+                rows[d, j] = pack_id(ctr, idx)
+    return rows
+
+
+def oracle_cursor_positions(doc, cursors) -> list:
+    """Scalar-replay cursor resolution with device semantics (-1 for absent
+    elements) — the fallback-doc path shared by DocBatch and StreamingMerge."""
+    from ..core.errors import IndexOutOfBounds, MissingObject
+
+    out = []
+    for cur in cursors:
+        try:
+            out.append(doc.resolve_cursor(cur))
+        except (IndexOutOfBounds, MissingObject):
+            out.append(-1)
+    return out

@@ -106,17 +106,18 @@ class StreamingMerge:
         self.mesh = mesh
         self.round_caps = (round_insert_capacity, round_delete_capacity, round_mark_capacity)
         self.comment_capacity = comment_capacity
-        if mesh is not None and num_docs % mesh.size:
-            raise ValueError(
-                f"num_docs={num_docs} must be a multiple of the mesh size "
-                f"({mesh.size}): the doc axis shards without padding"
-            )
+        # Sharding needs equal shards: pad the DEVICE doc axis up to a mesh
+        # multiple; padded rows are permanently empty docs (all-zero streams
+        # are no-ops) and are invisible in the public API (num_docs, reads).
+        self._padded_docs = (
+            -(-num_docs // mesh.size) * mesh.size if mesh is not None else num_docs
+        )
         self.docs = [_DocSession() for _ in range(num_docs)]
         self.rounds = 0
         self._patch_base: Dict[int, list] = {}
         self._resolved_cache = None  # (rounds, numpy ResolvedDocs)
         self._actor_table = OrderedActorTable(self.actors)
-        state = empty_docs(num_docs, slot_capacity, mark_capacity, tomb_capacity)
+        state = empty_docs(self._padded_docs, slot_capacity, mark_capacity, tomb_capacity)
         self.state: PackedDocs = shard_docs(state, mesh) if mesh is not None else state
 
     # -- ingestion ---------------------------------------------------------
@@ -245,11 +246,14 @@ class StreamingMerge:
         if scheduled == 0 and not frame_docs:
             return 0
 
+        pad_rows = self._padded_docs - self.num_docs
         encoded = pad_doc_streams(
-            per_doc,
+            per_doc + [_DocStreams()] * pad_rows,
             list(fallback_rows),
-            [s.encoder.actors if s.encoder else None for s in self.docs],
-            [s.encoder.attrs if s.encoder else None for s in self.docs],
+            [s.encoder.actors if s.encoder else None for s in self.docs]
+            + [None] * pad_rows,
+            [s.encoder.attrs if s.encoder else None for s in self.docs]
+            + [None] * pad_rows,
             insert_capacity=ki,
             delete_capacity=kd,
             mark_capacity=km,
@@ -453,6 +457,49 @@ class StreamingMerge:
             self._actor_table,
         )
 
+    def resolve_cursors(self, doc_index: int, cursors) -> List[int]:
+        """Resolve stable cursors (reference ``Cursor`` dicts, src/
+        micromerge.ts:859-870) for one doc; see resolve_cursors_batch."""
+        return self.resolve_cursors_batch({doc_index: list(cursors)})[doc_index]
+
+    def resolve_cursors_batch(self, cursor_map) -> Dict[int, List[int]]:
+        """Resolve cursors for many docs in ONE batched device call
+        (ops/resolve.resolve_cursors; width bucketed so varying counts reuse
+        one compiled program).  ``cursor_map``: {doc_index: [Cursor, ...]}.
+        Fallback and overflowed docs resolve via scalar replay.  Returns
+        visible indices per doc, -1 for absent elements."""
+        from ..ops.resolve import (
+            oracle_cursor_positions,
+            pack_cursor_rows,
+            resolve_cursors_jit,
+        )
+
+        overflow = np.asarray(self.state.overflow)
+        device_map, replay_docs = {}, []
+        for d, cursors in cursor_map.items():
+            if self.docs[d].fallback or bool(overflow[d]):
+                replay_docs.append(d)
+            else:
+                device_map[d] = cursors
+
+        out: Dict[int, List[int]] = {}
+        if device_map:
+            cursor_elem = pack_cursor_rows(
+                device_map, self._padded_docs, lambda d: self._actor_table
+            )
+            resolved = self._resolved_numpy()
+            positions = np.asarray(
+                resolve_cursors_jit(
+                    self.state, jnp.asarray(resolved.visible), cursor_elem
+                )
+            )
+            for d, cursors in device_map.items():
+                out[d] = [int(p) for p in positions[d, : len(cursors)]]
+        for d in replay_docs:
+            doc = _replay_doc(self._replay_changes(self.docs[d]))
+            out[d] = oracle_cursor_positions(doc, cursor_map[d])
+        return out
+
     def read_all(self) -> List[List[FormatSpan]]:
         resolved = self._resolved_numpy()
         overflow = np.asarray(resolved.overflow)
@@ -477,8 +524,10 @@ class StreamingMerge:
         round partitioning (compare those docs via read())."""
         resolved = resolve_jit(self.state, self.comment_capacity)
         on_device = np.asarray(
-            [not s.fallback for s in self.docs], bool
-        )[:, None]  # (D, 1)
+            [not s.fallback for s in self.docs]
+            + [False] * (self._padded_docs - self.num_docs),
+            bool,
+        )[:, None]  # (padded D, 1)
         mask = jnp.logical_and(
             jnp.asarray(on_device), jnp.logical_not(resolved.overflow)[:, None]
         )

@@ -158,3 +158,55 @@ def test_object_path_oversized_change_demotes_not_wedges():
     assert sess.pending_count() == 0
     w = {"doc1": [initial, big]}
     assert sess.read(0) == _oracle_doc(w).get_text_with_formatting(["text"])
+
+
+def test_streaming_cursor_resolution_matches_oracle():
+    import random
+
+    from peritext_tpu.api.batch import _oracle_doc
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    rng = random.Random(4)
+    workloads = generate_workload(seed=140, num_docs=3, ops_per_doc=100)
+    sess = StreamingMerge(
+        num_docs=3, actors=("doc1", "doc2", "doc3"), slot_capacity=512,
+        mark_capacity=128, round_insert_capacity=128,
+        round_delete_capacity=64, round_mark_capacity=64,
+    )
+    for d, w in enumerate(workloads):
+        sess.ingest_frame(d, encode_frame([ch for log in w.values() for ch in log]))
+    sess.drain()
+    for d, w in enumerate(workloads):
+        doc = _oracle_doc(w)
+        n = sum(len(s["text"]) for s in doc.get_text_with_formatting(["text"]))
+        if not n:
+            continue
+        cursors = [doc.get_cursor(["text"], rng.randrange(n)) for _ in range(5)]
+        expected = [doc.resolve_cursor(c) for c in cursors]
+        assert sess.resolve_cursors(d, cursors) == expected, f"doc {d}"
+    # unknown element -> -1
+    bogus = {"objectId": (1, "doc1"), "elemId": (99999, "nowhere")}
+    assert sess.resolve_cursors(0, [bogus]) == [-1]
+
+
+def test_streaming_cursor_resolution_on_fallback_doc():
+    from peritext_tpu.api.batch import _oracle_doc
+    from peritext_tpu.core.comment import Comment, put_comment
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.testing.generate import generate_docs
+
+    docs, _, initial = generate_docs("fallback text", 1)
+    (d1,) = docs
+    comment_change, _ = put_comment(d1, Comment(id="c9", actor="doc1", content="x"))
+    sess = StreamingMerge(
+        num_docs=1, actors=("doc1",), slot_capacity=128,
+        round_insert_capacity=64, round_delete_capacity=32, round_mark_capacity=32,
+    )
+    sess.ingest_frame(0, encode_frame([initial, comment_change]))
+    sess.drain()
+    assert sess.docs[0].fallback
+    w = {"doc1": [initial, comment_change]}
+    doc = _oracle_doc(w)
+    cursor = doc.get_cursor(["text"], 4)
+    assert sess.resolve_cursors(0, [cursor]) == [doc.resolve_cursor(cursor)]
