@@ -378,6 +378,11 @@ def main(argv: Optional[List[str]] = None) -> None:
         help="shard the doc axis over an N-device jax.sharding.Mesh "
              "(needs XLA_FLAGS=--xla_force_host_platform_device_count=N)",
     )
+    parser.add_argument(
+        "--faults", action="store_true",
+        help="scalar fuzz: inject delivery faults (drop 10%%, dup 10%%, "
+             "reorder) on every sync hop; anti-entropy must still converge",
+    )
     args = parser.parse_args(argv)
 
     mesh = None
@@ -427,10 +432,14 @@ def main(argv: Optional[List[str]] = None) -> None:
                 f"({device_docs} on device) match the oracle", flush=True,
             )
         else:
-            state = run_fuzz(seed, args.iterations, num_replicas=args.replicas)
+            faults = FaultSpec(drop_p=0.1, dup_p=0.1, reorder=True) if args.faults else None
+            state = run_fuzz(
+                seed, args.iterations, num_replicas=args.replicas, faults=faults
+            )
             print(
                 f"fuzz seed={seed}: {state.ops_generated} ops, "
-                f"{state.syncs} syncs, all convergence oracles passed", flush=True,
+                f"{state.syncs} syncs{' (faulted delivery)' if faults else ''}, "
+                f"all convergence oracles passed", flush=True,
             )
         if not args.forever:
             break
