@@ -384,6 +384,9 @@ def main(argv: Optional[List[str]] = None) -> None:
              "reorder) on every sync hop; anti-entropy must still converge",
     )
     args = parser.parse_args(argv)
+    if args.faults and (args.differential or args.differential_frames):
+        parser.error("--faults applies to the scalar fuzz only; it would be "
+                     "silently ignored with --differential/--differential-frames")
 
     mesh = None
     if args.mesh:
@@ -436,9 +439,23 @@ def main(argv: Optional[List[str]] = None) -> None:
             state = run_fuzz(
                 seed, args.iterations, num_replicas=args.replicas, faults=faults
             )
+            if faults is not None:
+                # faulted syncs skip the cross-replica oracle (deliveries are
+                # deliberately lossy); the property under test is that one
+                # clean anti-entropy round repairs everything
+                full_sync(state)
+                texts = [d.get_text_with_formatting(["text"]) for d in state.docs]
+                assert all(t == texts[0] for t in texts), (
+                    f"seed={seed}: replicas diverge after fault repair"
+                )
+                clocks = [d.clock for d in state.docs]
+                assert all(c == clocks[0] for c in clocks), (
+                    f"seed={seed}: clocks diverge after fault repair"
+                )
             print(
                 f"fuzz seed={seed}: {state.ops_generated} ops, "
-                f"{state.syncs} syncs{' (faulted delivery)' if faults else ''}, "
+                f"{state.syncs} syncs"
+                f"{' (faulted delivery; repaired + converged)' if faults else ''}, "
                 f"all convergence oracles passed", flush=True,
             )
         if not args.forever:
