@@ -62,6 +62,8 @@ from .causal import causal_schedule
 from .codec import decode_frame, encode_frame
 from .mesh import convergence_digest, shard_docs
 
+_digest_jit = jax.jit(convergence_digest)
+
 
 @dataclass
 class _DocSession:
@@ -99,6 +101,7 @@ class StreamingMerge:
         round_delete_capacity: int = 32,
         round_mark_capacity: int = 32,
         comment_capacity: int = 32,
+        read_chunk: int = 8192,
         mesh=None,
     ) -> None:
         self.num_docs = num_docs
@@ -112,10 +115,17 @@ class StreamingMerge:
         self._padded_docs = (
             -(-num_docs // mesh.size) * mesh.size if mesh is not None else num_docs
         )
+        # reads resolve the doc axis in blocks of this size (see the
+        # block-cached resolution section); meshed state is never sliced
+        self._read_chunk_requested = read_chunk
+        self._read_chunk = (
+            self._padded_docs if mesh is not None else max(1, min(read_chunk, max(num_docs, 1)))
+        )
         self.docs = [_DocSession() for _ in range(num_docs)]
         self.rounds = 0
         self._patch_base: Dict[int, list] = {}
-        self._resolved_cache = None  # (rounds, numpy ResolvedDocs)
+        # per-round cache of numpy-resolved doc blocks: (rounds, {bi: resolved})
+        self._resolved_cache = (-1, {})
         self._actor_table = OrderedActorTable(self.actors)
         state = empty_docs(self._padded_docs, slot_capacity, mark_capacity, tomb_capacity)
         self.state: PackedDocs = shard_docs(state, mesh) if mesh is not None else state
@@ -407,24 +417,57 @@ class StreamingMerge:
             return sess.attrs
         return sess.encoder.attrs if sess.encoder else None
 
-    def _resolved_numpy(self):
-        """Numpy-converted span resolution of the current device state,
-        cached per round: read/read_all/read_patches called per doc between
-        steps share ONE device resolve + host transfer instead of D."""
-        if self._resolved_cache is not None and self._resolved_cache[0] == self.rounds:
-            return self._resolved_cache[1]
-        resolved = resolve_jit(self.state, self.comment_capacity)
+    # -- block-cached resolution ------------------------------------------
+    #
+    # Reads resolve the doc axis in fixed-size BLOCKS: at 100K docs a full-
+    # batch span resolution materializes multi-GB comment planes and OOMs
+    # HBM, while any single read only needs its own block.  Blocks are
+    # cached per round (the hot pattern: many per-doc reads between steps)
+    # with at most two blocks resident.  Mesh sessions use one whole-batch
+    # block: state is sharded across devices there, and slicing would
+    # gather across shards.
+
+    def _block_bounds(self, block_index: int):
+        lo = block_index * self._read_chunk
+        return lo, min(lo + self._read_chunk, self._padded_docs)
+
+    def _state_block(self, block_index: int) -> PackedDocs:
+        lo, hi = self._block_bounds(block_index)
+        if lo == 0 and hi == self._padded_docs:
+            return self.state
+        return PackedDocs(*(x[lo:hi] for x in self.state))
+
+    def _resolved_block(self, block_index: int):
+        """Numpy-converted span resolution of one doc block, cached per
+        round so per-doc reads between steps share device work."""
+        stamp, cache = self._resolved_cache
+        if stamp != self.rounds:
+            cache = {}
+            self._resolved_cache = (self.rounds, cache)
+        if block_index in cache:
+            resolved = cache.pop(block_index)  # re-insert: LRU, not FIFO
+            cache[block_index] = resolved
+            return resolved
+        resolved = resolve_jit(self._state_block(block_index), self.comment_capacity)
         resolved = type(resolved)(*(np.asarray(x) for x in resolved))
-        self._resolved_cache = (self.rounds, resolved)
+        if len(cache) >= 2:  # bound host memory at large scale
+            cache.pop(next(iter(cache)))  # least-recently-used
+        cache[block_index] = resolved
         return resolved
+
+    def _resolved_doc(self, doc_index: int):
+        """(resolved block, index of the doc within it)."""
+        bi = doc_index // self._read_chunk
+        return self._resolved_block(bi), doc_index - bi * self._read_chunk
 
     def read(self, doc_index: int) -> List[FormatSpan]:
         sess = self.docs[doc_index]
-        overflow = bool(np.asarray(self.state.overflow)[doc_index])
-        if sess.fallback or overflow:
+        if sess.fallback:
             return _replay_spans(self._replay_changes(sess))
-        resolved = self._resolved_numpy()
-        return decode_doc_spans(resolved, doc_index, self._attr_table(sess))
+        resolved, local = self._resolved_doc(doc_index)
+        if bool(resolved.overflow[local]):
+            return _replay_spans(self._replay_changes(sess))
+        return decode_doc_spans(resolved, local, self._attr_table(sess))
 
     def read_patches(self, doc_index: int) -> List:
         """Incremental reference-shaped patches since this doc's previous
@@ -445,15 +488,16 @@ class StreamingMerge:
         from ..ops.patches import doc_chars_device, doc_chars_scalar
 
         sess = self.docs[doc_index]
-        overflow = bool(np.asarray(self.state.overflow)[doc_index])
-        if sess.fallback or overflow:
+        if sess.fallback:
             return doc_chars_scalar(_replay_doc(self._replay_changes(sess)))
-        resolved = self._resolved_numpy()
+        resolved, local = self._resolved_doc(doc_index)
+        if bool(resolved.overflow[local]):
+            return doc_chars_scalar(_replay_doc(self._replay_changes(sess)))
         return doc_chars_device(
             resolved,
-            doc_index,
+            local,
             self._attr_table(sess),
-            np.asarray(self.state.elem_id)[doc_index],
+            np.asarray(self.state.elem_id[doc_index]),
             self._actor_table,
         )
 
@@ -483,32 +527,36 @@ class StreamingMerge:
                 device_map[d] = cursors
 
         out: Dict[int, List[int]] = {}
-        if device_map:
+        by_block: Dict[int, Dict[int, list]] = {}
+        for d, cursors in device_map.items():
+            by_block.setdefault(d // self._read_chunk, {})[d] = cursors
+        for bi, block_map in by_block.items():
+            lo, hi = self._block_bounds(bi)
+            local_map = {d - lo: c for d, c in block_map.items()}
             cursor_elem = pack_cursor_rows(
-                device_map, self._padded_docs, lambda d: self._actor_table
+                local_map, hi - lo, lambda d: self._actor_table
             )
-            resolved = self._resolved_numpy()
+            resolved = self._resolved_block(bi)
             positions = np.asarray(
                 resolve_cursors_jit(
-                    self.state, jnp.asarray(resolved.visible), cursor_elem
+                    self._state_block(bi), jnp.asarray(resolved.visible), cursor_elem
                 )
             )
-            for d, cursors in device_map.items():
-                out[d] = [int(p) for p in positions[d, : len(cursors)]]
+            for d, cursors in block_map.items():
+                out[d] = [int(p) for p in positions[d - lo, : len(cursors)]]
         for d in replay_docs:
             doc = _replay_doc(self._replay_changes(self.docs[d]))
             out[d] = oracle_cursor_positions(doc, cursor_map[d])
         return out
 
     def read_all(self) -> List[List[FormatSpan]]:
-        resolved = self._resolved_numpy()
-        overflow = np.asarray(resolved.overflow)
         out: List[List[FormatSpan]] = []
         for i, sess in enumerate(self.docs):
-            if sess.fallback or bool(overflow[i]):
+            resolved, local = self._resolved_doc(i)
+            if sess.fallback or bool(resolved.overflow[local]):
                 out.append(_replay_spans(self._replay_changes(sess)))
             else:
-                out.append(decode_doc_spans(resolved, i, self._attr_table(sess)))
+                out.append(decode_doc_spans(resolved, local, self._attr_table(sess)))
         return out
 
     # -- cross-shard reductions (the ICI/DCN collectives) ------------------
@@ -521,18 +569,28 @@ class StreamingMerge:
         Fallback and overflowed docs are masked out — exactly the docs the
         read paths route to scalar replay: their truth lives host-side and
         their device rows may hold residue whose exact content depends on
-        round partitioning (compare those docs via read())."""
-        resolved = resolve_jit(self.state, self.comment_capacity)
-        on_device = np.asarray(
+        round partitioning (compare those docs via read()).
+
+        The digest is a doc-sum of per-doc hashes, so it is computed per
+        read-block and summed mod 2^32 — identical to the whole-batch value
+        while bounding device memory at 100K-doc scale."""
+        on_device_all = np.asarray(
             [not s.fallback for s in self.docs]
             + [False] * (self._padded_docs - self.num_docs),
             bool,
-        )[:, None]  # (padded D, 1)
-        mask = jnp.logical_and(
-            jnp.asarray(on_device), jnp.logical_not(resolved.overflow)[:, None]
         )
-        visible = jnp.logical_and(resolved.visible, mask)
-        return int(jax.jit(convergence_digest)(resolved.char, visible))
+        total = 0
+        n_blocks = -(-self._padded_docs // self._read_chunk)
+        for bi in range(n_blocks):
+            lo, hi = self._block_bounds(bi)
+            resolved = resolve_jit(self._state_block(bi), self.comment_capacity)
+            mask = jnp.logical_and(
+                jnp.asarray(on_device_all[lo:hi, None]),
+                jnp.logical_not(resolved.overflow)[:, None],
+            )
+            visible = jnp.logical_and(resolved.visible, mask)
+            total = (total + int(_digest_jit(resolved.char, visible))) & 0xFFFFFFFF
+        return total
 
     # -- checkpoint support (peritext_tpu.checkpoint.save_session) ----------
 
@@ -560,6 +618,9 @@ class StreamingMerge:
             "round_delete_capacity": self.round_caps[1],
             "round_mark_capacity": self.round_caps[2],
             "comment_capacity": self.comment_capacity,
+            # the REQUESTED value: a mesh session's effective block is its
+            # whole padded batch, but a meshless restore must block reads
+            "read_chunk": self._read_chunk_requested,
         }
 
     def frontier(self) -> Clock:

@@ -210,3 +210,44 @@ def test_streaming_cursor_resolution_on_fallback_doc():
     doc = _oracle_doc(w)
     cursor = doc.get_cursor(["text"], 4)
     assert sess.resolve_cursors(0, [cursor]) == [doc.resolve_cursor(cursor)]
+
+
+def test_block_chunked_reads_match_single_block():
+    """read_chunk smaller than num_docs: reads/digest/cursors/patches must be
+    identical to the whole-batch path (the 100K-doc memory-bounding mode)."""
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    workloads = generate_workload(seed=150, num_docs=5, ops_per_doc=80)
+
+    def build(read_chunk):
+        sess = StreamingMerge(
+            num_docs=5, actors=("doc1", "doc2", "doc3"), slot_capacity=512,
+            mark_capacity=128, round_insert_capacity=128,
+            round_delete_capacity=64, round_mark_capacity=64,
+            read_chunk=read_chunk,
+        )
+        for d, w in enumerate(workloads):
+            sess.ingest_frame(d, encode_frame([c for log in w.values() for c in log]))
+        sess.drain()
+        return sess
+
+    whole = build(read_chunk=8192)
+    chunked = build(read_chunk=2)  # 3 blocks, last one partial
+    assert chunked.digest() == whole.digest()
+    assert chunked.read_all() == whole.read_all()
+    for d in range(5):
+        assert chunked.read(d) == whole.read(d)
+        assert chunked.read_patches(d) == whole.read_patches(d)
+    # cursors across block boundaries in one batched call
+    from peritext_tpu.api.batch import _oracle_doc
+
+    cursor_map = {}
+    for d, w in enumerate(workloads):
+        doc = _oracle_doc(w)
+        n = sum(len(s["text"]) for s in doc.get_text_with_formatting(["text"]))
+        if n:
+            cursor_map[d] = [doc.get_cursor(["text"], n // 2)]
+    assert chunked.resolve_cursors_batch(cursor_map) == whole.resolve_cursors_batch(
+        cursor_map
+    )
