@@ -132,7 +132,8 @@ class TestCommentModel:
 class TestDemoScripts:
     @pytest.mark.parametrize(
         "script",
-        ["demos/two_editors.py", "demos/essay_demo.py", "demos/multihost_demo.py"],
+        ["demos/two_editors.py", "demos/essay_demo.py", "demos/multihost_demo.py",
+         "demos/scale_demo.py"],
     )
     def test_demo_runs_clean(self, script):
         proc = subprocess.run(
