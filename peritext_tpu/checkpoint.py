@@ -39,7 +39,7 @@ from .core.doc import Doc
 from .core.types import Change
 from .ops.packed import PackedDocs
 from .parallel.anti_entropy import ChangeStore, apply_changes
-from .parallel.causal import causal_sort
+
 
 # ---------------------------------------------------------------------------
 # Change-log persistence (the durable source of truth)
