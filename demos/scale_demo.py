@@ -6,7 +6,8 @@ to N independent documents as binary wire frames over two arrival rounds —
 the config-5 shape of BASELINE.md.  Ingest takes the frame-native fast path
 (C++ parse + one-call round scheduling); reads and the convergence digest
 resolve the doc axis in memory-bounded blocks, so N scales to 100K docs on
-a single chip (BASELINE.md row 5b: 22.6M ops in 170 s, zero fallbacks).
+a single chip (BASELINE.md row 5b: 22.6M ops converged on-device in 102 s,
+zero fallbacks or overflows).
 
 Run: python demos/scale_demo.py [--docs N]   (default 2000; try 100000 on TPU)
 """
@@ -48,9 +49,9 @@ def main() -> None:
 
     sess = StreamingMerge(
         num_docs=d, actors=("doc1", "doc2", "doc3"),
-        slot_capacity=384, mark_capacity=64, tomb_capacity=128,
+        slot_capacity=512, mark_capacity=160, tomb_capacity=192,
         round_insert_capacity=192, round_delete_capacity=96,
-        round_mark_capacity=64,
+        round_mark_capacity=96,
     )
     t_all = time.perf_counter()
     for r, frame in enumerate(frames):
@@ -68,10 +69,14 @@ def main() -> None:
     t_digest = time.perf_counter() - t0
     for doc in (0, d // 2, d - 1):
         assert sess.read(doc) == expected, f"doc {doc} diverged"
-    fallbacks = sum(1 for s in sess.docs if s.fallback)
-    assert fallbacks == 0
+    assert not any(s.fallback for s in sess.docs), "docs demoted to scalar replay"
+    # overflowed docs silently read via scalar replay and are masked from the
+    # digest — the demo's claim is DEVICE convergence, so none may overflow
+    assert sess.overflow_count() == 0, (
+        f"{sess.overflow_count()} docs overflowed device capacities"
+    )
 
-    print(f"\nconverged: digest {digest:#010x} ({t_digest:.1f}s, block-resolved)")
+    print(f"\nconverged ON DEVICE: digest {digest:#010x} ({t_digest:.1f}s, block-resolved)")
     print(f"{total_ops / 1e6:.1f}M ops in {wall:.1f}s "
           f"({total_ops / wall / 1e3:.0f}K ops/s end-to-end incl. host ingest)")
     print("sampled docs verified against the scalar oracle; 0 fallbacks")

@@ -636,6 +636,19 @@ class StreamingMerge:
                     merged[actor] = max(merged.get(actor, 0), seq)
         return merged
 
+    def overflow_count(self) -> int:
+        """Docs the device read path cannot serve: apply-time capacity
+        overflow OR resolve-time errors (mark anchor not found, comment attr
+        beyond capacity) — exactly the docs read() routes to scalar replay
+        and digest() masks.  A nonzero count on a converged session means
+        capacities should be raised for the workload (correctness is
+        preserved via replay either way)."""
+        n_blocks = -(-self._padded_docs // self._read_chunk)
+        return sum(
+            int(np.asarray(self._resolved_block(bi).overflow).sum())
+            for bi in range(n_blocks)
+        )
+
     def pending_count(self) -> int:
         return sum(
             (s.parsed.num_changes if s.frame_mode and s.parsed is not None else len(s.pending))
