@@ -85,3 +85,38 @@ def test_pad_doc_axis():
     assert padded.shape == (8, 3)
     assert padded[5:].sum() == 0
     assert pad_doc_axis(x, 5).shape == (5, 3)
+
+
+def test_cpu_platform_helper_yields_devices_and_restores():
+    """utils.platform.cpu_platform: >= n CPU devices inside, env restored after."""
+    import os
+
+    from peritext_tpu.utils.platform import cpu_platform
+
+    before_env = os.environ.get("JAX_PLATFORMS")
+    before_flags = os.environ.get("XLA_FLAGS")
+    with cpu_platform(8) as devices:
+        assert len(devices) >= 8
+        assert all(d.platform == "cpu" for d in devices[:8])
+        assert os.environ.get("JAX_PLATFORMS") == "cpu"
+        # eager arrays inside the block land on a CPU device
+        x = jax.numpy.zeros((2,))
+        assert next(iter(x.devices())).platform == "cpu"
+    assert os.environ.get("JAX_PLATFORMS") == before_env
+    assert os.environ.get("XLA_FLAGS") == before_flags
+
+
+def test_pin_cpu_platform_raises_small_flag_count(monkeypatch):
+    """A pre-existing too-small forced count is raised, not silently kept."""
+    import os
+
+    from peritext_tpu.utils import platform as plat
+
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+    # conftest already created the 8-device CPU client, so the flag rewrite
+    # cannot change live device count — but the env must reflect the request.
+    try:
+        plat.pin_cpu_platform(8)
+    except RuntimeError:
+        pass  # acceptable iff the client predates the flag; env still checked
+    assert "device_count=8" in os.environ["XLA_FLAGS"]
