@@ -192,18 +192,23 @@ def run_streaming(args) -> dict:
             round_mark_capacity=128,
         )
 
-    def feed(s, doc, batch):
+    def feed_round(s, r):
         if args.object_ingest:
-            s.ingest(doc, batch)
+            for doc, batches in enumerate(arrival):
+                if r < len(batches):
+                    s.ingest(doc, batches[r])
         else:
-            s.ingest_frame(doc, batch)
+            # the bulk DCN receive path: one native parse call per round
+            s.ingest_frames(
+                (doc, batches[r])
+                for doc, batches in enumerate(arrival)
+                if r < len(batches)
+            )
 
     # warmup compile
     s = session()
     for r in range(rounds):
-        for doc, batches in enumerate(arrival):
-            if r < len(batches):
-                feed(s, doc, batches[r])
+        feed_round(s, r)
         s.drain()
     digest0 = s.digest()
     fallbacks = sum(1 for sess in s.docs if sess.fallback)
@@ -211,9 +216,7 @@ def run_streaming(args) -> dict:
     t0 = time.perf_counter()
     s = session()
     for r in range(rounds):
-        for doc, batches in enumerate(arrival):
-            if r < len(batches):
-                feed(s, doc, batches[r])
+        feed_round(s, r)
         s.drain()
     digest = s.digest()  # sync point
     elapsed = time.perf_counter() - t0

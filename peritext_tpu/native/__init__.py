@@ -72,6 +72,7 @@ def load() -> Optional[ctypes.CDLL]:
         except OSError:
             return None
         i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
         u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
         lib.pt_causal_schedule.restype = ctypes.c_int32
         lib.pt_causal_schedule.argtypes = [
@@ -105,6 +106,18 @@ def load() -> Optional[ctypes.CDLL]:
             + [i32p] * 4  # n_ins, n_del, n_mark, n_admitted
             + [u8p] * 2  # admitted, status
         )
+        lib.pt_parse_frames.restype = ctypes.c_int32
+        lib.pt_parse_frames.argtypes = [
+            u8p, i64p, ctypes.c_int32,  # data, frame_off, n_frames
+            u8p, i64p, ctypes.c_int32,  # actor_bytes, actor_off, n_actors
+            ctypes.c_int32, ctypes.c_int32,  # actor_bits, max_ctr
+            i32p, i32p, i32p,  # f_status, f_ch_off, f_str_off
+            i64p, i32p, ctypes.c_int64,  # str_start, str_len, str_cap
+            i32p, i32p, ctypes.c_int64,  # ch_actor, ch_seq, ch_cap
+            i32p, i32p, i32p, ctypes.c_int64,  # dep_off, dep_actor, dep_seq, dep_cap
+            i32p, i32p, ctypes.c_int64,  # ops_off, ops, op_cap
+            i32p, i32p, i32p,  # cnt_ins, cnt_del, cnt_mark
+        ]
         _lib = lib
         return _lib
 
@@ -192,6 +205,80 @@ def parse_changes(
         dep_off, dep_actor[:n_deps].copy(), dep_seq[:n_deps].copy(),
         ops_off, ops[:n_ops].copy(),
         cnt_ins, cnt_del, cnt_mark,
+    )
+
+
+def parse_frames(
+    data: np.ndarray,  # concatenated frame bytes, uint8
+    frame_off: np.ndarray,  # (F+1,) int64 byte offsets
+    header_counts,  # (n_changes_total, n_strings_total, n_ints_total) from headers
+    actor_strings,  # declared actor names in interner order (index i -> id i+1)
+    actor_bits: int,
+    max_ctr: int,
+):
+    """Bulk whole-frame parse (see pt_parse_frames in native.cpp).
+
+    Returns ``(f_status, f_ch_off, f_str_off, str_start, str_len, ch_actor,
+    ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops, cnt_ins, cnt_del,
+    cnt_mark)`` with all change/dep/op arrays flattened across frames and
+    trimmed to their true lengths, or None when no native library.  Corrupt
+    frames are reported per frame via ``f_status`` (1), never an exception.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    n_frames = int(frame_off.shape[0]) - 1
+    ch_total, str_total, ints_total = (int(x) for x in header_counts)
+    raw = [s.encode("utf-8") for s in actor_strings]
+    actor_bytes = np.frombuffer(b"".join(raw) or b"\x00", np.uint8)
+    actor_off = np.concatenate(
+        [[0], np.cumsum([len(r) for r in raw], dtype=np.int64)]
+    ).astype(np.int64)
+
+    dep_cap = ints_total // 2 + 2
+    op_cap = ints_total // 2 + 2
+    str_cap = str_total + 1
+    f_status = np.empty(n_frames, np.int32)
+    f_ch_off = np.empty(n_frames + 1, np.int32)
+    f_str_off = np.empty(n_frames + 1, np.int32)
+    str_start = np.empty(str_cap, np.int64)
+    str_len = np.empty(str_cap, np.int32)
+    ch_actor = np.empty(ch_total + 1, np.int32)
+    ch_seq = np.empty(ch_total + 1, np.int32)
+    dep_off = np.empty(ch_total + 2, np.int32)
+    dep_actor = np.empty(dep_cap, np.int32)
+    dep_seq = np.empty(dep_cap, np.int32)
+    ops_off = np.empty(ch_total + 2, np.int32)
+    ops = np.empty((op_cap, 10), np.int32)
+    cnt_ins = np.empty(ch_total + 1, np.int32)
+    cnt_del = np.empty(ch_total + 1, np.int32)
+    cnt_mark = np.empty(ch_total + 1, np.int32)
+
+    rc = lib.pt_parse_frames(
+        np.ascontiguousarray(data), np.ascontiguousarray(frame_off, np.int64),
+        n_frames,
+        np.ascontiguousarray(actor_bytes), actor_off, len(raw),
+        int(actor_bits), int(max_ctr),
+        f_status, f_ch_off, f_str_off,
+        str_start, str_len, str_cap,
+        ch_actor, ch_seq, ch_total + 1,
+        dep_off, dep_actor, dep_seq, dep_cap,
+        ops_off, ops.reshape(-1), op_cap,
+        cnt_ins, cnt_del, cnt_mark,
+    )
+    if rc != 0:  # capacity sizing bug — surface loudly, don't mis-parse
+        raise RuntimeError(f"pt_parse_frames capacity error rc={rc}")
+    nc = int(f_ch_off[n_frames])
+    ns = int(f_str_off[n_frames])
+    n_deps = int(dep_off[nc]) if nc else 0
+    n_ops = int(ops_off[nc]) if nc else 0
+    return (
+        f_status, f_ch_off, f_str_off,
+        str_start[:ns], str_len[:ns],
+        ch_actor[:nc], ch_seq[:nc],
+        dep_off[: nc + 1], dep_actor[:n_deps].copy(), dep_seq[:n_deps].copy(),
+        ops_off[: nc + 1], ops[:n_ops].copy(),
+        cnt_ins[:nc], cnt_del[:nc], cnt_mark[:nc],
     )
 
 

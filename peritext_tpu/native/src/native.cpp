@@ -20,6 +20,7 @@
 #include <cstdint>
 #include <cstring>
 #include <queue>
+#include <string_view>
 #include <unordered_map>
 #include <vector>
 
@@ -436,6 +437,266 @@ int32_t pt_schedule_split_batch(
         total_admitted += nch;
     }
     return total_admitted;
+}
+
+// ---------------------------------------------------------------------------
+// pt_parse_frames — bulk whole-frame ingest: N raw wire frames -> flat parsed
+// arrays in ONE call.
+//
+// This is the pod-scale data-loader path (SURVEY §5.8, BASELINE config 5):
+// per-frame Python — header/string-table walks, actor lookups, per-frame
+// array allocation — dominates streaming ingest once thousands of docs ship
+// frames every round, so the whole loop moves here.  The frame layout is
+// exactly parallel/codec.py::encode_frame (29-byte header, zigzag-varint
+// string lengths + UTF-8 bytes, zigzag-varint int payload); the per-change
+// payload walk matches pt_parse_changes above, with string-table and
+// dep/op offsets GLOBALIZED across frames (f_str_off / f_ch_off give each
+// frame's slice).
+//
+// Outputs use the same conventions as pt_parse_changes; additionally:
+//   f_status[f]  : 0 ok, 1 corrupt (that frame contributes nothing; its
+//                  slice in f_ch_off/f_str_off is empty)
+//   str_start/str_len : byte spans of every string-table entry, absolute
+//                  into `data`, so Python can lazily decode only the strings
+//                  it needs (mark attrs, JSON-spillover rows)
+//   ops col 3 (json rows) and col 9 (mark attr + 1) hold GLOBAL string ids.
+//
+// Actor identity: actor_bytes/actor_off list the declared actor table's
+// UTF-8 names in interner order (index i -> interner id i+1; id 0 is the
+// reserved None slot, matching utils/interning.Interner).
+//
+// Returns 0 on success, negative on output-capacity overflow (a caller
+// sizing bug: capacities derive exactly from the validated frame headers).
+int32_t pt_parse_frames(
+    const uint8_t* data, const int64_t* frame_off, int32_t n_frames,
+    const uint8_t* actor_bytes, const int64_t* actor_off, int32_t n_actors,
+    int32_t actor_bits, int32_t max_ctr,
+    int32_t* f_status, int32_t* f_ch_off, int32_t* f_str_off,
+    int64_t* str_start, int32_t* str_len, int64_t str_cap,
+    int32_t* ch_actor, int32_t* ch_seq, int64_t ch_cap,
+    int32_t* dep_off, int32_t* dep_actor, int32_t* dep_seq, int64_t dep_cap,
+    int32_t* ops_off, int32_t* ops, int64_t op_cap,
+    int32_t* cnt_ins, int32_t* cnt_del, int32_t* cnt_mark) {
+    std::unordered_map<std::string_view, int32_t> amap;
+    amap.reserve(static_cast<size_t>(n_actors) * 2);
+    for (int32_t i = 0; i < n_actors; ++i) {
+        amap.emplace(
+            std::string_view(reinterpret_cast<const char*>(actor_bytes) + actor_off[i],
+                             static_cast<size_t>(actor_off[i + 1] - actor_off[i])),
+            i + 1);
+    }
+
+    int64_t nc = 0, nd = 0, no = 0, ns = 0;  // global cursors
+    dep_off[0] = 0;
+    ops_off[0] = 0;
+    f_ch_off[0] = 0;
+    f_str_off[0] = 0;
+    std::vector<int32_t> vals;  // reused per-frame payload scratch
+    std::vector<int32_t> s2a;   // frame string idx -> actor interner id | -1
+
+    for (int32_t f = 0; f < n_frames; ++f) {
+        const int64_t lo = frame_off[f], hi = frame_off[f + 1];
+        const int64_t save_nc = nc, save_nd = nd, save_no = no, save_ns = ns;
+        bool corrupt = false;
+
+        do {
+            if (hi - lo < 29 || hi > frame_off[n_frames]) { corrupt = true; break; }
+            // header: magic(4) ver(1) n_changes(u32) n_strings(u32)
+            //         n_ints(u64) payload_len(u64)  — little-endian packed
+            if (std::memcmp(data + lo, "PTXF", 4) != 0 || data[lo + 4] != 1) {
+                corrupt = true; break;
+            }
+            uint32_t h_changes, h_strings;
+            uint64_t h_ints, h_payload;
+            std::memcpy(&h_changes, data + lo + 5, 4);
+            std::memcpy(&h_strings, data + lo + 9, 4);
+            std::memcpy(&h_ints, data + lo + 13, 8);
+            std::memcpy(&h_payload, data + lo + 21, 8);
+            const uint64_t body = static_cast<uint64_t>(hi - lo - 29);
+            if (h_payload > body || h_ints > h_payload || h_strings > body ||
+                static_cast<uint64_t>(h_changes) * 5 > h_ints) {
+                corrupt = true; break;
+            }
+            if (nc + h_changes > ch_cap) return -2;
+            if (ns + h_strings > str_cap) return -4;
+
+            // string table: zigzag-varint length + UTF-8 bytes per entry
+            int64_t pos = lo + 29;
+            s2a.assign(h_strings, -1);
+            for (uint32_t s = 0; s < h_strings && !corrupt; ++s) {
+                uint32_t z = 0;
+                int shift = 0;
+                while (true) {
+                    if (pos >= hi || shift > 28) { corrupt = true; break; }
+                    const uint8_t byte = data[pos++];
+                    z |= static_cast<uint32_t>(byte & 0x7F) << shift;
+                    if (!(byte & 0x80)) break;
+                    shift += 7;
+                }
+                if (corrupt) break;
+                const int32_t length = static_cast<int32_t>((z >> 1) ^ (~(z & 1) + 1));
+                if (length < 0 || pos + length > hi) { corrupt = true; break; }
+                str_start[ns + s] = pos;
+                str_len[ns + s] = length;
+                auto it = amap.find(std::string_view(
+                    reinterpret_cast<const char*>(data) + pos,
+                    static_cast<size_t>(length)));
+                s2a[s] = (it == amap.end()) ? -1 : it->second;
+                pos += length;
+            }
+            if (corrupt) break;
+            if (pos + static_cast<int64_t>(h_payload) > hi) { corrupt = true; break; }
+
+            // payload: zigzag varints, exactly h_ints of them
+            vals.assign(h_ints, 0);
+            {
+                int64_t p = pos, count = 0;
+                const int64_t pend = pos + static_cast<int64_t>(h_payload);
+                while (p < pend) {
+                    uint32_t z = 0;
+                    int shift = 0;
+                    while (true) {
+                        if (p >= pend || shift > 28) { corrupt = true; break; }
+                        const uint8_t byte = data[p++];
+                        z |= static_cast<uint32_t>(byte & 0x7F) << shift;
+                        if (!(byte & 0x80)) break;
+                        shift += 7;
+                    }
+                    if (corrupt) break;
+                    if (count >= static_cast<int64_t>(h_ints)) { corrupt = true; break; }
+                    vals[count++] = static_cast<int32_t>((z >> 1) ^ (~(z & 1) + 1));
+                }
+                if (!corrupt && count != static_cast<int64_t>(h_ints)) corrupt = true;
+            }
+            if (corrupt) break;
+
+            // change walk (the pt_parse_changes logic, offsets globalized)
+            const int32_t n_strings_f = static_cast<int32_t>(h_strings);
+            int64_t p = 0;
+            const int64_t n_vals = static_cast<int64_t>(h_ints);
+            auto take = [&](int64_t k) -> const int32_t* {
+                if (p + k > n_vals) return nullptr;
+                const int32_t* ptr = vals.data() + p;
+                p += k;
+                return ptr;
+            };
+            auto actor_of = [&](int32_t strid) -> int32_t {
+                if (strid < 0 || strid >= n_strings_f) return -2;
+                return s2a[strid];
+            };
+            auto pack = [&](int32_t ctr, int32_t strid, bool* bad) -> int32_t {
+                const int32_t a = actor_of(strid);
+                if (a == -2) { *bad = true; return 0; }
+                if (a < 0 || ctr < 0 || ctr > max_ctr) { *bad = true; return 0; }
+                return (ctr << actor_bits) | a;
+            };
+
+            for (uint32_t c = 0; c < h_changes && !corrupt; ++c) {
+                const int32_t* h = take(4);
+                if (!h) { corrupt = true; break; }
+                const int32_t a = actor_of(h[0]);
+                if (a == -2) { corrupt = true; break; }
+                ch_actor[nc] = a;  // may be -1: undeclared, caller demotes
+                ch_seq[nc] = h[1];
+                const int32_t ndeps = h[3];
+                if (ndeps < 0) { corrupt = true; break; }
+                for (int32_t d = 0; d < ndeps; ++d) {
+                    const int32_t* dp = take(2);
+                    if (!dp) { corrupt = true; break; }
+                    const int32_t da = actor_of(dp[0]);
+                    if (da == -2) { corrupt = true; break; }
+                    if (da < 0) { ch_actor[nc] = -1; continue; }
+                    if (nd >= dep_cap) return -2;
+                    dep_actor[nd] = da;
+                    dep_seq[nd] = dp[1];
+                    ++nd;
+                }
+                if (corrupt) break;
+                dep_off[nc + 1] = static_cast<int32_t>(nd);
+
+                const int32_t* nop = take(1);
+                if (!nop) { corrupt = true; break; }
+                const int32_t nops = *nop;
+                if (nops < 0) { corrupt = true; break; }
+                int32_t ci = 0, cd = 0, cm = 0;
+                for (int32_t k = 0; k < nops && !corrupt; ++k) {
+                    if (no >= op_cap) return -3;
+                    int32_t* row = ops + no * 10;
+                    for (int i = 0; i < 10; ++i) row[i] = 0;
+                    const int32_t* kindp = take(1);
+                    if (!kindp) { corrupt = true; break; }
+                    const int32_t kind = *kindp;
+                    bool bad = (ch_actor[nc] < 0);
+                    if (kind == 4) {  // JSON spillover: [strid] -> global id
+                        const int32_t* b = take(1);
+                        if (!b) { corrupt = true; break; }
+                        if (b[0] < 0 || b[0] >= n_strings_f) { corrupt = true; break; }
+                        row[0] = 3;
+                        row[3] = static_cast<int32_t>(ns) + b[0];
+                    } else if (kind == 0) {  // insert
+                        const int32_t* b = take(9);
+                        if (!b) { corrupt = true; break; }
+                        row[0] = 0;
+                        row[1] = b[0] == 0 ? -1 : pack(b[1], b[2], &bad);
+                        row[2] = pack(b[3], b[4], &bad);
+                        row[3] = b[5] == 0 ? 0 : pack(b[6], b[7], &bad);
+                        row[4] = b[8];
+                        ++ci;
+                    } else if (kind == 1) {  // delete
+                        const int32_t* b = take(7);
+                        if (!b) { corrupt = true; break; }
+                        row[0] = 1;
+                        row[1] = b[0] == 0 ? -1 : pack(b[1], b[2], &bad);
+                        row[2] = pack(b[3], b[4], &bad);
+                        row[3] = pack(b[5], b[6], &bad);
+                        ++cd;
+                    } else if (kind == 2 || kind == 3) {  // add/remove mark
+                        const int32_t* b = take(13);
+                        if (!b) { corrupt = true; break; }
+                        if (b[6] < 0 || b[6] > 3 || b[9] < 0 || b[9] > 3) {
+                            corrupt = true; break;
+                        }
+                        row[0] = 2;
+                        row[1] = b[0] == 0 ? -1 : pack(b[1], b[2], &bad);
+                        row[2] = pack(b[3], b[4], &bad);
+                        row[3] = (kind == 2) ? 1 : 2;
+                        row[4] = b[5];
+                        row[5] = b[6];
+                        row[6] = (b[6] <= 1) ? pack(b[7], b[8], &bad) : 0;
+                        row[7] = b[9];
+                        row[8] = (b[9] <= 1) ? pack(b[10], b[11], &bad) : 0;
+                        if (b[12] < 0 || b[12] > n_strings_f) { corrupt = true; break; }
+                        row[9] = b[12] == 0
+                            ? 0
+                            : static_cast<int32_t>(ns) + (b[12] - 1) + 1;
+                        ++cm;
+                    } else {
+                        corrupt = true; break;
+                    }
+                    if (bad) row[0] = 4;
+                    ++no;
+                }
+                if (corrupt) break;
+                ops_off[nc + 1] = static_cast<int32_t>(no);
+                cnt_ins[nc] = ci;
+                cnt_del[nc] = cd;
+                cnt_mark[nc] = cm;
+                ++nc;
+            }
+            if (!corrupt && p != n_vals) corrupt = true;  // trailing garbage
+            if (!corrupt) ns += h_strings;
+        } while (false);
+
+        if (corrupt) {
+            nc = save_nc; nd = save_nd; no = save_no; ns = save_ns;
+            f_status[f] = 1;
+        } else {
+            f_status[f] = 0;
+        }
+        f_ch_off[f + 1] = static_cast<int32_t>(nc);
+        f_str_off[f + 1] = static_cast<int32_t>(ns);
+    }
+    return 0;
 }
 
 }  // extern "C"

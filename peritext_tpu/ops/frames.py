@@ -227,6 +227,219 @@ def parse_frame(
     return parsed, text_obj
 
 
+#: parse_frames_bulk per-frame statuses
+FRAME_OK = 0
+FRAME_CORRUPT = 1  # -> ValueError semantics (nothing ingested)
+FRAME_DEMOTE = 2  # -> FrameIngestError semantics (doc leaves the fast path)
+
+
+def frame_header_counts(buf: np.ndarray, frame_off: np.ndarray):
+    """Vectorized header read over concatenated frames: per-frame
+    ``(n_changes, n_strings, n_ints)`` clamped by the same sanity rules the
+    parser enforces (so corrupt headers cannot inflate allocations), plus a
+    per-frame header-valid mask."""
+    lens = frame_off[1:] - frame_off[:-1]
+    n = len(lens)
+    n_changes = np.zeros(n, np.int64)
+    n_strings = np.zeros(n, np.int64)
+    n_ints = np.zeros(n, np.int64)
+    ok = lens >= 29
+    if not ok.any():
+        return n_changes, n_strings, n_ints, ok
+    idx = np.nonzero(ok)[0]
+    hdr = buf[np.add.outer(frame_off[:-1][idx], np.arange(29, dtype=np.int64))]
+    magic_ok = np.all(hdr[:, :4] == np.frombuffer(b"PTXF", np.uint8), axis=1)
+    ver_ok = hdr[:, 4] == 1
+    h_changes = hdr[:, 5:9].copy().view("<u4").ravel().astype(np.int64)
+    h_strings = hdr[:, 9:13].copy().view("<u4").ravel().astype(np.int64)
+    h_ints = hdr[:, 13:21].copy().view("<u8").ravel().astype(np.int64)
+    h_payload = hdr[:, 21:29].copy().view("<u8").ravel().astype(np.int64)
+    body = (lens[idx] - 29).astype(np.int64)
+    sane = (
+        magic_ok & ver_ok
+        & (h_payload <= body) & (h_ints <= h_payload) & (h_strings <= body)
+        & (h_changes * 5 <= h_ints)
+    )
+    ok[idx] = sane
+    keep = idx[sane]
+    n_changes[keep] = h_changes[sane]
+    n_strings[keep] = h_strings[sane]
+    n_ints[keep] = h_ints[sane]
+    return n_changes, n_strings, n_ints, ok
+
+
+def parse_frames_bulk(
+    data: bytes,
+    frame_off: np.ndarray,
+    actors: OrderedActorTable,
+    attrs: Interner,
+    doc_ids: np.ndarray,
+    text_obj_by_doc: dict,
+):
+    """Parse MANY concatenated wire frames in one native call (the bulk twin
+    of :func:`parse_frame` — per-frame Python eliminated; SURVEY §5.8's
+    pod-scale data loader).
+
+    ``data`` holds the frames back to back with ``frame_off`` (F+1 int64)
+    byte offsets; ``doc_ids[f]`` is the document each frame belongs to and
+    ``text_obj_by_doc`` maps doc -> packed text-list id (0 = unknown),
+    updated in place as makeList ops are consumed.
+
+    Returns ``(parsed, f_ch_off, status)``: ``parsed`` is one flat
+    ParsedChanges across ALL frames (including to-be-demoted ones — slice by
+    ``f_ch_off`` and drop by ``status``), statuses per FRAME_* above.
+    Returns None when the native core is unavailable.
+    """
+    if not native.available():
+        return None
+    if len(actors) - 1 > MAX_ACTORS:
+        n_frames = len(frame_off) - 1
+        return (
+            ParsedChanges.empty(),
+            np.zeros(n_frames + 1, np.int32),
+            np.full(n_frames, FRAME_DEMOTE, np.int32),
+        )
+    buf = np.frombuffer(data, np.uint8)
+    n_changes, n_strings, n_ints, hdr_ok = frame_header_counts(buf, frame_off)
+    out = native.parse_frames(
+        buf,
+        frame_off,
+        (int(n_changes.sum()), int(n_strings.sum()), int(n_ints.sum())),
+        [actors.lookup(i) for i in range(1, len(actors))],
+        ACTOR_BITS,
+        MAX_CTR,
+    )
+    if out is None:  # pragma: no cover - available() checked above
+        return None
+    (f_status, f_ch_off, f_str_off, str_start, str_len,
+     ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops,
+     cnt_ins, cnt_del, cnt_mark) = out
+    status = f_status.astype(np.int32)
+
+    n_frames = len(frame_off) - 1
+    kinds = ops[:, 0]
+
+    def frames_of_ops(rows: np.ndarray) -> np.ndarray:
+        changes = np.searchsorted(ops_off, rows, side="right") - 1
+        return (np.searchsorted(f_ch_off, changes, side="right") - 1).astype(np.int64)
+
+    # Byte-content string access: slices of the original bytes object (no
+    # numpy round trip), decoded once per distinct content.
+    _decoded: dict = {}
+
+    def string_at(gid: int) -> str:
+        start = int(str_start[gid])
+        raw = data[start : start + int(str_len[gid])]
+        s = _decoded.get(raw)
+        if s is None:
+            s = raw.decode("utf-8")
+            _decoded[raw] = s
+        return s
+
+    # Validation passes run BEFORE the makeList adoption below, so a frame
+    # that will be rejected can never leak state into text_obj_by_doc.
+    # Value validation first (corrupt-frame semantics, as in parse_frame):
+    ins_bad = (kinds == KIND_INS) & ((ops[:, 4] < 0) | (ops[:, 4] > 0x10FFFF))
+    mark_bad = (kinds == KIND_MARK) & (
+        (ops[:, 4] < 0) | (ops[:, 4] >= len(ALL_MARKS))
+    )
+    value_bad = np.nonzero(ins_bad | mark_bad)[0]
+    if len(value_bad):
+        status[frames_of_ops(value_bad)] = FRAME_CORRUPT
+    status[~hdr_ok] = FRAME_CORRUPT  # belt: native flags these too
+
+    # Undeclared actors / out-of-range ids (KIND_BAD) demote their frame.
+    bad_rows = np.nonzero(kinds == KIND_BAD)[0]
+    if len(bad_rows):
+        for f in np.unique(frames_of_ops(bad_rows)):
+            if status[f] == FRAME_OK:
+                status[f] = FRAME_DEMOTE
+    if (ch_actor < 0).any():
+        ch_frame = np.repeat(np.arange(n_frames), np.diff(f_ch_off))
+        for f in np.unique(ch_frame[ch_actor < 0]):
+            if status[f] == FRAME_OK:
+                status[f] = FRAME_DEMOTE
+
+    # JSON-spillover rows: only each doc's makeList is fast-path-able (same
+    # contract as parse_frame).  Frames are processed in arrival order so a
+    # makeList learned from an earlier frame governs later frames of the same
+    # doc — but each frame's adoption commits only if the whole frame stays
+    # OK (a frame that fails mid-way must contribute nothing).
+    json_rows = np.nonzero(kinds == KIND_JSON)[0]
+    if len(json_rows):
+        jr_frames = frames_of_ops(json_rows)
+        for f in np.unique(jr_frames):
+            if status[f]:
+                continue
+            doc = int(doc_ids[f])
+            local_text = text_obj_by_doc.get(doc, 0)
+            staged: list = []
+            for row in json_rows[jr_frames == f]:
+                try:
+                    op = Operation.from_json(json.loads(string_at(int(ops[row, 3]))))
+                except (ValueError, TypeError, KeyError, AttributeError,
+                        UnicodeDecodeError):
+                    status[f] = FRAME_CORRUPT
+                    break
+                if op.action != "makeList":
+                    status[f] = FRAME_DEMOTE
+                    break
+                actor_idx = actors.get(op.opid[1])
+                if actor_idx is None or op.opid[0] > MAX_CTR:
+                    status[f] = FRAME_DEMOTE
+                    break
+                packed = pack_id(op.opid[0], actor_idx)
+                if local_text == 0:
+                    local_text = packed
+                elif packed != local_text:
+                    status[f] = FRAME_DEMOTE
+                    break
+                staged.append(row)
+            if status[f] == FRAME_OK:
+                text_obj_by_doc[doc] = local_text
+                for row in staged:
+                    ops[row, 0] = KIND_SKIP
+                    ops[row, 1] = local_text
+
+    # Session-level attr interning.  Unique by byte CONTENT, not by global
+    # string id: every frame carries its own string table, so the same url /
+    # comment id reappears under thousands of distinct gids at pod scale.
+    # Fully vectorized — group by length, gather an (N, len) byte matrix,
+    # np.unique rows, decode only the handful of distinct strings.
+    attr_rows = np.nonzero((kinds == KIND_MARK) & (ops[:, 9] > 0))[0]
+    if len(attr_rows):
+        gids = ops[attr_rows, 9] - 1
+        starts = str_start[gids]
+        lens = str_len[gids]
+        new_ids = np.zeros(len(attr_rows), np.int32)
+        bad_mask = np.zeros(len(attr_rows), bool)
+        for ln in np.unique(lens):
+            sel = np.nonzero(lens == ln)[0]
+            if ln == 0:
+                new_ids[sel] = attrs.intern("")
+                continue
+            content = buf[starts[sel][:, None] + np.arange(int(ln), dtype=np.int64)]
+            uniq_rows, inv = np.unique(content, axis=0, return_inverse=True)
+            ids = np.empty(len(uniq_rows), np.int32)
+            for j in range(len(uniq_rows)):
+                try:
+                    ids[j] = attrs.intern(uniq_rows[j].tobytes().decode("utf-8"))
+                except UnicodeDecodeError:
+                    ids[j] = -1  # decode failure: corrupt-frame semantics
+            mapped = ids[inv]
+            bad_mask[sel] = mapped < 0
+            new_ids[sel] = np.maximum(mapped, 0)
+        if bad_mask.any():
+            status[frames_of_ops(attr_rows[bad_mask])] = FRAME_CORRUPT
+        ops[attr_rows, 9] = new_ids
+
+    parsed = ParsedChanges(
+        ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops,
+        cnt_ins, cnt_del, cnt_mark,
+    )
+    return parsed, f_ch_off, status
+
+
 def _py_schedule_order(
     parsed: ParsedChanges, n_actors: int, clock: np.ndarray
 ) -> np.ndarray:

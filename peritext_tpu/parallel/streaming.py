@@ -47,11 +47,14 @@ from ..core.doc import Doc
 from ..core.types import Change, Clock, FormatSpan
 from ..observability import GLOBAL_COUNTERS
 from ..ops.decode import decode_doc_spans
-from ..ops.encode import DocEncoder, _DocStreams, pad_doc_streams
+from ..ops.encode import DocEncoder, _DocStreams
+from ..ops.encode import MARK_COLS
 from ..ops.frames import (
+    FRAME_CORRUPT,
+    FRAME_DEMOTE,
     FrameIngestError,
     ParsedChanges,
-    parse_frame,
+    parse_frames_bulk,
     schedule_split,
 )
 from ..ops.kernel import apply_batch_jit, encoded_arrays_of
@@ -72,14 +75,34 @@ class _DocSession:
     pending: List[Change] = field(default_factory=list)
     log: List[Change] = field(default_factory=list)
     fallback: bool = False
-    # frame-native mode (ops/frames.py): raw wire frames are the event source
-    # and pending ops live as flat parsed arrays, never Python objects
+    # frame-native mode (ops/frames.py): raw wire frames are the event source;
+    # pending parsed ops live in the session-level pool (one flat array chunk
+    # per bulk arrival, never per-doc Python objects), applied clocks live in
+    # the session-level clock matrix, attr interning is session-level too.
     frame_mode: bool = False
     frames: List[bytes] = field(default_factory=list)
-    parsed: Optional[ParsedChanges] = None
-    clock_arr: Optional[np.ndarray] = None
     text_obj: int = 0
-    attrs: Optional[Interner] = None
+
+
+class _RoundBuffers:
+    """One round's padded device-stream staging arrays (host side).
+
+    Fresh zeros each round: np.zeros is a calloc, so untouched rows cost no
+    page writes; only rows with scheduled work are filled (object docs by the
+    per-doc encoder, frame docs by the one-call native scheduler).  Duck-typed
+    to what kernel.encoded_arrays_of consumes."""
+
+    __slots__ = ("ins_ref", "ins_op", "ins_char", "del_target", "marks",
+                 "mark_count", "num_ops")
+
+    def __init__(self, d: int, ki: int, kd: int, km: int) -> None:
+        self.ins_ref = np.zeros((d, ki), np.int32)
+        self.ins_op = np.zeros((d, ki), np.int32)
+        self.ins_char = np.zeros((d, ki), np.int32)
+        self.del_target = np.zeros((d, kd), np.int32)
+        self.marks = {col: np.zeros((d, km), np.int32) for col in MARK_COLS}
+        self.mark_count = np.zeros(d, np.int32)
+        self.num_ops = np.zeros(d, np.int32)
 
 
 class StreamingMerge:
@@ -127,6 +150,16 @@ class StreamingMerge:
         # per-round cache of numpy-resolved doc blocks: (rounds, {bi: resolved})
         self._resolved_cache = (-1, {})
         self._actor_table = OrderedActorTable(self.actors)
+        # frame-native session state (bulk path, ops/frames.parse_frames_bulk):
+        # parsed-but-unscheduled changes pool as (doc_of_change, ParsedChanges)
+        # chunks; per-doc applied frontiers as one (D, A) clock matrix; attr
+        # interning shared across frame docs (ids are per-session, append-only).
+        self._pool: List = []
+        self._frame_mode = np.zeros(num_docs, bool)
+        self._clock_mat = np.zeros((num_docs, len(self._actor_table)), np.int32)
+        self._frame_attrs = Interner()
+        # object-path docs with pending changes (so step() never scans all D)
+        self._object_pending: set = set()
         state = empty_docs(self._padded_docs, slot_capacity, mark_capacity, tomb_capacity)
         self.state: PackedDocs = shard_docs(state, mesh) if mesh is not None else state
 
@@ -141,55 +174,140 @@ class StreamingMerge:
             # arrivals through the same (cheap) frame parse
             self.ingest_frame(doc_index, encode_frame(changes))
             return
-        sess.pending.extend(changes)
+        if changes:
+            sess.pending.extend(changes)
+            self._object_pending.add(doc_index)
 
     def ingest_frame(self, doc_index: int, data: bytes) -> None:
         """Queue one binary change frame (the wire format a peer host ships,
-        parallel/codec.py) for one document — the native fast path: the C++
-        core parses the payload straight into flat arrays and no Python
-        ``Change`` objects are built unless the doc leaves the fast path.
-        Raises ValueError on corrupt frames (nothing is queued)."""
-        sess = self.docs[doc_index]
-        object_bound = sess.fallback or sess.encoder is not None or bool(
-            sess.pending or sess.log
-        )
-        if (not sess.frame_mode and object_bound) or not native.available():
-            self.ingest(doc_index, decode_frame(data))
-            return
-        if not sess.frame_mode:
-            sess.frame_mode = True
-            sess.attrs = Interner()
-            sess.parsed = ParsedChanges.empty()
-            sess.clock_arr = np.zeros(len(self._actor_table), np.int32)
-        try:
-            parsed, sess.text_obj = parse_frame(
-                data, self._actor_table, sess.attrs, sess.text_obj
-            )
-        except FrameIngestError:
-            self._demote_frame_doc(sess, extra=decode_frame(data))
-            return
-        sess.frames.append(data)
-        sess.parsed = sess.parsed.concat(parsed)
+        parallel/codec.py) for one document.  Raises ValueError on corrupt
+        frames (nothing is queued).  This is the single-frame convenience
+        form of :meth:`ingest_frames` — a host draining a DCN receive queue
+        should hand the whole batch over at once."""
+        self.ingest_frames([(doc_index, data)])
 
-    def _demote_frame_doc(self, sess: _DocSession, extra: List[Change] = ()) -> None:
+    def ingest_frames(self, items: Iterable) -> None:
+        """Bulk-queue binary change frames, many docs per call — the native
+        fast path at pod scale: ONE C++ call parses every frame (header,
+        string tables, varint payload, packed identifiers) straight into flat
+        arrays; no per-frame Python, no ``Change`` objects unless a doc
+        leaves the fast path.
+
+        ``items`` is an iterable of ``(doc_index, frame_bytes)``.  Frames are
+        processed in order; corrupt frames contribute nothing and raise one
+        ValueError (naming the affected docs) after all parseable frames have
+        been queued."""
+        items = list(items)
+        fast: List = []
+        corrupt: List[int] = []
+        use_native = native.available()
+        for doc_index, data in items:
+            sess = self.docs[doc_index]
+            object_bound = sess.fallback or sess.encoder is not None or bool(
+                sess.pending or sess.log
+            )
+            if (not sess.frame_mode and object_bound) or not use_native:
+                try:
+                    self.ingest(doc_index, decode_frame(data))
+                except ValueError:
+                    corrupt.append(doc_index)
+            else:
+                fast.append((doc_index, data))
+        if fast:
+            corrupt.extend(self._ingest_frames_native(fast))
+        if corrupt:
+            raise ValueError(f"corrupt frame(s) for doc(s) {sorted(set(corrupt))}")
+
+    def _ingest_frames_native(self, items: List) -> List[int]:
+        """Bulk-parse eligible frames; returns doc indices of corrupt frames."""
+        doc_ids = np.asarray([d for d, _ in items], np.int64)
+        frames = [data for _, data in items]
+        frame_off = np.concatenate(
+            [[0], np.cumsum([len(f) for f in frames], dtype=np.int64)]
+        ).astype(np.int64)
+        text_objs: Dict[int, int] = {}
+        for d in doc_ids:
+            d = int(d)
+            sess = self.docs[d]
+            if not sess.frame_mode:
+                sess.frame_mode = True
+                self._frame_mode[d] = True
+            text_objs.setdefault(d, sess.text_obj)
+
+        out = parse_frames_bulk(
+            b"".join(frames), frame_off, self._actor_table,
+            self._frame_attrs, doc_ids, text_objs,
+        )
+        if out is None:  # pragma: no cover - native.available() checked
+            corrupt = []
+            for (d, data) in items:
+                try:
+                    self.ingest(int(d), decode_frame(data))
+                except ValueError:
+                    corrupt.append(int(d))
+            return corrupt
+        parsed, f_ch_off, status = out
+
+        # Per-frame bookkeeping in arrival order: a demotion mid-call routes
+        # the same doc's later frames to the object path (its pooled changes
+        # are dropped at gather time; the frame history replay covers them).
+        corrupt: List[int] = []
+        keep_frame = np.zeros(len(items), bool)
+        for f, (d, data) in enumerate(items):
+            d = int(d)
+            sess = self.docs[d]
+            if not sess.frame_mode:  # demoted earlier in this call
+                try:
+                    self.ingest(d, decode_frame(data))
+                except ValueError:
+                    corrupt.append(d)
+                continue
+            if status[f] == FRAME_CORRUPT:
+                corrupt.append(d)
+            elif status[f] == FRAME_DEMOTE:
+                try:
+                    extra = decode_frame(data)
+                except ValueError:
+                    # natively parseable but not object-decodable: corrupt
+                    # semantics — contribute nothing, keep the doc's state
+                    corrupt.append(d)
+                    continue
+                self._demote_frame_doc(d, extra=extra)
+            else:
+                sess.frames.append(data)
+                sess.text_obj = text_objs[d]
+                keep_frame[f] = True
+
+        if keep_frame.all() and parsed.num_changes:
+            self._pool.append(
+                (np.repeat(doc_ids, np.diff(f_ch_off).astype(np.int64)), parsed)
+            )
+        elif parsed.num_changes:
+            doc_of = np.repeat(doc_ids, np.diff(f_ch_off).astype(np.int64))
+            sel = np.nonzero(np.repeat(keep_frame, np.diff(f_ch_off)))[0]
+            if len(sel):
+                self._pool.append((doc_of[sel], parsed.select(sel)))
+        return corrupt
+
+    def _demote_frame_doc(self, doc_index: int, extra: List[Change] = ()) -> None:
         """Leave the fast path: the doc becomes a scalar-replay fallback fed
         by its decoded frame history (its device rows may already hold applied
         ops, so only the oracle path is still correct for it)."""
+        sess = self.docs[doc_index]
         changes = [ch for f in sess.frames for ch in decode_frame(f)]
         changes.extend(extra)
         sess.log.extend(changes)
-        if sess.clock_arr is not None:
-            # fold the applied frontier into the object-path clock so
-            # frontier() stays truthful across the demotion
-            for idx in np.nonzero(sess.clock_arr)[0]:
-                actor = self._actor_table.lookup(int(idx))
-                sess.clock[actor] = max(sess.clock.get(actor, 0), int(sess.clock_arr[idx]))
+        # fold the applied frontier into the object-path clock so frontier()
+        # stays truthful across the demotion
+        row = self._clock_mat[doc_index]
+        for idx in np.nonzero(row)[0]:
+            actor = self._actor_table.lookup(int(idx))
+            sess.clock[actor] = max(sess.clock.get(actor, 0), int(row[idx]))
+        self._clock_mat[doc_index] = 0
         sess.frame_mode = False
+        self._frame_mode[doc_index] = False
         sess.frames = []
-        sess.parsed = None
-        sess.clock_arr = None
         sess.text_obj = 0
-        sess.attrs = None
         sess.fallback = True
         GLOBAL_COUNTERS.add("streaming.fallback_docs")
 
@@ -203,82 +321,81 @@ class StreamingMerge:
         schedule the next round while the TPU runs this one.
         """
         ki, kd, km = self.round_caps
-        per_doc: List[_DocStreams] = []
-        fallback_rows: List[int] = []
         scheduled = 0
 
-        for i, sess in enumerate(self.docs):
-            streams = _DocStreams()
-            if sess.frame_mode:
-                per_doc.append(streams)
-                continue  # scheduled in the frame-native pass below
-            if sess.pending and not sess.fallback:
-                if sess.encoder is None:
-                    sess.encoder = DocEncoder(self.actors)
-                ordered, stuck = causal_schedule(sess.pending, sess.clock)
-                # budget the round to the static stream widths: admit a
-                # prefix whose stream usage fits; the rest waits (shapes stay
-                # constant, docs just take extra rounds)
-                admitted, deferred = self._budget(ordered, ki, kd, km)
-                if not admitted and ordered and self._never_fits(ordered[0], ki, kd, km):
-                    # a single change larger than a round width can never be
-                    # admitted: demote instead of wedging the doc (and every
-                    # change behind it) forever — the frame path's batched
-                    # scheduler does the same via its demote status
-                    sess.fallback = True
-                    GLOBAL_COUNTERS.add("streaming.fallback_docs")
-                streams, ok = sess.encoder.encode_increment(admitted)
-                if not ok:
-                    sess.fallback = True
-                    streams = _DocStreams()
-                    GLOBAL_COUNTERS.add("streaming.fallback_docs")
-                else:
-                    for ch in admitted:
-                        sess.clock[ch.actor] = ch.seq
-                    scheduled += len(admitted)
-                sess.log.extend(admitted)
-                sess.pending = deferred + stuck
-                if sess.fallback:
-                    # keep full history for scalar replay; nothing on device
-                    sess.log.extend(deferred + stuck)
-                    sess.pending = []
-            elif sess.pending and sess.fallback:
+        # ---- object-path docs (editor-style sessions): per-doc encode ----
+        obj_streams: Dict[int, _DocStreams] = {}
+        for i in sorted(self._object_pending):
+            sess = self.docs[i]
+            if sess.fallback:
                 sess.log.extend(sess.pending)
                 sess.pending = []
+                self._object_pending.discard(i)
+                continue
+            if sess.encoder is None:
+                sess.encoder = DocEncoder(self.actors)
+            ordered, stuck = causal_schedule(sess.pending, sess.clock)
+            # budget the round to the static stream widths: admit a prefix
+            # whose stream usage fits; the rest waits (shapes stay constant,
+            # docs just take extra rounds)
+            admitted, deferred = self._budget(ordered, ki, kd, km)
+            if not admitted and ordered and self._never_fits(ordered[0], ki, kd, km):
+                # a single change larger than a round width can never be
+                # admitted: demote instead of wedging the doc (and every
+                # change behind it) forever — the frame path's batched
+                # scheduler does the same via its demote status
+                sess.fallback = True
+                GLOBAL_COUNTERS.add("streaming.fallback_docs")
+            streams, ok = sess.encoder.encode_increment(admitted)
+            if not ok:
+                sess.fallback = True
+                GLOBAL_COUNTERS.add("streaming.fallback_docs")
+            else:
+                for ch in admitted:
+                    sess.clock[ch.actor] = ch.seq
+                scheduled += len(admitted)
+                if streams.ins or streams.dels or streams.marks:
+                    obj_streams[i] = streams
+            sess.log.extend(admitted)
+            sess.pending = deferred + stuck
             if sess.fallback:
-                fallback_rows.append(i)
-            per_doc.append(streams)
+                # keep full history for scalar replay; nothing on device
+                sess.log.extend(sess.pending)
+                sess.pending = []
+            if not sess.pending:
+                self._object_pending.discard(i)
 
-        frame_docs = [
-            i for i, s in enumerate(self.docs)
-            if s.frame_mode and s.parsed is not None and s.parsed.num_changes
-        ]
-        if scheduled == 0 and not frame_docs:
+        pool = self._gather_pool()
+        if scheduled == 0 and pool is None:
             return 0
 
-        pad_rows = self._padded_docs - self.num_docs
-        encoded = pad_doc_streams(
-            per_doc + [_DocStreams()] * pad_rows,
-            list(fallback_rows),
-            [s.encoder.actors if s.encoder else None for s in self.docs]
-            + [None] * pad_rows,
-            [s.encoder.attrs if s.encoder else None for s in self.docs]
-            + [None] * pad_rows,
-            insert_capacity=ki,
-            delete_capacity=kd,
-            mark_capacity=km,
-        )
+        enc = _RoundBuffers(self._padded_docs, ki, kd, km)
+        for i, streams in obj_streams.items():
+            if streams.ins:
+                arr = np.asarray(streams.ins, np.int32)
+                enc.ins_ref[i, : len(arr)] = arr[:, 0]
+                enc.ins_op[i, : len(arr)] = arr[:, 1]
+                enc.ins_char[i, : len(arr)] = arr[:, 2]
+            if streams.dels:
+                enc.del_target[i, : len(streams.dels)] = streams.dels
+            if streams.marks:
+                arr = np.asarray(streams.marks, np.int32)
+                for c, col in enumerate(MARK_COLS):
+                    enc.marks[col][i, : len(arr)] = arr[:, c]
+                enc.mark_count[i] = len(arr)
+            enc.num_ops[i] = (
+                len(streams.ins) + len(streams.dels) + len(streams.marks)
+            )
 
-        # Frame-native pass: schedule + split every frame-mode doc's parsed
-        # arrays directly into the padded rows.  With the native core this is
-        # ONE C++ call for all docs per round (pt_schedule_split_batch); the
-        # per-doc Python version is the no-native fallback.
-        if frame_docs:
-            scheduled += self._step_frame_docs(frame_docs, encoded, (ki, kd, km))
+        # Frame-native pass: ONE C++ call schedules + splits every frame-mode
+        # doc's pooled parsed changes into its padded row (the per-doc Python
+        # version is the no-native fallback).
+        if pool is not None:
+            scheduled += self._step_frame_docs(pool, enc, (ki, kd, km))
 
         if scheduled == 0:
             return 0
-        arrays = encoded_arrays_of(encoded)
+        arrays = encoded_arrays_of(enc)
         if self.mesh is not None:
             arrays = shard_docs(arrays, self.mesh)
         self.state = apply_batch_jit(self.state, arrays)
@@ -287,82 +404,125 @@ class StreamingMerge:
         GLOBAL_COUNTERS.add("streaming.scheduled_changes", scheduled)
         return scheduled
 
-    def _step_frame_docs(self, frame_docs, encoded, caps) -> int:
-        """Round-schedule all frame-mode docs into their padded rows."""
-        if not native.available():
-            return self._step_frame_docs_python(frame_docs, encoded, caps)
+    def _gather_pool(self):
+        """Merge pooled parsed-change chunks into one doc-grouped batch:
+        ``(doc_of_change, ParsedChanges)`` sorted by doc, demoted docs'
+        entries dropped (their frame-history replay covers those changes)."""
+        if not self._pool:
+            return None
+        chunks = self._pool
+        self._pool = []
+        doc_of = (
+            chunks[0][0] if len(chunks) == 1
+            else np.concatenate([d for d, _ in chunks])
+        )
+        parsed = ParsedChanges.concat_many([p for _, p in chunks])
+        keep = self._frame_mode[doc_of]
+        if not keep.all():
+            idx = np.nonzero(keep)[0]
+            if not len(idx):
+                return None
+            doc_of, parsed = doc_of[idx], parsed.select(idx)
+        if np.any(doc_of[:-1] > doc_of[1:]):
+            order = np.argsort(doc_of, kind="stable")
+            doc_of, parsed = doc_of[order], parsed.select(order)
+        return doc_of, parsed
 
-        merged = ParsedChanges.concat_many([self.docs[i].parsed for i in frame_docs])
+    def _step_frame_docs(self, pool, enc, caps) -> int:
+        """Round-schedule every frame-mode doc's pooled changes into its
+        padded row; deferred changes go back to the pool as one chunk."""
+        doc_of, parsed = pool
+        if not native.available():
+            return self._step_frame_docs_python(pool, enc, caps)
+
+        frame_docs = np.unique(doc_of)
         ch_off = np.concatenate(
-            [[0], np.cumsum([self.docs[i].parsed.num_changes for i in frame_docs])]
+            [np.searchsorted(doc_of, frame_docs), [len(doc_of)]]
         ).astype(np.int32)
-        # (F, n_actors) clock matrix: mutated in place by the native call
-        clock = np.ascontiguousarray(
-            np.stack([self.docs[i].clock_arr for i in frame_docs]), np.int32
+        # gather the scheduled docs' clock rows; scatter back after the call
+        clock = np.ascontiguousarray(self._clock_mat[frame_docs], np.int32)
+        text_obj = np.asarray(
+            [self.docs[int(i)].text_obj for i in frame_docs], np.int32
         )
         batch = native.schedule_split_batch(
             len(self._actor_table),
             ch_off,
-            np.asarray(frame_docs, np.int32),
-            np.asarray([self.docs[i].text_obj for i in frame_docs], np.int32),
-            (merged.ch_actor, merged.ch_seq, merged.dep_off,
-             merged.dep_actor, merged.dep_seq, merged.ops_off, merged.ops),
+            frame_docs.astype(np.int32),
+            text_obj,
+            (parsed.ch_actor, parsed.ch_seq, parsed.dep_off,
+             parsed.dep_actor, parsed.dep_seq, parsed.ops_off, parsed.ops),
             clock,
             caps,
-            (encoded.ins_ref, encoded.ins_op, encoded.ins_char),
-            encoded.del_target,
-            encoded.marks,
+            (enc.ins_ref, enc.ins_op, enc.ins_char),
+            enc.del_target,
+            enc.marks,
         )
         if batch is None:  # pragma: no cover - available() checked above
-            return self._step_frame_docs_python(frame_docs, encoded, caps)
+            return self._step_frame_docs_python(pool, enc, caps)
 
         _, n_ins, n_del, n_mark, n_admitted, admitted, status = batch
-        scheduled = 0
-        for j, i in enumerate(frame_docs):
-            sess = self.docs[i]
-            flags = admitted[ch_off[j] : ch_off[j + 1]]
-            if status[j]:
-                self._demote_frame_doc(sess)  # rows already zeroed natively
-                continue
-            sess.clock_arr = clock[j].copy()
-            if flags.all():  # common case: everything admitted or consumed
-                sess.parsed = ParsedChanges.empty()
-            else:
-                sess.parsed = sess.parsed.select(np.nonzero(flags == 0)[0])
-            encoded.mark_count[i] = int(n_mark[j])
-            encoded.num_ops[i] = int(n_ins[j] + n_del[j] + n_mark[j])
-            scheduled += int(n_admitted[j])
+        self._clock_mat[frame_docs] = clock
+        enc.mark_count[frame_docs] = n_mark
+        enc.num_ops[frame_docs] = n_ins + n_del + n_mark
+        scheduled = int(n_admitted.sum())
+
+        demoted_docs = frame_docs[status != 0] if status.any() else None
+        if demoted_docs is not None:
+            for i in demoted_docs:  # rare: demote (rows zeroed natively)
+                i = int(i)
+                enc.mark_count[i] = 0
+                enc.num_ops[i] = 0
+                self._demote_frame_doc(i)  # folds + zeroes the doc's clock row
+
+        defer = admitted == 0
+        if demoted_docs is not None:
+            defer &= ~np.isin(doc_of, demoted_docs)
+        if defer.any():
+            idx = np.nonzero(defer)[0]
+            self._pool.append((doc_of[idx], parsed.select(idx)))
         return scheduled
 
-    def _step_frame_docs_python(self, frame_docs, encoded, caps) -> int:
+    def _step_frame_docs_python(self, pool, enc, caps) -> int:
         """Per-doc Python fallback (no native library)."""
+        doc_of, parsed = pool
         ki, kd, km = caps
         scheduled = 0
-        for i in frame_docs:
+        frame_docs = np.unique(doc_of)
+        bounds = np.concatenate(
+            [np.searchsorted(doc_of, frame_docs), [len(doc_of)]]
+        )
+        for j, i in enumerate(frame_docs):
+            i = int(i)
             sess = self.docs[i]
+            doc_parsed = parsed.select(
+                np.arange(bounds[j], bounds[j + 1], dtype=np.int64)
+            )
             try:
                 nch, (ni, nd, nm), deferred = schedule_split(
-                    sess.parsed,
-                    sess.clock_arr,
+                    doc_parsed,
+                    self._clock_mat[i],  # row view: advanced in place
                     sess.text_obj,
                     (ki, kd, km),
-                    (encoded.ins_ref[i], encoded.ins_op[i], encoded.ins_char[i]),
-                    encoded.del_target[i],
-                    {col: encoded.marks[col][i] for col in encoded.marks},
+                    (enc.ins_ref[i], enc.ins_op[i], enc.ins_char[i]),
+                    enc.del_target[i],
+                    {col: enc.marks[col][i] for col in enc.marks},
                     len(self._actor_table),
                 )
             except FrameIngestError:
-                for col in encoded.marks:  # discard any partial row writes
-                    encoded.marks[col][i] = 0
-                encoded.ins_ref[i] = 0
-                encoded.ins_op[i] = 0
-                encoded.ins_char[i] = 0
-                encoded.del_target[i] = 0
-                self._demote_frame_doc(sess)
+                for col in enc.marks:  # discard any partial row writes
+                    enc.marks[col][i] = 0
+                enc.ins_ref[i] = 0
+                enc.ins_op[i] = 0
+                enc.ins_char[i] = 0
+                enc.del_target[i] = 0
+                self._demote_frame_doc(i)
                 continue
-            sess.parsed = deferred
-            encoded.mark_count[i] = nm
-            encoded.num_ops[i] = ni + nd + nm
+            if deferred.num_changes:
+                self._pool.append(
+                    (np.full(deferred.num_changes, i, np.int64), deferred)
+                )
+            enc.mark_count[i] = nm
+            enc.num_ops[i] = ni + nd + nm
             scheduled += nch
         return scheduled
 
@@ -411,10 +571,9 @@ class StreamingMerge:
             return [ch for f in sess.frames for ch in decode_frame(f)]
         return sess.log + sess.pending
 
-    @staticmethod
-    def _attr_table(sess: _DocSession):
+    def _attr_table(self, sess: _DocSession):
         if sess.frame_mode:
-            return sess.attrs
+            return self._frame_attrs
         return sess.encoder.attrs if sess.encoder else None
 
     # -- block-cached resolution ------------------------------------------
@@ -626,14 +785,13 @@ class StreamingMerge:
     def frontier(self) -> Clock:
         """Merged vector-clock frontier across all docs (host-side metadata)."""
         merged: Clock = {}
+        if self._clock_mat.size:
+            col_max = self._clock_mat.max(axis=0)  # frame docs, vectorized
+            for idx in np.nonzero(col_max)[0]:
+                merged[self._actor_table.lookup(int(idx))] = int(col_max[idx])
         for sess in self.docs:
-            if sess.frame_mode:
-                for idx in np.nonzero(sess.clock_arr)[0]:
-                    actor = self._actor_table.lookup(int(idx))
-                    merged[actor] = max(merged.get(actor, 0), int(sess.clock_arr[idx]))
-            else:
-                for actor, seq in sess.clock.items():
-                    merged[actor] = max(merged.get(actor, 0), seq)
+            for actor, seq in sess.clock.items():
+                merged[actor] = max(merged.get(actor, 0), seq)
         return merged
 
     def overflow_count(self) -> int:
@@ -650,10 +808,8 @@ class StreamingMerge:
         )
 
     def pending_count(self) -> int:
-        return sum(
-            (s.parsed.num_changes if s.frame_mode and s.parsed is not None else len(s.pending))
-            for s in self.docs
-        )
+        pooled = sum(int(self._frame_mode[d].sum()) for d, _ in self._pool)
+        return pooled + sum(len(s.pending) for s in self.docs)
 
 
 def _replay_doc(changes: List[Change]) -> Doc:

@@ -282,3 +282,76 @@ def test_out_of_range_codepoint_rejected_at_ingest(workloads):
     patched = hdr.pack(magic, ver, nc, ns, ni, pl + 2) + patched[hdr.size:]
     with pytest.raises(ValueError, match="codepoint"):
         parse_frame(patched, OrderedActorTable(["doc1"]), Interner(), 0)
+
+
+# -- bulk-ingest edge cases (parse_frames_bulk contracts) -------------------
+
+
+def _craft_frame(strings, ints, n_changes):
+    """Hand-build a wire frame (codec layout) from a raw int payload."""
+    from peritext_tpu.parallel.codec import _HEADER, _MAGIC, _py_varint_encode
+
+    payload = _py_varint_encode(ints)
+    parts = [_HEADER.pack(_MAGIC, 1, n_changes, len(strings), len(ints), len(payload))]
+    for s in strings:
+        raw = s if isinstance(s, bytes) else s.encode("utf-8")
+        parts.append(_py_varint_encode([len(raw)]))
+        parts.append(raw)
+    parts.append(payload)
+    return b"".join(parts)
+
+
+@pytest.mark.skipif(not native.available(), reason="needs native core")
+def test_bulk_demote_frame_undecodable_is_corrupt_not_lossy():
+    """A frame that parses natively (byte-compared actors) but cannot be
+    object-decoded (invalid UTF-8 actor) must report corrupt without
+    aborting the bulk call — other docs' frames stay queued."""
+    docs, _, origin = generate_docs()
+    good = encode_frame([origin])
+    # actor string "zz" -> invalid UTF-8 bytes: undeclared actor (demote path)
+    # whose decode_frame fallback raises ValueError
+    bad_actor = _craft_frame(
+        [b"\xff\xfe"],
+        [0, 1, 1, 0, 1, 0, 1, 1, 0, 2, 0, 0, 0, 0, ord("x")],
+        1,
+    )
+    s = _session()
+    with pytest.raises(ValueError):
+        s.ingest_frames([(1, good), (0, bad_actor)])
+    # doc 0 contributed nothing; doc 1's frame is fully queued
+    assert s.docs[0].frames == [] and not s.docs[0].fallback
+    s.drain()
+    assert "".join(sp["text"] for sp in s.read(1)) == "The Peritext editor"
+
+
+@pytest.mark.skipif(not native.available(), reason="needs native core")
+def test_bulk_corrupt_frame_does_not_adopt_makelist():
+    """A corrupt frame's makeList must not leak into session text_obj state
+    (same-wire-input convergence must not depend on call batching)."""
+    import json as _json
+
+    # a makeList whose opid differs from the legitimate doc history's, so a
+    # leak is distinguishable from follow's own (valid) makeList adoption
+    make_list = _json.dumps(
+        {"action": "makeList", "obj": "_root", "key": "text", "opId": "5@doc2"}
+    )
+    # change: [actor=0 seq=1 startOp=1 ndeps=0 nops=2,
+    #          JSON makeList, insert with out-of-range codepoint]
+    corrupt = _craft_frame(
+        ["doc1", make_list],
+        [0, 1, 1, 0, 2, 4, 1, 0, 1, 1, 0, 2, 0, 0, 0, 0, 0x110000],
+        1,
+    )
+    docs, _, origin = generate_docs()
+    follow = encode_frame([origin])  # valid ops (incl. makeList 1@doc1)
+    s = _session()
+    with pytest.raises(ValueError):
+        s.ingest_frames([(0, corrupt), (0, follow)])
+    # the corrupt frame contributed nothing: follow's own makeList governs,
+    # the doc stays on the fast path, and its content reads back intact
+    from peritext_tpu.ops.packed import pack_id
+
+    assert s.docs[0].text_obj == pack_id(1, 1)
+    assert s.docs[0].frames == [follow] and not s.docs[0].fallback
+    s.drain()
+    assert "".join(sp["text"] for sp in s.read(0)) == "The Peritext editor"
