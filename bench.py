@@ -205,22 +205,34 @@ def run_streaming(args) -> dict:
                 if r < len(batches)
             )
 
+    def run_session():
+        stages = {"ingest": 0.0, "schedule_apply": 0.0, "digest": 0.0}
+        t_all = time.perf_counter()
+        s = session()
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            feed_round(s, r)
+            t1 = time.perf_counter()
+            s.drain()
+            t2 = time.perf_counter()
+            stages["ingest"] += t1 - t0
+            stages["schedule_apply"] += t2 - t1
+        t0 = time.perf_counter()
+        digest = s.digest()  # sync point: absorbs all queued device work
+        stages["digest"] += time.perf_counter() - t0
+        return time.perf_counter() - t_all, digest, stages, s
+
     # warmup compile
-    s = session()
-    for r in range(rounds):
-        feed_round(s, r)
-        s.drain()
-    digest0 = s.digest()
+    _, digest0, _, s = run_session()
     fallbacks = sum(1 for sess in s.docs if sess.fallback)
 
-    t0 = time.perf_counter()
-    s = session()
-    for r in range(rounds):
-        feed_round(s, r)
-        s.drain()
-    digest = s.digest()  # sync point
-    elapsed = time.perf_counter() - t0
-    assert digest == digest0
+    # tunnel dispatch latency is noisy: best of 3 timed sessions
+    elapsed, stages = None, None
+    for _ in range(3):
+        t, digest, st, _ = run_session()
+        assert digest == digest0
+        if elapsed is None or t < elapsed:
+            elapsed, stages = t, st
 
     total_ops = sum(
         len(ch.ops) for w in workloads for log in w.values() for ch in log
@@ -240,6 +252,7 @@ def run_streaming(args) -> dict:
         "fallback_docs": fallbacks,
         "workload_gen_seconds": round(gen_time, 1),
         "wall_seconds": round(elapsed, 3),
+        "stage_seconds": {k: round(v, 3) for k, v in stages.items()},
         "platform": jax.devices()[0].platform,
     }
 

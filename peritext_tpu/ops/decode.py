@@ -37,7 +37,12 @@ class DocMarkDecoder:
         self.chars = np.asarray(resolved.char[d])
         self._lww = np.asarray(resolved.lww_active[d])
         self._link_attr = np.asarray(resolved.link_attr[d])
-        self._comments = np.asarray(resolved.comment_active[d])
+        # unpack the (W, S) uint32 comment bitmask to a (W*32, S) bool plane
+        bits = np.asarray(resolved.comment_bits[d])
+        shifts = np.arange(32, dtype=np.uint32)
+        self._comments = (
+            (bits[:, None, :] >> shifts[None, :, None]) & 1
+        ).astype(bool).reshape(-1, bits.shape[-1])
 
     def marks_at(self, slot: int) -> dict:
         marks: dict = {}

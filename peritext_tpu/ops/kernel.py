@@ -193,6 +193,73 @@ def apply_batch(
     )
 
 
+def _pad_from_flat(flat, counts, width: int):
+    """(N,) flat per-doc-concatenated values + (D,) counts -> (D, width)
+    zero-padded rows, reconstructed on device with ONE gather (host->device
+    transfer is proportional to real ops, not padded capacity)."""
+    counts = counts.astype(jnp.int32)
+    if flat.shape[0] == 0:  # a round with zero ops of this kind
+        return jnp.zeros((counts.shape[0], width), jnp.int32)
+    offsets = jnp.cumsum(counts) - counts
+    idx = offsets[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+    mask = jnp.arange(width, dtype=jnp.int32)[None, :] < counts[:, None]
+    safe = jnp.clip(idx, 0, int(flat.shape[0]) - 1)
+    return jnp.where(mask, flat[safe], 0)
+
+
+def apply_batch_compact(
+    state: PackedDocs,
+    stream_counts,  # (n_ins, n_del, n_mark) each (D,) int32
+    ins_flat,  # (ref, op, char) each (N_i,) int32
+    del_flat,  # (N_d,) int32
+    mark_flat,  # dict col -> (N_m,) int32 in MARK_COLS order
+    *,
+    widths,  # static (ki, kd, km) padded stream widths
+    insert_impl: str = "auto",
+    insert_loop_slots: int | None = None,
+) -> PackedDocs:
+    """apply_batch over compactly-transferred streams.
+
+    The padded (D, K) layout the kernel consumes is rebuilt on device from
+    flat arrays; with a slow host link (the padded rows are mostly zeros)
+    this cuts per-round transfer several-fold.  Flat arrays may carry
+    power-of-two padding at the END (zero rows beyond sum(counts) are never
+    gathered into a live slot)."""
+    n_ins, n_del, n_mark = stream_counts
+    ki, kd, km = widths
+    ins_ref = _pad_from_flat(ins_flat[0], n_ins, ki)
+    ins_op = _pad_from_flat(ins_flat[1], n_ins, ki)
+    ins_char = _pad_from_flat(ins_flat[2], n_ins, ki)
+    del_target = _pad_from_flat(del_flat, n_del, kd)
+    marks = {col: _pad_from_flat(mark_flat[col], n_mark, km) for col in mark_flat}
+    return apply_batch(
+        state,
+        (ins_ref, ins_op, ins_char, del_target, marks, n_mark.astype(jnp.int32)),
+        insert_impl=insert_impl,
+        insert_loop_slots=insert_loop_slots,
+    )
+
+
+_apply_batch_compact_jit = jax.jit(
+    apply_batch_compact,
+    static_argnames=("widths", "insert_impl", "insert_loop_slots"),
+)
+
+
+def apply_batch_compact_jit(state, stream_counts, ins_flat, del_flat, mark_flat,
+                            *, widths, insert_impl: str = "auto",
+                            insert_loop_slots: int | None = None) -> PackedDocs:
+    """jit-compiled :func:`apply_batch_compact` (``"auto"`` resolved at the
+    boundary, as in :func:`apply_batch_jit`)."""
+    if insert_impl == "auto":
+        insert_impl = resolve_insert_impl(state.elem_id)
+    return _apply_batch_compact_jit(
+        state, stream_counts, ins_flat, del_flat, mark_flat,
+        widths=widths, insert_impl=insert_impl,
+        insert_loop_slots=insert_loop_slots,
+    )
+
+
 def encoded_arrays_of(encoded: EncodedBatch):
     """The device-array tuple for apply_batch from a host EncodedBatch."""
     return (

@@ -21,6 +21,19 @@ a per-mark walk.  Padding chunk reads may overlap the previous chunk
 (dynamic_slice clamps); that is harmless because max/or updates are
 idempotent.
 
+Comments are the expensive plane: per interned comment id a winner val per
+slot is needed, i.e. a (C, S) plane per document.  Three measures keep that
+off the critical path: (a) the "val trick" — the carried state per key is the
+single uint32 ``(op_id << 1) | is_add`` maximum, whose low bit is the
+add/remove verdict, halving both carries and reductions; (b) the per-chunk
+per-id reduction is a dense (J, C, S) masked max that XLA fuses into plain
+reductions (measured faster on TPU than a segment_max scatter, which
+serializes); (c) resolution is compiled with a static ``with_comments``
+flag, and the paths that never read comment state (convergence digests,
+cursor resolution, overflow counting) compile with it off, so the comment
+work vanishes from those programs entirely.  The output plane is bit-packed
+(``comment_bits``), shrinking the device->host read transfer 32x.
+
 Visibility is also computed here: a slot is visible iff occupied and its
 element id is absent from the tombstone table (one vectorized any-match).
 """
@@ -46,7 +59,10 @@ from .packed import (
 NUM_TYPES = len(ALL_MARKS)
 COMMENT_TYPE = MARK_INDEX["comment"]
 LINK_TYPE = MARK_INDEX["link"]
-MARK_CHUNK = 32
+#: chunk width of the mark-table loop: wide enough that common tables (<= 128
+#: rows) resolve in a single carry-free pass; long-doc tables loop with
+#: (C, S) carries only between chunks.
+MARK_CHUNK = 128
 
 
 class ResolvedDocs(NamedTuple):
@@ -58,34 +74,45 @@ class ResolvedDocs(NamedTuple):
     lww_active: jnp.ndarray
     #: (D, S): interned url of the winning link op (0 = none)
     link_attr: jnp.ndarray
-    #: (D, C, S): per interned comment id, winning op is an addMark
-    comment_active: jnp.ndarray
+    #: (D, W, S) uint32 bitmask: bit ``c % 32`` of word ``c // 32`` set iff
+    #: interned comment id ``c``'s winning op is an addMark (W = ceil(C/32);
+    #: packed so the host transfer is 32x smaller than a bool plane)
+    comment_bits: jnp.ndarray
     overflow: jnp.ndarray  # bool (D,)
 
 
-def resolve_single(state: PackedDocs, comment_capacity: int) -> ResolvedDocs:
-    """Resolve one document (unbatched arrays)."""
+def resolve_single(
+    state: PackedDocs, comment_capacity: int, with_comments: bool = True
+) -> ResolvedDocs:
+    """Resolve one document (unbatched arrays).
+
+    ``with_comments=False`` compiles the comment planes away entirely (the
+    returned ``comment_bits`` has zero words); the comment-attr overflow
+    *check* still runs so ``overflow`` semantics are identical."""
     s_cap = state.elem_id.shape[0]
     m_cap = state.m_action.shape[0]
     pos = jnp.arange(s_cap, dtype=jnp.int32)
     n = state.num_slots
     big = jnp.int32(2 * s_cap + 1)
     gap_before = 2 * pos  # the gap governing each slot's character
+    c_cap = comment_capacity if with_comments else 0
+    c_words = -(-c_cap // 32) if with_comments else 0
 
+    # The "val trick": winner state per (key, slot) is the single uint32
+    # ``(op_id << 1) | is_add`` maximum over covering rows — op ids are unique
+    # (re-delivered duplicate rows tie with identical action), so the winner's
+    # low bit IS the add/remove verdict.  One max instead of separate
+    # add-maximum and remove-maximum: half the carries, half the reductions.
     class Carry(NamedTuple):
-        add_op: jnp.ndarray  # (T, S) max covering add-op id per LWW type
-        rem_op: jnp.ndarray  # (T, S) max covering remove-op id
-        link_attr: jnp.ndarray  # (S,) attr of the current best link add op
-        c_add_op: jnp.ndarray  # (C, S) per interned comment id
-        c_rem_op: jnp.ndarray  # (C, S)
+        lww_val: jnp.ndarray  # (T, S) uint32 max (op<<1|is_add) per LWW type
+        link_attr: jnp.ndarray  # (S,) attr of the current link winner
+        c_val: jnp.ndarray  # (C, S) uint32 per interned comment id
         error: jnp.ndarray  # () bool
 
     carry = Carry(
-        add_op=jnp.zeros((NUM_TYPES, s_cap), jnp.int32),
-        rem_op=jnp.zeros((NUM_TYPES, s_cap), jnp.int32),
+        lww_val=jnp.zeros((NUM_TYPES, s_cap), jnp.uint32),
         link_attr=jnp.zeros((s_cap,), jnp.int32),
-        c_add_op=jnp.zeros((comment_capacity, s_cap), jnp.int32),
-        c_rem_op=jnp.zeros((comment_capacity, s_cap), jnp.int32),
+        c_val=jnp.zeros((c_cap, s_cap), jnp.uint32),
         error=jnp.asarray(False),
     )
 
@@ -127,57 +154,52 @@ def resolve_single(state: PackedDocs, comment_capacity: int) -> ResolvedDocs:
             & (gap_before[None, :] < e_gap[:, None])
             & (pos[None, :] < n)
         )  # (J, S)
-        add_mask = cover & (action == MA_ADD)[:, None]
-        rem_mask = cover & (action == MA_REMOVE)[:, None]
-        op_col = op[:, None]
+        val = (op.astype(jnp.uint32) << 1) | (action == MA_ADD)  # (J,)
+        val_col = val[:, None]
 
-        # LWW types: reduce the chunk to per-slot maxima, combine into carry.
-        add_rows, rem_rows = [], []
+        # LWW types: reduce the chunk to per-slot winner vals, combine.
+        val_rows = []
         link_attr = carry.link_attr
+        is_comment = mtype == COMMENT_TYPE
         for t in range(NUM_TYPES):
             if t == COMMENT_TYPE:
-                add_rows.append(carry.add_op[t])
-                rem_rows.append(carry.rem_op[t])
+                val_rows.append(carry.lww_val[t])
                 continue
-            tm = (mtype == t)[:, None]
-            chunk_add = jnp.max(jnp.where(add_mask & tm, op_col, 0), axis=0)  # (S,)
-            chunk_rem = jnp.max(jnp.where(rem_mask & tm, op_col, 0), axis=0)
+            sel = cover & (mtype == t)[:, None]  # (J, S)
+            chunk_val = jnp.max(jnp.where(sel, val_col, 0), axis=0)  # (S,)
             if t == LINK_TYPE:
-                # max, not sum: a re-delivered mark row may appear twice in
-                # the table (rows are appended without dedup), and both
-                # copies carry the same attr.
+                # attr of the chunk winner (max, not sum: duplicate rows tie
+                # with equal attrs); gated on add at the output, so a remove
+                # winner's attr is harmless.
                 chunk_attr = jnp.max(
-                    jnp.where(add_mask & tm & (op_col == chunk_add[None, :]),
+                    jnp.where(sel & (val_col == chunk_val[None, :]),
                               attr[:, None], 0),
                     axis=0,
                 )
                 link_attr = jnp.where(
-                    chunk_add > carry.add_op[t], chunk_attr, link_attr
+                    chunk_val > carry.lww_val[t], chunk_attr, link_attr
                 )
-            add_rows.append(jnp.maximum(carry.add_op[t], chunk_add))
-            rem_rows.append(jnp.maximum(carry.rem_op[t], chunk_rem))
+            val_rows.append(jnp.maximum(carry.lww_val[t], chunk_val))
 
-        # Comments: per interned comment id, one vectorized segment-max over
-        # the chunk axis — (J, C, S) masks reduce to (C, S) chunk maxima.
-        is_comment = mtype == COMMENT_TYPE
-        c_ids = jnp.arange(comment_capacity, dtype=jnp.int32)
-        row_sel = is_comment[:, None] & (attr[:, None] == c_ids[None, :])  # (J, C)
-        op3 = op[:, None, None]  # (J, 1, 1)
-        chunk_c_add = jnp.max(
-            jnp.where(row_sel[:, :, None] & add_mask[:, None, :], op3, 0), axis=0
-        )
-        chunk_c_rem = jnp.max(
-            jnp.where(row_sel[:, :, None] & rem_mask[:, None, :], op3, 0), axis=0
-        )
-        c_add_op = jnp.maximum(carry.c_add_op, chunk_c_add)
-        c_rem_op = jnp.maximum(carry.c_rem_op, chunk_c_rem)
+        # Comments: per interned comment id, a masked (J, C, S) winner-val
+        # max.  Dense beats a segment-max scatter on TPU (scatters serialize;
+        # the dense product fuses into plain reductions), and the val trick
+        # halves it to a single product.
+        if with_comments:
+            sel_c = (
+                attr[:, None] == jnp.arange(comment_capacity, dtype=jnp.int32)[None, :]
+            )  # (J, C)
+            data = jnp.where(cover & is_comment[:, None], val_col, 0)  # (J, S)
+            chunk_c = jnp.max(
+                jnp.where(sel_c[:, :, None], data[:, None, :], 0), axis=0
+            )  # (C, S)
+            c_val = jnp.maximum(carry.c_val, chunk_c)
+        else:
+            c_val = carry.c_val
 
         error = carry.error | jnp.any(live & ~(s_ok & e_ok))
         error = error | jnp.any(live & is_comment & (attr >= comment_capacity))
-        return Carry(
-            jnp.stack(add_rows), jnp.stack(rem_rows), link_attr,
-            c_add_op, c_rem_op, error,
-        )
+        return Carry(jnp.stack(val_rows), link_attr, c_val, error)
 
     num_chunks = -(-m_cap // chunk)
     out = lax.fori_loop(0, num_chunks, body, carry)
@@ -189,23 +211,37 @@ def resolve_single(state: PackedDocs, comment_capacity: int) -> ResolvedDocs:
     )
     visible = (pos < n) & ~tombed
 
-    lww_active = out.add_op > out.rem_op
+    lww_active = (out.lww_val & 1) == 1
+    if with_comments:
+        # pack per-id verdicts into uint32 words: (C, S) -> (W, S)
+        active = (out.c_val & 1).astype(jnp.uint32)  # (C, S)
+        padded = jnp.zeros((c_words * 32, s_cap), jnp.uint32).at[:c_cap].set(active)
+        weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[None, :, None]
+        comment_bits = jnp.sum(
+            padded.reshape(c_words, 32, s_cap) * weights, axis=1, dtype=jnp.uint32
+        )
+    else:
+        comment_bits = jnp.zeros((0, s_cap), jnp.uint32)
     return ResolvedDocs(
         char=state.char,
         visible=visible,
         lww_active=lww_active,
         link_attr=jnp.where(lww_active[LINK_TYPE], out.link_attr, 0),
-        comment_active=out.c_add_op > out.c_rem_op,
+        comment_bits=comment_bits,
         overflow=state.overflow | out.error,
     )
 
 
-def resolve(state: PackedDocs, comment_capacity: int = 32) -> ResolvedDocs:
+def resolve(
+    state: PackedDocs, comment_capacity: int = 32, with_comments: bool = True
+) -> ResolvedDocs:
     """Batched resolution over the doc axis."""
-    return jax.vmap(lambda s: resolve_single(s, comment_capacity))(state)
+    return jax.vmap(
+        lambda s: resolve_single(s, comment_capacity, with_comments)
+    )(state)
 
 
-resolve_jit = jax.jit(resolve, static_argnums=1)
+resolve_jit = jax.jit(resolve, static_argnums=(1, 2))
 
 
 def resolve_cursors(state: PackedDocs, visible, cursor_elem):

@@ -35,6 +35,7 @@ collaborative documents", per BASELINE.json config 5.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
@@ -59,13 +60,22 @@ from ..ops.frames import (
 )
 from ..ops.kernel import apply_batch_jit, encoded_arrays_of
 from ..ops.packed import PackedDocs, empty_docs
-from ..ops.resolve import resolve_jit
+from ..ops.resolve import resolve, resolve_jit
 from ..utils.interning import Interner, OrderedActorTable
 from .causal import causal_schedule
 from .codec import decode_frame, encode_frame
 from .mesh import convergence_digest, shard_docs
 
-_digest_jit = jax.jit(convergence_digest)
+@partial(jax.jit, static_argnums=1)
+def _resolve_digest_jit(state: PackedDocs, comment_capacity: int, row_mask):
+    """Fused span resolution + convergence digest in ONE program: resolution
+    runs with the comment planes compiled away (the digest never reads them —
+    resolve.py ``with_comments``), and only the scalar digest plus the
+    overflow vector ever reach the host."""
+    resolved = resolve(state, comment_capacity, with_comments=False)
+    mask = row_mask & ~resolved.overflow
+    visible = resolved.visible & mask[:, None]
+    return convergence_digest(resolved.char, visible), resolved.overflow
 
 
 @dataclass
@@ -93,7 +103,7 @@ class _RoundBuffers:
     to what kernel.encoded_arrays_of consumes."""
 
     __slots__ = ("ins_ref", "ins_op", "ins_char", "del_target", "marks",
-                 "mark_count", "num_ops")
+                 "ins_count", "del_count", "mark_count", "num_ops")
 
     def __init__(self, d: int, ki: int, kd: int, km: int) -> None:
         self.ins_ref = np.zeros((d, ki), np.int32)
@@ -101,6 +111,8 @@ class _RoundBuffers:
         self.ins_char = np.zeros((d, ki), np.int32)
         self.del_target = np.zeros((d, kd), np.int32)
         self.marks = {col: np.zeros((d, km), np.int32) for col in MARK_COLS}
+        self.ins_count = np.zeros(d, np.int32)
+        self.del_count = np.zeros(d, np.int32)
         self.mark_count = np.zeros(d, np.int32)
         self.num_ops = np.zeros(d, np.int32)
 
@@ -369,6 +381,12 @@ class StreamingMerge:
         if scheduled == 0 and pool is None:
             return 0
 
+        # Adaptive round widths: the (D, K) staging buffers are a real cost
+        # (host->device transfer every round), so trickle rounds shrink them.
+        # One shared power-of-two shift keeps the apply-program variant count
+        # logarithmic; any doc with large pending work keeps the full widths.
+        ki, kd, km = self._round_widths(pool, obj_streams, ki, kd, km)
+
         enc = _RoundBuffers(self._padded_docs, ki, kd, km)
         for i, streams in obj_streams.items():
             if streams.ins:
@@ -383,6 +401,8 @@ class StreamingMerge:
                 for c, col in enumerate(MARK_COLS):
                     enc.marks[col][i, : len(arr)] = arr[:, c]
                 enc.mark_count[i] = len(arr)
+            enc.ins_count[i] = len(streams.ins)
+            enc.del_count[i] = len(streams.dels)
             enc.num_ops[i] = (
                 len(streams.ins) + len(streams.dels) + len(streams.marks)
             )
@@ -395,14 +415,72 @@ class StreamingMerge:
 
         if scheduled == 0:
             return 0
-        arrays = encoded_arrays_of(enc)
         if self.mesh is not None:
+            # sharded path: padded (D, K) rows partition cleanly over the mesh
+            arrays = encoded_arrays_of(enc)
             arrays = shard_docs(arrays, self.mesh)
-        self.state = apply_batch_jit(self.state, arrays)
+            self.state = apply_batch_jit(self.state, arrays)
+        else:
+            # single-device path: ship flat streams proportional to real ops
+            # and rebuild the padded layout on device (kernel._pad_from_flat)
+            self.state = self._apply_compact(enc, (ki, kd, km))
         self.rounds += 1
         GLOBAL_COUNTERS.add("streaming.rounds")
         GLOBAL_COUNTERS.add("streaming.scheduled_changes", scheduled)
         return scheduled
+
+    def _apply_compact(self, enc: _RoundBuffers, widths) -> PackedDocs:
+        """Dispatch one round via kernel.apply_batch_compact_jit: the host
+        link carries flat op streams (power-of-two padded) plus per-doc
+        counts instead of the mostly-zero (D, K) staging rows."""
+        from ..ops.kernel import apply_batch_compact_jit
+
+        ki, kd, km = widths
+        mi = np.arange(ki, dtype=np.int32)[None, :] < enc.ins_count[:, None]
+        md = np.arange(kd, dtype=np.int32)[None, :] < enc.del_count[:, None]
+        mm = np.arange(km, dtype=np.int32)[None, :] < enc.mark_count[:, None]
+
+        def pad(v: np.ndarray) -> np.ndarray:
+            cap = 8
+            while cap < len(v):
+                cap *= 2
+            out = np.zeros(cap, np.int32)
+            out[: len(v)] = v
+            # async h2d: the copy streams while the host parses/schedules the
+            # next round (the jit call would otherwise block on each input)
+            return jax.device_put(out)
+
+        return apply_batch_compact_jit(
+            self.state,
+            (enc.ins_count, enc.del_count, enc.mark_count),
+            (pad(enc.ins_ref[mi]), pad(enc.ins_op[mi]), pad(enc.ins_char[mi])),
+            pad(enc.del_target[md]),
+            {col: pad(enc.marks[col][mm]) for col in MARK_COLS},
+            widths=widths,
+        )
+
+    def _round_widths(self, pool, obj_streams, ki: int, kd: int, km: int):
+        """Shrink this round's stream widths by a shared power-of-two shift
+        while every doc's pending need (clamped at the session caps) fits."""
+        need_i = max((len(s.ins) for s in obj_streams.values()), default=0)
+        need_d = max((len(s.dels) for s in obj_streams.values()), default=0)
+        need_m = max((len(s.marks) for s in obj_streams.values()), default=0)
+        if pool is not None:
+            doc_of, parsed = pool
+            starts = np.nonzero(
+                np.concatenate([[True], doc_of[1:] != doc_of[:-1]])
+            )[0]
+            need_i = max(need_i, min(ki, int(np.add.reduceat(parsed.cnt_ins, starts).max())))
+            need_d = max(need_d, min(kd, int(np.add.reduceat(parsed.cnt_del, starts).max())))
+            need_m = max(need_m, min(km, int(np.add.reduceat(parsed.cnt_mark, starts).max())))
+        shift = 0
+        while (
+            (ki >> (shift + 1)) >= max(need_i, 8)
+            and (kd >> (shift + 1)) >= max(need_d, 8)
+            and (km >> (shift + 1)) >= max(need_m, 8)
+        ):
+            shift += 1
+        return ki >> shift, kd >> shift, km >> shift
 
     def _gather_pool(self):
         """Merge pooled parsed-change chunks into one doc-grouped batch:
@@ -466,10 +544,15 @@ class StreamingMerge:
         enc.num_ops[frame_docs] = n_ins + n_del + n_mark
         scheduled = int(n_admitted.sum())
 
+        enc.ins_count[frame_docs] = n_ins
+        enc.del_count[frame_docs] = n_del
+
         demoted_docs = frame_docs[status != 0] if status.any() else None
         if demoted_docs is not None:
             for i in demoted_docs:  # rare: demote (rows zeroed natively)
                 i = int(i)
+                enc.ins_count[i] = 0
+                enc.del_count[i] = 0
                 enc.mark_count[i] = 0
                 enc.num_ops[i] = 0
                 self._demote_frame_doc(i)  # folds + zeroes the doc's clock row
@@ -521,6 +604,8 @@ class StreamingMerge:
                 self._pool.append(
                     (np.full(deferred.num_changes, i, np.int64), deferred)
                 )
+            enc.ins_count[i] = ni
+            enc.del_count[i] = nd
             enc.mark_count[i] = nm
             enc.num_ops[i] = ni + nd + nm
             scheduled += nch
@@ -742,13 +827,12 @@ class StreamingMerge:
         n_blocks = -(-self._padded_docs // self._read_chunk)
         for bi in range(n_blocks):
             lo, hi = self._block_bounds(bi)
-            resolved = resolve_jit(self._state_block(bi), self.comment_capacity)
-            mask = jnp.logical_and(
-                jnp.asarray(on_device_all[lo:hi, None]),
-                jnp.logical_not(resolved.overflow)[:, None],
+            digest, _ = _resolve_digest_jit(
+                self._state_block(bi),
+                self.comment_capacity,
+                jnp.asarray(on_device_all[lo:hi]),
             )
-            visible = jnp.logical_and(resolved.visible, mask)
-            total = (total + int(_digest_jit(resolved.char, visible))) & 0xFFFFFFFF
+            total = (total + int(digest)) & 0xFFFFFFFF
         return total
 
     # -- checkpoint support (peritext_tpu.checkpoint.save_session) ----------

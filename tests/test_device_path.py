@@ -205,3 +205,32 @@ def test_cursor_for_unknown_element_is_minus_one(batch):
     bogus = {"objectId": (1, "doc1"), "elemId": (999, "nowhere")}
     report = batch.merge([workload], cursors=[[bogus]])
     assert report.cursor_positions == [[-1]]
+
+
+def test_apply_batch_compact_empty_stream():
+    """A round with zero ops of one kind (unpadded empty flat array) applies
+    cleanly — kernel._pad_from_flat's empty-stream contract."""
+    import jax.numpy as jnp
+
+    from peritext_tpu.ops.kernel import apply_batch_compact_jit
+    from peritext_tpu.ops.packed import empty_docs
+
+    state = empty_docs(4, 32, 16, tomb_capacity=8)
+    zero4 = jnp.zeros((4,), jnp.int32)
+    counts = (jnp.asarray([1, 0, 0, 0], jnp.int32), zero4, zero4)
+    out = apply_batch_compact_jit(
+        state,
+        counts,
+        (jnp.asarray([0], jnp.int32),  # ref HEAD
+         jnp.asarray([1 << 10 | 1], jnp.int32),  # op 1@actor1
+         jnp.asarray([ord("a")], jnp.int32)),
+        jnp.zeros((0,), jnp.int32),  # no deletes at all this round
+        {col: jnp.zeros((0,), jnp.int32) for col in (
+            "m_action", "m_type", "m_start_kind", "m_start_elem",
+            "m_end_kind", "m_end_elem", "m_op", "m_attr")},
+        widths=(8, 8, 8),
+    )
+    import numpy as np
+
+    assert int(np.asarray(out.num_slots)[0]) == 1
+    assert not bool(np.asarray(out.overflow).any())
