@@ -51,6 +51,10 @@ class MergeReport:
     #: resolved cursor indices (aligned with merge()'s ``cursors`` argument);
     #: -1 = cursor's element does not exist in the converged document
     cursor_positions: Optional[List[List[int]]] = None
+    #: per-doc materialized root map (nested maps + text list), equal to the
+    #: scalar oracle's ``Doc.root`` — device docs decode their LWW register
+    #: table (ops/decode.decode_doc_root), fallback docs replay
+    roots: Optional[List[dict]] = None
 
 
 class DocBatch:
@@ -69,6 +73,7 @@ class DocBatch:
         mark_capacity: int = 64,
         comment_capacity: int = 32,
         op_capacity: Optional[int] = None,
+        map_capacity: int = 32,
         jit: bool = True,
         mesh=None,
     ) -> None:
@@ -76,6 +81,7 @@ class DocBatch:
         self.mark_capacity = mark_capacity
         self.comment_capacity = comment_capacity
         self.op_capacity = op_capacity
+        self.map_capacity = map_capacity
         #: optional jax.sharding.Mesh; when set, the doc axis of every tensor
         #: is sharded across it (pure data parallelism; XLA adds collectives
         #: only for cross-doc reductions like the convergence digest).
@@ -114,6 +120,7 @@ class DocBatch:
             self.slot_capacity,
             self.mark_capacity,
             tomb_capacity=arrays[3].shape[1],  # delete-stream width
+            map_capacity=self.map_capacity,
         )
         if self.mesh is not None:
             from ..parallel.mesh import shard_docs
@@ -174,15 +181,30 @@ class DocBatch:
             )
 
         t0 = time.perf_counter()
+        from ..ops.decode import decode_doc_root
+        from types import SimpleNamespace
+
+        # register table transfer (small: 5 x (D, R) int32)
+        regs = SimpleNamespace(
+            r_obj=np.asarray(state.r_obj), r_key=np.asarray(state.r_key),
+            r_op=np.asarray(state.r_op), r_kind=np.asarray(state.r_kind),
+            r_val=np.asarray(state.r_val), num_regs=np.asarray(state.num_regs),
+        )
         spans: List[List[FormatSpan]] = []
+        roots: List[dict] = []
         device_ops = 0
         fallback_ops = 0
         for d, workload in enumerate(workloads):
             if d in fallback:
-                spans.append(oracle_doc_for(d).get_text_with_formatting(["text"]))
+                doc = oracle_doc_for(d)
+                spans.append(doc.get_text_with_formatting(["text"]))
+                roots.append(doc.root)
                 fallback_ops += int(encoded.num_ops[d])
             else:
                 spans.append(decode_doc_spans(resolved, d, encoded.attr_tables[d]))
+                roots.append(
+                    decode_doc_root(regs, resolved, d, encoded.map_tables[d])
+                )
                 device_ops += int(encoded.num_ops[d])
         stats.decode_seconds = time.perf_counter() - t0
 
@@ -190,6 +212,7 @@ class DocBatch:
             encoded.ins_op.shape[1]
             + encoded.del_target.shape[1]
             + next(iter(encoded.marks.values())).shape[1]
+            + next(iter(encoded.map_ops.values())).shape[1]
         )
         stats.device_ops = device_ops
         stats.fallback_ops = fallback_ops
@@ -207,6 +230,7 @@ class DocBatch:
             device_ops=device_ops,
             stats=stats,
             cursor_positions=cursor_positions,
+            roots=roots,
         )
 
     def _resolve_cursor_batch(

@@ -95,8 +95,23 @@ def save_packed(state: PackedDocs, path: str | Path) -> None:
 
 
 def load_packed(path: str | Path) -> PackedDocs:
+    """Load a packed snapshot.  Fields absent from the file (snapshots
+    written before the schema gained them, e.g. the map-register table)
+    default to empty: zeros are exactly the state a doc without those ops
+    holds, so old snapshots stay loadable."""
     with np.load(path) as data:
-        return PackedDocs(*(data[name] for name in PackedDocs._fields))
+        num_docs = data["elem_id"].shape[0]
+
+        def field(name: str) -> np.ndarray:
+            if name in data:
+                return data[name]
+            if name == "overflow":
+                return np.zeros((num_docs,), bool)
+            if name in ("num_slots", "num_tombs", "num_marks", "num_regs"):
+                return np.zeros((num_docs,), np.int32)
+            return np.zeros((num_docs, 32), np.int32)  # table default width
+
+        return PackedDocs(*(field(name) for name in PackedDocs._fields))
 
 
 # ---------------------------------------------------------------------------

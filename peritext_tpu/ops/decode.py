@@ -79,3 +79,64 @@ def decode_doc_text(resolved: ResolvedDocs, doc_index: int) -> str:
     visible = np.asarray(resolved.visible[doc_index])
     chars = np.asarray(resolved.char[doc_index])
     return "".join(chr(int(c)) for c in chars[visible])
+
+
+def decode_doc_root(state, resolved: ResolvedDocs, doc_index: int, keys: Interner):
+    """Materialize one doc's root map from its device LWW registers — the
+    device twin of the scalar oracle's ``Doc.root`` (object graph walk from
+    the reference's nested object store, src/micromerge.ts:520-539).
+
+    ``state`` is a (numpy-converted) PackedDocs; VK_TEXT registers expand to
+    the visible character list so ``root == oracle.root`` exactly."""
+    from .packed import (
+        OBJ_ROOT,
+        VK_DELETED,
+        VK_FALSE,
+        VK_INT,
+        VK_NULL,
+        VK_OBJ,
+        VK_STR,
+        VK_TEXT,
+        VK_TRUE,
+    )
+
+    d = doc_index
+    n = int(np.asarray(state.num_regs[d]))
+    r_obj = np.asarray(state.r_obj[d])[:n]
+    r_key = np.asarray(state.r_key[d])[:n]
+    r_op = np.asarray(state.r_op[d])[:n]
+    r_kind = np.asarray(state.r_kind[d])[:n]
+    r_val = np.asarray(state.r_val[d])[:n]
+    visible = np.asarray(resolved.visible[d])
+    chars = np.asarray(resolved.char[d])
+
+    by_container: dict = {}
+    for i in range(n):
+        if r_op[i] == 0:
+            continue
+        by_container.setdefault(int(r_obj[i]), []).append(i)
+
+    def build(obj_id: int) -> dict:
+        out: dict = {}
+        for i in by_container.get(obj_id, ()):
+            kind = int(r_kind[i])
+            if kind == VK_DELETED:
+                continue
+            key = keys.lookup(int(r_key[i]))
+            if kind == VK_STR:
+                out[key] = keys.lookup(int(r_val[i]))
+            elif kind == VK_INT:
+                out[key] = int(r_val[i])
+            elif kind == VK_TRUE:
+                out[key] = True
+            elif kind == VK_FALSE:
+                out[key] = False
+            elif kind == VK_NULL:
+                out[key] = None
+            elif kind == VK_OBJ:
+                out[key] = build(int(r_val[i]))
+            elif kind == VK_TEXT:
+                out[key] = [chr(int(c)) for c in chars[visible]]
+        return out
+
+    return build(OBJ_ROOT)

@@ -28,7 +28,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..core.opids import HEAD
+from ..core.opids import HEAD, ROOT
 from ..core.types import AFTER, BEFORE, END_OF_TEXT, START_OF_TEXT, Boundary, Change
 from ..parallel.causal import causal_sort
 from ..schema import MARK_INDEX
@@ -42,6 +42,15 @@ from .packed import (
     MA_REMOVE,
     MAX_ACTORS,
     MAX_CTR,
+    OBJ_ROOT,
+    VK_DELETED,
+    VK_FALSE,
+    VK_INT,
+    VK_NULL,
+    VK_OBJ,
+    VK_STR,
+    VK_TEXT,
+    VK_TRUE,
     pack_id,
 )
 
@@ -64,6 +73,10 @@ MARK_COLS = (
     "m_attr",
 )
 
+# canonical map-register column order lives in packed.py (device & host
+# share one definition); re-exported here for stream-filling callers
+from .packed import MAP_STREAM_COLS  # noqa: E402  (grouped with MARK_COLS)
+
 
 @dataclass
 class EncodedBatch:
@@ -78,9 +91,17 @@ class EncodedBatch:
     # mark stream (D, KM) per MARK_COLS
     marks: Dict[str, np.ndarray]
     mark_count: np.ndarray  # int32 (D,)
+    # map-register stream (D, KP) per MAP_STREAM_COLS
+    map_ops: Dict[str, np.ndarray]
+    map_count: np.ndarray  # int32 (D,)
     num_ops: np.ndarray  # int32 (D,) total encoded ops (stats)
     actor_tables: List[OrderedActorTable]
     attr_tables: List[Interner]
+    #: per-doc interner for map keys and string values
+    map_tables: List[Interner]
+    #: per-doc key under which the text list hangs off the root (None = no
+    #: text list yet); lets read_root place the decoded text
+    text_keys: List[Optional[str]]
     #: doc indices the device path cannot express; resolved by the oracle
     fallback_docs: List[int] = field(default_factory=list)
 
@@ -94,6 +115,7 @@ class _DocStreams:
         self.ins: List[Tuple[int, int, int]] = []  # (ref, op, char)
         self.dels: List[int] = []
         self.marks: List[Tuple[int, ...]] = []  # MARK_COLS order
+        self.maps: List[Tuple[int, int, int, int, int]] = []  # MAP_STREAM_COLS
 
 
 def _pack_opid(opid, actors: OrderedActorTable) -> int:
@@ -109,55 +131,105 @@ def _pack_boundary(b: Boundary, actors: OrderedActorTable) -> Tuple[int, int]:
     return _BK[b.kind], 0
 
 
+def _encode_value(value, keys: Interner):
+    """Map-set value -> (VK_*, payload), or None when inexpressible on
+    device (nested containers, floats, out-of-range ints -> oracle)."""
+    if isinstance(value, bool):
+        return (VK_TRUE if value else VK_FALSE), 0
+    if value is None:
+        return VK_NULL, 0
+    if isinstance(value, str):
+        return VK_STR, keys.intern(value)
+    if isinstance(value, int) and -(2**31) <= value < 2**31:
+        return VK_INT, value
+    return None
+
+
 def encode_doc(
     changes: Sequence[Change],
     actors: OrderedActorTable,
     attrs: Interner,
+    keys: Interner,
     text_obj=None,
+    map_objs: Optional[set] = None,
+    text_key: Optional[str] = None,
 ):
-    """Split one document's causally-sorted changes into three streams.
-    Returns (_DocStreams, ok, text_obj); ok=False -> host fallback.
-    ``text_obj`` (the op id of the document's text list) carries across
-    incremental rounds for streaming sessions."""
+    """Split one document's causally-sorted changes into four streams
+    (text inserts / deletes / marks, plus map-register writes).
+    Returns (_DocStreams, ok, text_obj, text_key); ok=False -> host fallback.
+    ``text_obj`` (the op id of the document's text list), ``map_objs`` (the
+    packed ids of known map objects, mutated in place) and ``text_key`` carry
+    across incremental rounds for streaming sessions."""
     streams = _DocStreams()
+    if map_objs is None:
+        map_objs = set()
 
     for change in changes:
         for op in change.ops:
-            if op.action == "makeList" and text_obj is None:
-                text_obj = op.opid
-                continue
-            if op.obj != text_obj:
-                return streams, False, text_obj
-            if op.action == "set" and op.insert:
-                ref = 0 if op.elem_id is HEAD else _pack_opid(op.elem_id, actors)
-                streams.ins.append((ref, _pack_opid(op.opid, actors), ord(op.value)))
-            elif op.action == "del":
-                streams.dels.append(_pack_opid(op.elem_id, actors))
-            elif op.action in ("addMark", "removeMark"):
-                sk, se = _pack_boundary(op.start, actors)
-                ek, ee = _pack_boundary(op.end, actors)
-                attr = 0
-                if op.attrs:
-                    # key-presence, not truthiness: an empty url/id is a value
-                    if "url" in op.attrs:
-                        attr = attrs.intern(op.attrs["url"])
-                    elif "id" in op.attrs:
-                        attr = attrs.intern(op.attrs["id"])
-                streams.marks.append(
-                    (
-                        MA_ADD if op.action == "addMark" else MA_REMOVE,
-                        MARK_INDEX[op.mark_type],
-                        sk,
-                        se,
-                        ek,
-                        ee,
-                        _pack_opid(op.opid, actors),
-                        attr,
+            if text_obj is not None and op.obj == text_obj:
+                if op.action == "set" and op.insert:
+                    ref = 0 if op.elem_id is HEAD else _pack_opid(op.elem_id, actors)
+                    streams.ins.append((ref, _pack_opid(op.opid, actors), ord(op.value)))
+                elif op.action == "del":
+                    streams.dels.append(_pack_opid(op.elem_id, actors))
+                elif op.action in ("addMark", "removeMark"):
+                    sk, se = _pack_boundary(op.start, actors)
+                    ek, ee = _pack_boundary(op.end, actors)
+                    attr = 0
+                    if op.attrs:
+                        # key-presence, not truthiness: empty url/id is a value
+                        if "url" in op.attrs:
+                            attr = attrs.intern(op.attrs["url"])
+                        elif "id" in op.attrs:
+                            attr = attrs.intern(op.attrs["id"])
+                    streams.marks.append(
+                        (
+                            MA_ADD if op.action == "addMark" else MA_REMOVE,
+                            MARK_INDEX[op.mark_type],
+                            sk,
+                            se,
+                            ek,
+                            ee,
+                            _pack_opid(op.opid, actors),
+                            attr,
+                        )
                     )
-                )
+                else:
+                    return streams, False, text_obj, text_key
+                continue
+
+            # Map-object ops (reference src/micromerge.ts:1151-1175): the
+            # containing object must be the root or a known child map.
+            if op.obj is ROOT:
+                pobj = OBJ_ROOT
             else:
-                return streams, False, text_obj  # makeMap / map ops: host fallback
-    return streams, True, text_obj
+                pobj = _pack_opid(op.obj, actors)
+                if pobj not in map_objs:
+                    return streams, False, text_obj, text_key
+            if op.key is None:
+                return streams, False, text_obj, text_key
+            popid = _pack_opid(op.opid, actors)
+            pkey = keys.intern(op.key)
+            if op.action == "makeList":
+                # exactly one list (the text sequence) is device-expressible
+                if text_obj is not None:
+                    return streams, False, text_obj, text_key
+                text_obj = op.opid
+                text_key = op.key
+                streams.maps.append((pobj, pkey, popid, VK_TEXT, popid))
+            elif op.action == "makeMap":
+                map_objs.add(popid)
+                streams.maps.append((pobj, pkey, popid, VK_OBJ, popid))
+            elif op.action == "set" and not op.insert:
+                encoded = _encode_value(op.value, keys)
+                if encoded is None:
+                    return streams, False, text_obj, text_key
+                streams.maps.append((pobj, pkey, popid, *encoded))
+            elif op.action == "del":
+                streams.maps.append((pobj, pkey, popid, VK_DELETED, 0))
+            else:
+                return streams, False, text_obj, text_key
+    return streams, True, text_obj, text_key
 
 
 class DocEncoder:
@@ -174,7 +246,10 @@ class DocEncoder:
     def __init__(self, actor_names) -> None:
         self.actors = OrderedActorTable(actor_names)
         self.attrs = Interner()
+        self.keys = Interner()
         self.text_obj = None
+        self.text_key: Optional[str] = None
+        self.map_objs: set = set()
         self.ok = len(self.actors) - 1 <= MAX_ACTORS
 
     def encode_increment(self, ordered_changes: Sequence[Change]):
@@ -183,8 +258,9 @@ class DocEncoder:
         if not self.ok:
             return _DocStreams(), False
         try:
-            streams, ok, self.text_obj = encode_doc(
-                ordered_changes, self.actors, self.attrs, self.text_obj
+            streams, ok, self.text_obj, self.text_key = encode_doc(
+                ordered_changes, self.actors, self.attrs, self.keys,
+                self.text_obj, self.map_objs, self.text_key,
             )
         except (OverflowError, KeyError):  # ctr overflow / undeclared actor
             ok = False
@@ -202,11 +278,14 @@ def encode_workloads(
     insert_capacity: Optional[int] = None,
     delete_capacity: Optional[int] = None,
     mark_capacity: Optional[int] = None,
+    map_capacity: Optional[int] = None,
 ) -> EncodedBatch:
     """Encode a batch of per-doc change-log sets (dict actor -> [Change])."""
     per_doc: List[Optional[_DocStreams]] = []
     actor_tables: List[OrderedActorTable] = []
     attr_tables: List[Interner] = []
+    map_tables: List[Interner] = []
+    text_keys: List[Optional[str]] = []
     fallback: List[int] = []
 
     for doc_index, queues in enumerate(workloads):
@@ -217,13 +296,15 @@ def encode_workloads(
         }
         actors = OrderedActorTable(actor_set)
         attrs = Interner()
+        keys = Interner()
         # len(actors) includes the reserved index-0 None slot, so the largest
         # assigned actor index is len(actors) - 1, which must fit ACTOR_BITS.
         ok = len(actors) - 1 <= MAX_ACTORS
         streams = _DocStreams()
+        text_key = None
         if ok:
             try:
-                streams, ok, _ = encode_doc(ordered, actors, attrs)
+                streams, ok, _, text_key = encode_doc(ordered, actors, attrs, keys)
             except OverflowError:
                 ok = False
         if not ok:
@@ -232,15 +313,20 @@ def encode_workloads(
         per_doc.append(streams)
         actor_tables.append(actors)
         attr_tables.append(attrs)
+        map_tables.append(keys)
+        text_keys.append(text_key)
 
     return pad_doc_streams(
         per_doc,
         fallback,
         actor_tables,
         attr_tables,
+        map_tables=map_tables,
+        text_keys=text_keys,
         insert_capacity=insert_capacity,
         delete_capacity=delete_capacity,
         mark_capacity=mark_capacity,
+        map_capacity=map_capacity,
     )
 
 
@@ -249,9 +335,12 @@ def pad_doc_streams(
     fallback: List[int],
     actor_tables: List[OrderedActorTable],
     attr_tables: List[Interner],
+    map_tables: Optional[List[Interner]] = None,
+    text_keys: Optional[List[Optional[str]]] = None,
     insert_capacity: Optional[int] = None,
     delete_capacity: Optional[int] = None,
     mark_capacity: Optional[int] = None,
+    map_capacity: Optional[int] = None,
 ) -> EncodedBatch:
     """Pad per-doc split streams into dense (D, K) arrays.  Docs exceeding a
     fixed capacity are appended to ``fallback`` (shape buckets are static so
@@ -260,6 +349,7 @@ def pad_doc_streams(
     ki = insert_capacity or _round8(max((len(s.ins) for s in per_doc), default=0))
     kd = delete_capacity or _round8(max((len(s.dels) for s in per_doc), default=0))
     km = mark_capacity or _round8(max((len(s.marks) for s in per_doc), default=0))
+    kp = map_capacity or _round8(max((len(s.maps) for s in per_doc), default=0))
 
     ins_ref = np.zeros((d, ki), np.int32)
     ins_op = np.zeros((d, ki), np.int32)
@@ -267,12 +357,17 @@ def pad_doc_streams(
     del_target = np.zeros((d, kd), np.int32)
     marks = {col: np.zeros((d, km), np.int32) for col in MARK_COLS}
     mark_count = np.zeros(d, np.int32)
+    map_ops = {col: np.zeros((d, kp), np.int32) for col in MAP_STREAM_COLS}
+    map_count = np.zeros(d, np.int32)
     num_ops = np.zeros(d, np.int32)
 
     for i, streams in enumerate(per_doc):
         if i in fallback:
             continue
-        if len(streams.ins) > ki or len(streams.dels) > kd or len(streams.marks) > km:
+        if (
+            len(streams.ins) > ki or len(streams.dels) > kd
+            or len(streams.marks) > km or len(streams.maps) > kp
+        ):
             fallback.append(i)  # over this shape bucket: oracle fallback
             continue
         if streams.ins:
@@ -287,7 +382,15 @@ def pad_doc_streams(
             for c, col in enumerate(MARK_COLS):
                 marks[col][i, : len(arr)] = arr[:, c]
             mark_count[i] = len(arr)
-        num_ops[i] = len(streams.ins) + len(streams.dels) + len(streams.marks)
+        if streams.maps:
+            arr = np.asarray(streams.maps, np.int32)
+            for c, col in enumerate(MAP_STREAM_COLS):
+                map_ops[col][i, : len(arr)] = arr[:, c]
+            map_count[i] = len(arr)
+        num_ops[i] = (
+            len(streams.ins) + len(streams.dels)
+            + len(streams.marks) + len(streams.maps)
+        )
 
     return EncodedBatch(
         ins_ref=ins_ref,
@@ -296,8 +399,12 @@ def pad_doc_streams(
         del_target=del_target,
         marks=marks,
         mark_count=mark_count,
+        map_ops=map_ops,
+        map_count=map_count,
         num_ops=num_ops,
         actor_tables=actor_tables,
         attr_tables=attr_tables,
+        map_tables=map_tables if map_tables is not None else [Interner() for _ in range(d)],
+        text_keys=text_keys if text_keys is not None else [None] * d,
         fallback_docs=sorted(fallback),
     )

@@ -144,6 +144,52 @@ def _post_insert_doc(state: PackedDocs, del_target, mark_rows, mark_count):
     )
 
 
+#: map-op stream columns (encode.py fills, _apply_map_doc consumes) —
+#: single canonical definition in packed.py
+from .packed import MAP_STREAM_COLS as MAP_COLS  # noqa: E402
+
+
+def _apply_map_doc(state: PackedDocs, p_obj, p_key, p_op, p_kind, p_val, count):
+    """Phase 4: LWW upsert of map registers for one doc.
+
+    The scalar semantics is core/doc.py ``_apply_op``'s map branch (reference
+    src/micromerge.ts:1151-1175): per (object, key), the op with the largest
+    id wins; ``del`` wins like any write (kind VK_DELETED).  Sequential over
+    the round's map stream because an unseen key must append exactly one
+    register row even when written twice in a round; winner choice itself is
+    an order-independent max, so any causally-valid schedule converges."""
+    cap = state.r_obj.shape[0]
+    kp = p_op.shape[0]
+
+    def body(i, carry):
+        r_obj, r_key, r_op, r_kind, r_val, n, ov = carry
+        live = (i < count) & (p_op[i] != 0)
+        match = (r_op != 0) & (r_obj == p_obj[i]) & (r_key == p_key[i])
+        exists = jnp.any(match)
+        pos = jnp.where(exists, jnp.argmax(match), n).astype(jnp.int32)
+        full = ~exists & (n >= cap)
+        pos = jnp.minimum(pos, cap - 1)
+        win = live & ~full & (p_op[i] > r_op[pos])
+        r_obj = r_obj.at[pos].set(jnp.where(win, p_obj[i], r_obj[pos]))
+        r_key = r_key.at[pos].set(jnp.where(win, p_key[i], r_key[pos]))
+        r_op = r_op.at[pos].set(jnp.where(win, p_op[i], r_op[pos]))
+        r_kind = r_kind.at[pos].set(jnp.where(win, p_kind[i], r_kind[pos]))
+        r_val = r_val.at[pos].set(jnp.where(win, p_val[i], r_val[pos]))
+        n = n + (live & ~exists & ~full).astype(jnp.int32)
+        ov = ov | (live & full)
+        return (r_obj, r_key, r_op, r_kind, r_val, n, ov)
+
+    r_obj, r_key, r_op, r_kind, r_val, n, ov = lax.fori_loop(
+        0, kp, body,
+        (state.r_obj, state.r_key, state.r_op, state.r_kind, state.r_val,
+         state.num_regs, state.overflow),
+    )
+    return state._replace(
+        r_obj=r_obj, r_key=r_key, r_op=r_op, r_kind=r_kind, r_val=r_val,
+        num_regs=n, overflow=ov,
+    )
+
+
 def apply_batch(
     state: PackedDocs,
     encoded_arrays,
@@ -151,11 +197,13 @@ def apply_batch(
     insert_impl: str = "auto",
     insert_loop_slots: int | None = None,
 ) -> PackedDocs:
-    """Batched apply: vmap of the two-phase pipeline over the doc axis.
+    """Batched apply: vmap of the phase pipeline over the doc axis.
 
     ``encoded_arrays`` is the tuple
-    (ins_ref, ins_op, ins_char, del_target, marks_dict, mark_count)
-    with leading doc axes, as produced by :func:`encoded_arrays_of`.
+    (ins_ref, ins_op, ins_char, del_target, marks_dict, mark_count[,
+    maps_dict, map_count]) with leading doc axes, as produced by
+    :func:`encoded_arrays_of`; the 6-tuple form (no map stream) is accepted
+    for callers without map ops.
 
     ``insert_impl`` selects the sequential-phase implementation:
     ``"auto"`` (pallas on TPU, lax elsewhere), ``"lax"``, ``"pallas"``, or
@@ -163,7 +211,12 @@ def apply_batch(
     ``insert_loop_slots`` optionally bounds the slot window the insert loop
     touches (see pallas_insert.insert_batch_pallas); ignored on the lax path.
     """
-    ins_ref, ins_op, ins_char, del_target, marks, mark_count = encoded_arrays
+    if len(encoded_arrays) == 6:
+        ins_ref, ins_op, ins_char, del_target, marks, mark_count = encoded_arrays
+        maps, map_count = None, None
+    else:
+        (ins_ref, ins_op, ins_char, del_target, marks, mark_count,
+         maps, map_count) = encoded_arrays
     impl = insert_impl
     if impl == "auto":
         impl = resolve_insert_impl(state.elem_id)
@@ -185,12 +238,19 @@ def apply_batch(
             loop_slots=insert_loop_slots,
         )
         state = state._replace(elem_id=elem, char=char, num_slots=n, overflow=ov)
-        return jax.vmap(_post_insert_doc)(state, del_target, marks, mark_count)
-    if impl != "lax":
+        state = jax.vmap(_post_insert_doc)(state, del_target, marks, mark_count)
+    elif impl == "lax":
+        state = jax.vmap(_apply_doc)(
+            state, ins_ref, ins_op, ins_char, del_target, marks, mark_count
+        )
+    else:
         raise ValueError(f"unknown insert_impl: {insert_impl!r}")
-    return jax.vmap(_apply_doc)(
-        state, ins_ref, ins_op, ins_char, del_target, marks, mark_count
-    )
+    if maps is not None:
+        state = jax.vmap(_apply_map_doc)(
+            state, maps["p_obj"], maps["p_key"], maps["p_op"],
+            maps["p_kind"], maps["p_val"], map_count,
+        )
+    return state
 
 
 def _pad_from_flat(flat, counts, width: int):
@@ -261,14 +321,25 @@ def apply_batch_compact_jit(state, stream_counts, ins_flat, del_flat, mark_flat,
 
 
 def encoded_arrays_of(encoded: EncodedBatch):
-    """The device-array tuple for apply_batch from a host EncodedBatch."""
-    return (
+    """The device-array tuple for apply_batch from a host EncodedBatch.
+
+    Emits the 8-tuple (with the map-register stream) when the batch carries
+    one; sources without map streams (e.g. streaming round buffers) yield
+    the 6-tuple form apply_batch equally accepts."""
+    base = (
         jnp.asarray(encoded.ins_ref),
         jnp.asarray(encoded.ins_op),
         jnp.asarray(encoded.ins_char),
         jnp.asarray(encoded.del_target),
         {col: jnp.asarray(arr) for col, arr in encoded.marks.items()},
         jnp.asarray(encoded.mark_count),
+    )
+    map_ops = getattr(encoded, "map_ops", None)
+    if map_ops is None:
+        return base
+    return base + (
+        {col: jnp.asarray(arr) for col, arr in map_ops.items()},
+        jnp.asarray(encoded.map_count),
     )
 
 

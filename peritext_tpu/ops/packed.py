@@ -53,6 +53,26 @@ BK_END_OF_TEXT = 3
 MA_ADD = 1
 MA_REMOVE = 2
 
+# Map-register value kinds (device LWW registers for map objects; the scalar
+# semantics is core/doc.py:_apply_op's map branch, reference
+# src/micromerge.ts:1151-1175).  A register row (r_op != 0) is the current
+# LWW winner for one (object, key) pair.
+VK_DELETED = 0  # winning op was a del: key absent
+VK_STR = 1  # r_val = interned string id
+VK_INT = 2  # r_val = the value (int32 range)
+VK_TRUE = 3
+VK_FALSE = 4
+VK_NULL = 5
+VK_OBJ = 6  # r_val = packed id of a child map (its makeMap's op id)
+VK_TEXT = 7  # r_val = packed id of the document's text list
+
+#: ROOT object encoding in packed object columns (0 means HEAD/empty)
+OBJ_ROOT = -1
+
+#: canonical column order of a map-register stream row (host encode ->
+#: device kernel share this single definition)
+MAP_STREAM_COLS = ("p_obj", "p_key", "p_op", "p_kind", "p_val")
+
 
 class PackedDocs(NamedTuple):
     """Batched document state; leading axis D is the (shardable) doc axis.
@@ -77,10 +97,18 @@ class PackedDocs(NamedTuple):
     m_end_elem: jnp.ndarray  # int32 packed
     m_op: jnp.ndarray  # int32 packed op id
     m_attr: jnp.ndarray  # int32 interned attr (url/comment id); 0 = none
+    # map register table (D, R): LWW winner per (map object, key) —
+    # makeMap / map set / map del without leaving the device path
+    r_obj: jnp.ndarray  # int32 container object (OBJ_ROOT = root; row empty iff r_op == 0)
+    r_key: jnp.ndarray  # int32 interned key
+    r_op: jnp.ndarray  # int32 packed winning op id (0 = empty row)
+    r_kind: jnp.ndarray  # int32 VK_*
+    r_val: jnp.ndarray  # int32 payload per VK_*
     # scalars per doc (D,)
     num_slots: jnp.ndarray  # int32
     num_tombs: jnp.ndarray  # int32
     num_marks: jnp.ndarray  # int32
+    num_regs: jnp.ndarray  # int32
     overflow: jnp.ndarray  # bool: capacity exceeded or invalid reference
 
     @property
@@ -99,16 +127,22 @@ class PackedDocs(NamedTuple):
     def mark_capacity(self) -> int:
         return self.m_action.shape[1]
 
+    @property
+    def map_capacity(self) -> int:
+        return self.r_obj.shape[1]
+
 
 def empty_docs(
     num_docs: int,
     slot_capacity: int,
     mark_capacity: int,
     tomb_capacity: int | None = None,
+    map_capacity: int = 32,
 ) -> PackedDocs:
     """Fresh empty batch (documents are built by applying their change logs)."""
     d, s, m = num_docs, slot_capacity, mark_capacity
     t = tomb_capacity if tomb_capacity is not None else s
+    r = map_capacity
     zi = lambda *shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
     return PackedDocs(
         elem_id=zi(d, s),
@@ -122,9 +156,15 @@ def empty_docs(
         m_end_elem=zi(d, m),
         m_op=zi(d, m),
         m_attr=zi(d, m),
+        r_obj=zi(d, r),
+        r_key=zi(d, r),
+        r_op=zi(d, r),
+        r_kind=zi(d, r),
+        r_val=zi(d, r),
         num_slots=zi(d),
         num_tombs=zi(d),
         num_marks=zi(d),
+        num_regs=zi(d),
         overflow=jnp.zeros((d,), bool),
     )
 

@@ -59,7 +59,7 @@ from ..ops.frames import (
     schedule_split,
 )
 from ..ops.kernel import apply_batch_jit, encoded_arrays_of
-from ..ops.packed import PackedDocs, empty_docs
+from ..ops.packed import VK_TEXT, PackedDocs, empty_docs
 from ..ops.resolve import resolve, resolve_jit
 from ..utils.interning import Interner, OrderedActorTable
 from .causal import causal_schedule
@@ -359,6 +359,13 @@ class StreamingMerge:
                 sess.fallback = True
                 GLOBAL_COUNTERS.add("streaming.fallback_docs")
             streams, ok = sess.encoder.encode_increment(admitted)
+            if any(row[3] != VK_TEXT for row in streams.maps):
+                # map-register rounds are not wired into the streaming round
+                # buffers yet; until then a map op demotes the doc (replay
+                # stays correct), exactly as before the device map path.
+                # (The text list's own VK_TEXT register row is host-tracked
+                # via the encoder's text_obj/text_key and safe to drop here.)
+                ok = False
             if not ok:
                 sess.fallback = True
                 GLOBAL_COUNTERS.add("streaming.fallback_docs")

@@ -105,10 +105,28 @@ def test_device_convergence_under_causal_reorder(batch):
     assert report_fwd.spans == report_rev.spans
 
 
-def test_encode_reports_nontext_ops_for_fallback():
+def test_encode_expresses_map_ops_on_device():
+    """makeMap / map set / del encode into the map-register stream (no
+    fallback); reference map LWW src/micromerge.ts:1151-1175."""
     docs, _, initial = generate_docs("ab", 1)
     d1 = docs[0]
-    c, _ = d1.change([{"path": [], "action": "makeMap", "key": "meta"}])
+    c, _ = d1.change([
+        {"path": [], "action": "makeMap", "key": "meta"},
+        {"path": ["meta"], "action": "set", "key": "title", "value": "hi"},
+        {"path": ["meta"], "action": "del", "key": "title"},
+    ])
+    enc = encode_workloads([{"doc1": [initial, c]}])
+    assert enc.fallback_docs == []
+    assert int(enc.map_count[0]) == 4  # text makeList register + 3 map ops
+
+
+def test_encode_inexpressible_map_value_falls_back():
+    """Nested-container / float values stay oracle-only."""
+    docs, _, initial = generate_docs("ab", 1)
+    d1 = docs[0]
+    c, _ = d1.change([
+        {"path": [], "action": "set", "key": "ratio", "value": 0.5},
+    ])
     enc = encode_workloads([{"doc1": [initial, c]}])
     assert enc.fallback_docs == [0]
 
@@ -234,3 +252,55 @@ def test_apply_batch_compact_empty_stream():
 
     assert int(np.asarray(out.num_slots)[0]) == 1
     assert not bool(np.asarray(out.overflow).any())
+
+
+# -- device map registers (kernel._apply_map_doc) ---------------------------
+
+
+def test_device_map_lww_concurrent_set():
+    """Two replicas set the same key concurrently: larger op id wins on
+    device exactly as in the oracle (reference src/micromerge.ts:1151-1175)."""
+    from peritext_tpu.api.batch import _oracle_doc
+
+    docs, _, initial = generate_docs("ab", 2)
+    d1, d2 = docs
+    c1, _ = d1.change([{"path": [], "action": "set", "key": "title", "value": "one"}])
+    c2, _ = d2.change([{"path": [], "action": "set", "key": "title", "value": "two"}])
+    w = {"doc1": [initial, c1], "doc2": [c2]}
+    report = DocBatch(slot_capacity=64, mark_capacity=16).merge([w])
+    assert report.fallback_docs == []
+    assert report.roots[0] == _oracle_doc(w).root
+
+
+def test_device_map_del_vs_set_and_nested():
+    from peritext_tpu.api.batch import _oracle_doc
+    from peritext_tpu.core.comment import Comment, put_comment, remove_comment
+
+    docs, _, initial = generate_docs("ab", 2)
+    d1, d2 = docs
+    ca, _ = put_comment(d1, Comment(id="c1", actor="doc1", content="first"))
+    d2.apply_change(ca)
+    # concurrent: doc1 deletes the comment while doc2 edits its content
+    cdel, _ = remove_comment(d1, "c1")
+    cset, _ = d2.change(
+        [{"path": ["comments", "c1"], "action": "set", "key": "content", "value": "edited"}]
+    )
+    w = {"doc1": [initial, ca, cdel], "doc2": [cset]}
+    report = DocBatch(slot_capacity=64, mark_capacity=16).merge([w])
+    assert report.fallback_docs == []
+    assert report.roots[0] == _oracle_doc(w).root
+
+
+def test_device_map_register_overflow_falls_back():
+    docs, _, initial = generate_docs("ab", 1)
+    d1 = docs[0]
+    ops = [
+        {"path": [], "action": "set", "key": f"k{i}", "value": i} for i in range(40)
+    ]
+    c, _ = d1.change(ops)
+    w = {"doc1": [initial, c]}
+    report = DocBatch(slot_capacity=64, mark_capacity=16, map_capacity=8).merge([w])
+    assert report.fallback_docs == [0]
+    from peritext_tpu.api.batch import _oracle_doc
+
+    assert report.roots[0] == _oracle_doc(w).root  # served by the oracle
