@@ -97,6 +97,24 @@ class Doc:
         self._objects: Dict[Any, Any] = {ROOT: {}}
         self._metadata: Dict[Any, Metadata] = {ROOT: MapMeta()}
 
+    @classmethod
+    def resume(cls, actor_id: str, ordered_changes) -> "Doc":
+        """Reconstruct a replica AND resume its actor identity.
+
+        ``apply_change`` alone rebuilds state but leaves the local sequence
+        counter at zero (the reference behaves the same: ``this.seq`` only
+        advances through ``change()``, src/micromerge.ts:566-577), so a
+        replica restored by replay would mint colliding ``(actor, seq=1)``
+        changes.  This constructor replays ``ordered_changes`` (already in a
+        causally-valid order) and then continues the actor's own numbering —
+        the event-sourcing restore path (checkpoint.py).
+        """
+        doc = cls(actor_id)
+        for change in ordered_changes:
+            doc.apply_change(change)
+        doc._seq = doc.clock.get(actor_id, 0)
+        return doc
+
     # ------------------------------------------------------------------
     # Public read API
     # ------------------------------------------------------------------

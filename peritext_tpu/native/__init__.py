@@ -91,7 +91,7 @@ def load() -> Optional[ctypes.CDLL]:
             i32p, i32p,  # ch_actor, ch_seq
             i32p, i32p, i32p, ctypes.c_int64,  # dep_off, dep_actor, dep_seq, dep_cap
             i32p, i32p, ctypes.c_int64,  # ops_off, ops, op_cap
-            i32p, i32p, i32p,  # cnt_ins, cnt_del, cnt_mark
+            i32p, i32p, i32p, i32p,  # cnt_ins, cnt_del, cnt_mark, cnt_map
         ]
         lib.pt_schedule_split_batch.restype = ctypes.c_int32
         lib.pt_schedule_split_batch.argtypes = (
@@ -101,9 +101,10 @@ def load() -> Optional[ctypes.CDLL]:
             + [i32p] * 3  # dep_off, dep_actor, dep_seq
             + [i32p] * 2  # ops_off, ops
             + [i32p]  # clock
-            + [ctypes.c_int32] * 3  # ki, kd, km
+            + [ctypes.c_int32] * 4  # ki, kd, km, kp
             + [i32p] * 12  # ins x3, del, marks x8
-            + [i32p] * 4  # n_ins, n_del, n_mark, n_admitted
+            + [i32p] * 5  # map stream x5
+            + [i32p] * 5  # n_ins, n_del, n_mark, n_map, n_admitted
             + [u8p] * 2  # admitted, status
         )
         lib.pt_parse_frames.restype = ctypes.c_int32
@@ -116,7 +117,7 @@ def load() -> Optional[ctypes.CDLL]:
             i32p, i32p, ctypes.c_int64,  # ch_actor, ch_seq, ch_cap
             i32p, i32p, i32p, ctypes.c_int64,  # dep_off, dep_actor, dep_seq, dep_cap
             i32p, i32p, ctypes.c_int64,  # ops_off, ops, op_cap
-            i32p, i32p, i32p,  # cnt_ins, cnt_del, cnt_mark
+            i32p, i32p, i32p, i32p,  # cnt_ins, cnt_del, cnt_mark, cnt_map
         ]
         _lib = lib
         return _lib
@@ -165,8 +166,8 @@ def parse_changes(
     """Native frame-payload parse (see pt_parse_changes in native.cpp).
 
     Returns ``(ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops,
-    cnt_ins, cnt_del, cnt_mark)`` with ``ops`` shaped (n_ops, 10), or None
-    when the native library is unavailable.  Raises ValueError on a
+    cnt_ins, cnt_del, cnt_mark, cnt_map)`` with ``ops`` shaped (n_ops, 10),
+    or None when the native library is unavailable.  Raises ValueError on a
     malformed payload.
     """
     lib = load()
@@ -187,6 +188,7 @@ def parse_changes(
     cnt_ins = np.empty(n, np.int32)
     cnt_del = np.empty(n, np.int32)
     cnt_mark = np.empty(n, np.int32)
+    cnt_map = np.empty(n, np.int32)
     rc = lib.pt_parse_changes(
         values, int(values.size), n,
         str2actor, int(str2actor.size),
@@ -194,7 +196,7 @@ def parse_changes(
         ch_actor, ch_seq,
         dep_off, dep_actor, dep_seq, dep_cap,
         ops_off, ops.reshape(-1), op_cap,
-        cnt_ins, cnt_del, cnt_mark,
+        cnt_ins, cnt_del, cnt_mark, cnt_map,
     )
     if rc != 0:
         raise ValueError(f"malformed change frame payload (native rc={rc})")
@@ -204,7 +206,7 @@ def parse_changes(
         ch_actor, ch_seq,
         dep_off, dep_actor[:n_deps].copy(), dep_seq[:n_deps].copy(),
         ops_off, ops[:n_ops].copy(),
-        cnt_ins, cnt_del, cnt_mark,
+        cnt_ins, cnt_del, cnt_mark, cnt_map,
     )
 
 
@@ -220,9 +222,10 @@ def parse_frames(
 
     Returns ``(f_status, f_ch_off, f_str_off, str_start, str_len, ch_actor,
     ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops, cnt_ins, cnt_del,
-    cnt_mark)`` with all change/dep/op arrays flattened across frames and
-    trimmed to their true lengths, or None when no native library.  Corrupt
-    frames are reported per frame via ``f_status`` (1), never an exception.
+    cnt_mark, cnt_map)`` with all change/dep/op arrays flattened across
+    frames and trimmed to their true lengths, or None when no native
+    library.  Corrupt frames are reported per frame via ``f_status`` (1),
+    never an exception.
     """
     lib = load()
     if lib is None:
@@ -253,6 +256,7 @@ def parse_frames(
     cnt_ins = np.empty(ch_total + 1, np.int32)
     cnt_del = np.empty(ch_total + 1, np.int32)
     cnt_mark = np.empty(ch_total + 1, np.int32)
+    cnt_map = np.empty(ch_total + 1, np.int32)
 
     rc = lib.pt_parse_frames(
         np.ascontiguousarray(data), np.ascontiguousarray(frame_off, np.int64),
@@ -264,7 +268,7 @@ def parse_frames(
         ch_actor, ch_seq, ch_total + 1,
         dep_off, dep_actor, dep_seq, dep_cap,
         ops_off, ops.reshape(-1), op_cap,
-        cnt_ins, cnt_del, cnt_mark,
+        cnt_ins, cnt_del, cnt_mark, cnt_map,
     )
     if rc != 0:  # capacity sizing bug — surface loudly, don't mis-parse
         raise RuntimeError(f"pt_parse_frames capacity error rc={rc}")
@@ -278,7 +282,7 @@ def parse_frames(
         ch_actor[:nc], ch_seq[:nc],
         dep_off[: nc + 1], dep_actor[:n_deps].copy(), dep_seq[:n_deps].copy(),
         ops_off[: nc + 1], ops[:n_ops].copy(),
-        cnt_ins[:nc], cnt_del[:nc], cnt_mark[:nc],
+        cnt_ins[:nc], cnt_del[:nc], cnt_mark[:nc], cnt_map[:nc],
     )
 
 
@@ -289,14 +293,15 @@ def schedule_split_batch(
     text_obj: np.ndarray,
     parsed_cols,  # (ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops)
     clock: np.ndarray,  # (n_docs, n_actors) int32, updated in place
-    caps,  # (ki, kd, km)
+    caps,  # (ki, kd, km, kp)
     ins_arrays,  # (ins_ref, ins_op, ins_char) each (D, ki) int32
     del_array: np.ndarray,  # (D, kd)
     mark_arrays,  # dict of 8 (D, km) arrays in MARK_COLS order
+    map_arrays,  # dict of 5 (D, kp) arrays in MAP_STREAM_COLS order
 ):
     """One-call round scheduling for every frame-mode doc (see
     pt_schedule_split_batch).  Returns ``(total, n_ins, n_del, n_mark,
-    n_admitted, admitted, status)`` or None when no native library."""
+    n_map, n_admitted, admitted, status)`` or None when no native library."""
     lib = load()
     if lib is None:
         return None
@@ -306,6 +311,7 @@ def schedule_split_batch(
     n_ins = np.zeros(n_docs, np.int32)
     n_del = np.zeros(n_docs, np.int32)
     n_mark = np.zeros(n_docs, np.int32)
+    n_map = np.zeros(n_docs, np.int32)
     n_admitted = np.zeros(n_docs, np.int32)
     admitted = np.zeros(n_changes, np.uint8)
     status = np.zeros(n_docs, np.uint8)
@@ -317,17 +323,19 @@ def schedule_split_batch(
         c(dep_off), c(dep_actor), c(dep_seq),
         c(ops_off), c(ops).reshape(-1),
         clock,
-        int(caps[0]), int(caps[1]), int(caps[2]),
+        int(caps[0]), int(caps[1]), int(caps[2]), int(caps[3]),
         ins_arrays[0], ins_arrays[1], ins_arrays[2],
         del_array,
         mark_arrays["m_action"], mark_arrays["m_type"],
         mark_arrays["m_start_kind"], mark_arrays["m_start_elem"],
         mark_arrays["m_end_kind"], mark_arrays["m_end_elem"],
         mark_arrays["m_op"], mark_arrays["m_attr"],
-        n_ins, n_del, n_mark, n_admitted,
+        map_arrays["p_obj"], map_arrays["p_key"], map_arrays["p_op"],
+        map_arrays["p_kind"], map_arrays["p_val"],
+        n_ins, n_del, n_mark, n_map, n_admitted,
         admitted, status,
     )
-    return total, n_ins, n_del, n_mark, n_admitted, admitted, status
+    return total, n_ins, n_del, n_mark, n_map, n_admitted, admitted, status
 
 
 def varint_encode(values: np.ndarray) -> Optional[bytes]:

@@ -161,14 +161,19 @@ int64_t pt_varint_decode(const uint8_t* in, int64_t nbytes, int32_t* out,
 // vectorizable numpy on these arrays.
 //
 // Column layout of ops[row*10 + c] (kinds: 0 insert, 1 delete, 2 mark,
-// 3 json-spillover, 4 unsupported/undeclared):
+// 3 json-spillover, 4 unsupported/undeclared, 6 map-register op):
 //   c0 kind
 //   c1 obj id, packed (ctr << actor_bits | actor); -1 = ROOT, 0 = n/a
 //   c2 op id, packed
 //   c3 insert: ref elem packed (0 = HEAD) | delete: target elem packed
 //      | mark: action (1 add, 2 remove)   | json: string-table index
+//      | map: key string-table index
 //   c4 insert: codepoint | mark: mark-type index
+//      | map: register value kind (packed.VK_*: 0 del, 1 str, 2 int,
+//        3 true, 4 false, 5 null, 6 child map)
 //   c5 mark: start boundary kind (0 before, 1 after, 2 startOf, 3 endOf)
+//      | map: payload (str: string-table index + 1; int: the value;
+//        child map: its own packed op id)
 //   c6 mark: start elem packed (0 = none)
 //   c7 mark: end boundary kind
 //   c8 mark: end elem packed
@@ -189,7 +194,7 @@ int32_t pt_parse_changes(
     int32_t* ch_actor, int32_t* ch_seq,
     int32_t* dep_off, int32_t* dep_actor, int32_t* dep_seq, int64_t dep_cap,
     int32_t* ops_off, int32_t* ops, int64_t op_cap,
-    int32_t* cnt_ins, int32_t* cnt_del, int32_t* cnt_mark) {
+    int32_t* cnt_ins, int32_t* cnt_del, int32_t* cnt_mark, int32_t* cnt_map) {
     int64_t p = 0;       // cursor into vals
     int64_t nd = 0;      // deps written
     int64_t no = 0;      // op rows written
@@ -240,7 +245,7 @@ int32_t pt_parse_changes(
         if (!nop) return -1;
         int32_t nops = nop[0];
         if (nops < 0) return -1;
-        int32_t ci = 0, cd = 0, cm = 0;
+        int32_t ci = 0, cd = 0, cm = 0, cp = 0;
         for (int32_t k = 0; k < nops; ++k) {
             if (no >= op_cap) return -3;
             int32_t* row = ops + no * 10;
@@ -289,6 +294,30 @@ int32_t pt_parse_changes(
                 if (b[12] < 0 || b[12] > n_strings) return -1;
                 row[9] = b[12];
                 ++cm;
+            } else if (kind == 5 || kind == 7) {  // makeMap / map del: obj(3) opid(2) key
+                const int32_t* b = take(6);
+                if (!b) return -1;
+                if (b[5] < 0 || b[5] >= n_strings) return -1;
+                row[0] = 6;
+                row[1] = b[0] == 0 ? -1 : pack(b[1], b[2], &bad);
+                row[2] = pack(b[3], b[4], &bad);
+                row[3] = b[5];
+                row[4] = (kind == 5) ? 6 : 0;  // VK_OBJ / VK_DELETED
+                row[5] = (kind == 5) ? row[2] : 0;
+                ++cp;
+            } else if (kind == 6) {  // map set: obj(3) opid(2) key vkind payload
+                const int32_t* b = take(8);
+                if (!b) return -1;
+                if (b[5] < 0 || b[5] >= n_strings) return -1;
+                if (b[6] < 1 || b[6] > 5) return -1;  // VK_STR..VK_NULL
+                if (b[6] == 1 && (b[7] < 0 || b[7] >= n_strings)) return -1;
+                row[0] = 6;
+                row[1] = b[0] == 0 ? -1 : pack(b[1], b[2], &bad);
+                row[2] = pack(b[3], b[4], &bad);
+                row[3] = b[5];
+                row[4] = b[6];
+                row[5] = (b[6] == 1) ? b[7] + 1 : b[7];  // str: strid + 1
+                ++cp;
             } else {
                 return -1;  // unknown op kind: frame is corrupt
             }
@@ -299,6 +328,7 @@ int32_t pt_parse_changes(
         cnt_ins[c] = ci;
         cnt_del[c] = cd;
         cnt_mark[c] = cm;
+        cnt_map[c] = cp;
     }
     if (p != n_vals) return -1;  // trailing garbage
     return 0;
@@ -331,12 +361,15 @@ int32_t pt_schedule_split_batch(
     const int32_t* dep_off, const int32_t* dep_actor, const int32_t* dep_seq,
     const int32_t* ops_off, const int32_t* ops,
     int32_t* clock,  // (n_docs, n_actors) row-major, in/out
-    int32_t ki, int32_t kd, int32_t km,
+    int32_t ki, int32_t kd, int32_t km, int32_t kp,
     int32_t* ins_ref, int32_t* ins_op, int32_t* ins_char,
     int32_t* del_target,
     int32_t* m_action, int32_t* m_type, int32_t* m_sk, int32_t* m_se,
     int32_t* m_ek, int32_t* m_ee, int32_t* m_op, int32_t* m_attr,
-    int32_t* n_ins, int32_t* n_del, int32_t* n_mark, int32_t* n_admitted,
+    int32_t* p_obj, int32_t* p_key, int32_t* p_op, int32_t* p_kind,
+    int32_t* p_val,
+    int32_t* n_ins, int32_t* n_del, int32_t* n_mark, int32_t* n_map,
+    int32_t* n_admitted,
     uint8_t* admitted, uint8_t* status) {
     int32_t total_admitted = 0;
     std::vector<int32_t> order;
@@ -352,6 +385,7 @@ int32_t pt_schedule_split_batch(
         int32_t* r_ins_char = ins_char + static_cast<int64_t>(row) * ki;
         int32_t* r_del = del_target + static_cast<int64_t>(row) * kd;
         int64_t mbase = static_cast<int64_t>(row) * km;
+        int64_t pbase = static_cast<int64_t>(row) * kp;
 
         order.clear();
         for (int32_t c = lo; c < hi; ++c) order.push_back(c);
@@ -361,7 +395,7 @@ int32_t pt_schedule_split_batch(
             return a < b;
         });
 
-        int32_t ci = 0, cd = 0, cm = 0, nch = 0;
+        int32_t ci = 0, cd = 0, cm = 0, cp = 0, nch = 0;
         bool demote = false, budget_closed = false, progress = true;
         while (progress && !demote) {
             progress = false;
@@ -376,17 +410,20 @@ int32_t pt_schedule_split_batch(
                 }
                 if (!ok) continue;
                 // count this change's streams
-                int32_t wi = 0, wd = 0, wm = 0;
+                int32_t wi = 0, wd = 0, wm = 0, wp = 0;
                 for (int32_t o = ops_off[c]; o < ops_off[c + 1]; ++o) {
                     const int32_t k = ops[static_cast<int64_t>(o) * 10];
                     if (k == 0) ++wi;
                     else if (k == 1) ++wd;
                     else if (k == 2) ++wm;
+                    else if (k == 6) ++wp;
                     else if (k != 5) { demote = true; break; }  // json/bad left over
                 }
                 if (demote) break;
-                if (wi > ki || wd > kd || wm > km) { demote = true; break; }  // never fits
-                if (ci + wi > ki || cd + wd > kd || cm + wm > km) {
+                if (wi > ki || wd > kd || wm > km || wp > kp) {
+                    demote = true; break;  // never fits
+                }
+                if (ci + wi > ki || cd + wd > kd || cm + wm > km || cp + wp > kp) {
                     budget_closed = true;  // prefix semantics: round is full
                     continue;
                 }
@@ -395,6 +432,16 @@ int32_t pt_schedule_split_batch(
                     const int32_t* r = ops + static_cast<int64_t>(o) * 10;
                     const int32_t k = r[0];
                     if (k == 5) continue;
+                    if (k == 6) {
+                        // map-register op: container is the root or a child
+                        // map (object-kind validation happened at the
+                        // sender's encoder; list objects never produce k=6)
+                        p_obj[pbase + cp] = r[1]; p_key[pbase + cp] = r[3];
+                        p_op[pbase + cp] = r[2]; p_kind[pbase + cp] = r[4];
+                        p_val[pbase + cp] = r[5];
+                        ++cp;
+                        continue;
+                    }
                     if (r[1] != text_obj[d]) { demote = true; break; }
                     if (k == 0) {
                         r_ins_ref[ci] = r[3]; r_ins_op[ci] = r[2]; r_ins_char[ci] = r[4];
@@ -427,12 +474,15 @@ int32_t pt_schedule_split_batch(
             std::memset(r_del, 0, kd * sizeof(int32_t));
             for (int32_t* col : {m_action, m_type, m_sk, m_se, m_ek, m_ee, m_op, m_attr})
                 std::memset(col + mbase, 0, km * sizeof(int32_t));
+            for (int32_t* col : {p_obj, p_key, p_op, p_kind, p_val})
+                std::memset(col + pbase, 0, kp * sizeof(int32_t));
             for (int32_t c = lo; c < hi; ++c) admitted[c] = 0;
-            n_ins[d] = n_del[d] = n_mark[d] = n_admitted[d] = 0;
+            n_ins[d] = n_del[d] = n_mark[d] = n_map[d] = n_admitted[d] = 0;
             status[d] = 1;
             continue;
         }
-        n_ins[d] = ci; n_del[d] = cd; n_mark[d] = cm; n_admitted[d] = nch;
+        n_ins[d] = ci; n_del[d] = cd; n_mark[d] = cm; n_map[d] = cp;
+        n_admitted[d] = nch;
         status[d] = 0;
         total_admitted += nch;
     }
@@ -476,7 +526,7 @@ int32_t pt_parse_frames(
     int32_t* ch_actor, int32_t* ch_seq, int64_t ch_cap,
     int32_t* dep_off, int32_t* dep_actor, int32_t* dep_seq, int64_t dep_cap,
     int32_t* ops_off, int32_t* ops, int64_t op_cap,
-    int32_t* cnt_ins, int32_t* cnt_del, int32_t* cnt_mark) {
+    int32_t* cnt_ins, int32_t* cnt_del, int32_t* cnt_mark, int32_t* cnt_map) {
     std::unordered_map<std::string_view, int32_t> amap;
     amap.reserve(static_cast<size_t>(n_actors) * 2);
     for (int32_t i = 0; i < n_actors; ++i) {
@@ -618,7 +668,7 @@ int32_t pt_parse_frames(
                 if (!nop) { corrupt = true; break; }
                 const int32_t nops = *nop;
                 if (nops < 0) { corrupt = true; break; }
-                int32_t ci = 0, cd = 0, cm = 0;
+                int32_t ci = 0, cd = 0, cm = 0, cp = 0;
                 for (int32_t k = 0; k < nops && !corrupt; ++k) {
                     if (no >= op_cap) return -3;
                     int32_t* row = ops + no * 10;
@@ -670,6 +720,34 @@ int32_t pt_parse_frames(
                             ? 0
                             : static_cast<int32_t>(ns) + (b[12] - 1) + 1;
                         ++cm;
+                    } else if (kind == 5 || kind == 7) {  // makeMap / map del
+                        const int32_t* b = take(6);
+                        if (!b) { corrupt = true; break; }
+                        if (b[5] < 0 || b[5] >= n_strings_f) { corrupt = true; break; }
+                        row[0] = 6;
+                        row[1] = b[0] == 0 ? -1 : pack(b[1], b[2], &bad);
+                        row[2] = pack(b[3], b[4], &bad);
+                        row[3] = static_cast<int32_t>(ns) + b[5];
+                        row[4] = (kind == 5) ? 6 : 0;  // VK_OBJ / VK_DELETED
+                        row[5] = (kind == 5) ? row[2] : 0;
+                        ++cp;
+                    } else if (kind == 6) {  // map set
+                        const int32_t* b = take(8);
+                        if (!b) { corrupt = true; break; }
+                        if (b[5] < 0 || b[5] >= n_strings_f) { corrupt = true; break; }
+                        if (b[6] < 1 || b[6] > 5) { corrupt = true; break; }
+                        if (b[6] == 1 && (b[7] < 0 || b[7] >= n_strings_f)) {
+                            corrupt = true; break;
+                        }
+                        row[0] = 6;
+                        row[1] = b[0] == 0 ? -1 : pack(b[1], b[2], &bad);
+                        row[2] = pack(b[3], b[4], &bad);
+                        row[3] = static_cast<int32_t>(ns) + b[5];
+                        row[4] = b[6];
+                        row[5] = (b[6] == 1)
+                            ? static_cast<int32_t>(ns) + b[7] + 1
+                            : b[7];
+                        ++cp;
                     } else {
                         corrupt = true; break;
                     }
@@ -681,6 +759,7 @@ int32_t pt_parse_frames(
                 cnt_ins[nc] = ci;
                 cnt_del[nc] = cd;
                 cnt_mark[nc] = cm;
+                cnt_map[nc] = cp;
                 ++nc;
             }
             if (!corrupt && p != n_vals) corrupt = true;  // trailing garbage

@@ -30,9 +30,13 @@ class DocMarkDecoder:
     patch diff path (ops/patches.py).  Per-doc rows are sliced once at
     construction; ``marks_at`` is then cheap per visible slot."""
 
-    def __init__(self, resolved: ResolvedDocs, doc_index: int, attr_table: Interner):
+    def __init__(self, resolved: ResolvedDocs, doc_index: int, attr_table: Interner,
+                 comment_table: Interner | None = None):
         d = doc_index
         self._attrs = attr_table
+        # comment-plane ids may live in a separate (per-doc dense) table:
+        # they index capacity-C planes, unlike link attrs which are opaque
+        self._comment_ids = comment_table if comment_table is not None else attr_table
         self.visible = np.asarray(resolved.visible[d])
         self.chars = np.asarray(resolved.char[d])
         self._lww = np.asarray(resolved.lww_active[d])
@@ -54,7 +58,7 @@ class DocMarkDecoder:
             url = self._attrs.lookup(int(self._link_attr[slot]))
             marks["link"] = {"active": True, "url": url}
         active_ids = sorted(
-            self._attrs.lookup(int(c))
+            self._comment_ids.lookup(int(c))
             for c in np.nonzero(self._comments[:, slot])[0]
         )
         if active_ids:
@@ -63,10 +67,11 @@ class DocMarkDecoder:
 
 
 def decode_doc_spans(
-    resolved: ResolvedDocs, doc_index: int, attr_table: Interner
+    resolved: ResolvedDocs, doc_index: int, attr_table: Interner,
+    comment_table: Interner | None = None,
 ) -> List[FormatSpan]:
     """Decode one document of a (numpy-converted) ResolvedDocs batch."""
-    dec = DocMarkDecoder(resolved, doc_index, attr_table)
+    dec = DocMarkDecoder(resolved, doc_index, attr_table, comment_table)
     spans: List[FormatSpan] = []
     for slot in np.nonzero(dec.visible)[0]:
         add_characters_to_spans(
@@ -116,7 +121,10 @@ def decode_doc_root(state, resolved: ResolvedDocs, doc_index: int, keys: Interne
             continue
         by_container.setdefault(int(r_obj[i]), []).append(i)
 
-    def build(obj_id: int) -> dict:
+    def build(obj_id: int, path: frozenset = frozenset()) -> dict:
+        if obj_id in path:  # malformed peer: self/ancestor reference
+            return {}
+        path = path | {obj_id}
         out: dict = {}
         for i in by_container.get(obj_id, ()):
             kind = int(r_kind[i])
@@ -134,7 +142,7 @@ def decode_doc_root(state, resolved: ResolvedDocs, doc_index: int, keys: Interne
             elif kind == VK_NULL:
                 out[key] = None
             elif kind == VK_OBJ:
-                out[key] = build(int(r_val[i]))
+                out[key] = build(int(r_val[i]), path)
             elif kind == VK_TEXT:
                 out[key] = [chr(int(c)) for c in chars[visible]]
         return out

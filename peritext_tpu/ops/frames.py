@@ -35,6 +35,7 @@ KIND_MARK = 2
 KIND_JSON = 3
 KIND_BAD = 4
 KIND_SKIP = 5  # resolved makeList: consumed at parse time, no device op
+KIND_MAP = 6  # map-register op (makeMap / map set / map del)
 
 #: op-matrix columns (see native.cpp): the mark row in device MARK_COLS order
 #: is cols [3, 4, 5, 6, 7, 8, 2, 9].
@@ -55,6 +56,7 @@ class ParsedChanges:
     cnt_ins: np.ndarray  # (N,)
     cnt_del: np.ndarray  # (N,)
     cnt_mark: np.ndarray  # (N,)
+    cnt_map: np.ndarray  # (N,)
 
     @property
     def num_changes(self) -> int:
@@ -64,7 +66,7 @@ class ParsedChanges:
     def empty() -> "ParsedChanges":
         z = lambda *s: np.zeros(s, np.int32)  # noqa: E731
         return ParsedChanges(
-            z(0), z(0), z(1), z(0), z(0), z(1), z(0, 10), z(0), z(0), z(0)
+            z(0), z(0), z(1), z(0), z(0), z(1), z(0, 10), z(0), z(0), z(0), z(0)
         )
 
     def concat(self, other: "ParsedChanges") -> "ParsedChanges":
@@ -96,6 +98,7 @@ class ParsedChanges:
             cnt_ins=cat("cnt_ins"),
             cnt_del=cat("cnt_del"),
             cnt_mark=cat("cnt_mark"),
+            cnt_map=cat("cnt_map"),
         )
 
     def select(self, indices: np.ndarray) -> "ParsedChanges":
@@ -114,6 +117,7 @@ class ParsedChanges:
             cnt_ins=self.cnt_ins[indices],
             cnt_del=self.cnt_del[indices],
             cnt_mark=self.cnt_mark[indices],
+            cnt_map=self.cnt_map[indices],
         )
 
 
@@ -141,6 +145,7 @@ def parse_frame(
     actors: OrderedActorTable,
     attrs: Interner,
     text_obj: int,
+    keys: Interner,
 ) -> Tuple[ParsedChanges, int]:
     """Parse one wire frame into flat arrays on the fast path.
 
@@ -169,7 +174,7 @@ def parse_frame(
     if parsed_raw is None:  # pragma: no cover - guarded by available() above
         raise FrameIngestError("native core unavailable")
     (ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops,
-     cnt_ins, cnt_del, cnt_mark) = parsed_raw
+     cnt_ins, cnt_del, cnt_mark, cnt_map) = parsed_raw
 
     if np.any(ch_actor < 0):
         raise FrameIngestError("undeclared actor in frame")
@@ -220,9 +225,18 @@ def parse_frame(
         for row in np.nonzero(mark_rows & (attr_col > 0))[0]:
             ops[row, 9] = attrs.intern(strings[int(attr_col[row]) - 1])
 
+    map_rows = kinds == KIND_MAP
+    if np.any(map_rows):
+        from .packed import VK_STR
+
+        for row in np.nonzero(map_rows)[0]:
+            ops[row, 3] = keys.intern(strings[int(ops[row, 3])])
+            if ops[row, 4] == VK_STR:
+                ops[row, 5] = keys.intern(strings[int(ops[row, 5]) - 1])
+
     parsed = ParsedChanges(
         ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops,
-        cnt_ins, cnt_del, cnt_mark,
+        cnt_ins, cnt_del, cnt_mark, cnt_map,
     )
     return parsed, text_obj
 
@@ -275,6 +289,8 @@ def parse_frames_bulk(
     attrs: Interner,
     doc_ids: np.ndarray,
     text_obj_by_doc: dict,
+    keys: Interner | None = None,
+    text_key_by_doc: dict | None = None,
 ):
     """Parse MANY concatenated wire frames in one native call (the bulk twin
     of :func:`parse_frame` — per-frame Python eliminated; SURVEY §5.8's
@@ -283,13 +299,19 @@ def parse_frames_bulk(
     ``data`` holds the frames back to back with ``frame_off`` (F+1 int64)
     byte offsets; ``doc_ids[f]`` is the document each frame belongs to and
     ``text_obj_by_doc`` maps doc -> packed text-list id (0 = unknown),
-    updated in place as makeList ops are consumed.
+    updated in place as makeList ops are consumed (``text_key_by_doc``
+    likewise records the root key the text list hangs under).  ``keys`` is
+    the session interner for map keys and string values.
 
     Returns ``(parsed, f_ch_off, status)``: ``parsed`` is one flat
     ParsedChanges across ALL frames (including to-be-demoted ones — slice by
     ``f_ch_off`` and drop by ``status``), statuses per FRAME_* above.
     Returns None when the native core is unavailable.
     """
+    if keys is None:
+        keys = Interner()
+    if text_key_by_doc is None:
+        text_key_by_doc = {}
     if not native.available():
         return None
     if len(actors) - 1 > MAX_ACTORS:
@@ -313,11 +335,12 @@ def parse_frames_bulk(
         return None
     (f_status, f_ch_off, f_str_off, str_start, str_len,
      ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops,
-     cnt_ins, cnt_del, cnt_mark) = out
+     cnt_ins, cnt_del, cnt_mark, cnt_map) = out
     status = f_status.astype(np.int32)
 
     n_frames = len(frame_off) - 1
-    kinds = ops[:, 0]
+    kinds = ops[:, 0]  # NOTE: a view — JSON->map conversion below mutates it
+    native_map_rows = np.nonzero(kinds == KIND_MAP)[0]
 
     def frames_of_ops(rows: np.ndarray) -> np.ndarray:
         changes = np.searchsorted(ops_off, rows, side="right") - 1
@@ -367,6 +390,8 @@ def parse_frames_bulk(
     # OK (a frame that fails mid-way must contribute nothing).
     json_rows = np.nonzero(kinds == KIND_JSON)[0]
     if len(json_rows):
+        from .packed import OBJ_ROOT, VK_TEXT
+
         jr_frames = frames_of_ops(json_rows)
         for f in np.unique(jr_frames):
             if status[f]:
@@ -381,61 +406,96 @@ def parse_frames_bulk(
                         UnicodeDecodeError):
                     status[f] = FRAME_CORRUPT
                     break
-                if op.action != "makeList":
+                if op.action != "makeList" or op.key is None:
                     status[f] = FRAME_DEMOTE
                     break
                 actor_idx = actors.get(op.opid[1])
                 if actor_idx is None or op.opid[0] > MAX_CTR:
                     status[f] = FRAME_DEMOTE
                     break
+                if not isinstance(op.obj, tuple):
+                    pobj = OBJ_ROOT  # the ROOT sentinel (or absent) = root map
+                else:
+                    obj_actor = actors.get(op.obj[1])
+                    if obj_actor is None or op.obj[0] > MAX_CTR:
+                        status[f] = FRAME_DEMOTE
+                        break
+                    pobj = pack_id(op.obj[0], obj_actor)
                 packed = pack_id(op.opid[0], actor_idx)
                 if local_text == 0:
                     local_text = packed
                 elif packed != local_text:
                     status[f] = FRAME_DEMOTE
                     break
-                staged.append(row)
-            if status[f] == FRAME_OK:
+                staged.append((row, pobj, packed, op.key))
+            if status[f] == FRAME_OK and staged:
                 text_obj_by_doc[doc] = local_text
-                for row in staged:
-                    ops[row, 0] = KIND_SKIP
-                    ops[row, 1] = local_text
+                text_key_by_doc[doc] = staged[-1][3]
+                # Rewrite the spillover row into a VK_TEXT map-register row:
+                # the text list placement then competes in register LWW like
+                # any other key (the object path emits the same register),
+                # instead of being host-injected at read time.
+                for row, pobj, packed, key in staged:
+                    ch = int(np.searchsorted(ops_off, row, side="right")) - 1
+                    cnt_map[ch] += 1
+                    ops[row, 0] = KIND_MAP
+                    ops[row, 1] = pobj
+                    ops[row, 2] = packed
+                    ops[row, 3] = keys.intern(key)
+                    ops[row, 4] = VK_TEXT
+                    ops[row, 5] = packed
+                    ops[row, 6:] = 0
 
-    # Session-level attr interning.  Unique by byte CONTENT, not by global
-    # string id: every frame carries its own string table, so the same url /
-    # comment id reappears under thousands of distinct gids at pod scale.
-    # Fully vectorized — group by length, gather an (N, len) byte matrix,
-    # np.unique rows, decode only the handful of distinct strings.
-    attr_rows = np.nonzero((kinds == KIND_MARK) & (ops[:, 9] > 0))[0]
-    if len(attr_rows):
-        gids = ops[attr_rows, 9] - 1
+    # Session-level string interning (mark attrs, map keys, map string
+    # values).  Unique by byte CONTENT, not by global string id: every frame
+    # carries its own string table, so the same url / key reappears under
+    # thousands of distinct gids at pod scale.  Fully vectorized — group by
+    # length, gather an (N, len) byte matrix, np.unique rows, decode only
+    # the handful of distinct strings.
+    def intern_column(rows: np.ndarray, col: int, offset: int, table: Interner):
+        """Rewrite ``ops[rows, col]`` (global strid + offset) to interned
+        ids; flags frames of undecodable strings corrupt."""
+        gids = ops[rows, col] - offset
         starts = str_start[gids]
         lens = str_len[gids]
-        new_ids = np.zeros(len(attr_rows), np.int32)
-        bad_mask = np.zeros(len(attr_rows), bool)
+        new_ids = np.zeros(len(rows), np.int32)
+        bad_mask = np.zeros(len(rows), bool)
         for ln in np.unique(lens):
             sel = np.nonzero(lens == ln)[0]
             if ln == 0:
-                new_ids[sel] = attrs.intern("")
+                new_ids[sel] = table.intern("")
                 continue
             content = buf[starts[sel][:, None] + np.arange(int(ln), dtype=np.int64)]
             uniq_rows, inv = np.unique(content, axis=0, return_inverse=True)
             ids = np.empty(len(uniq_rows), np.int32)
             for j in range(len(uniq_rows)):
                 try:
-                    ids[j] = attrs.intern(uniq_rows[j].tobytes().decode("utf-8"))
+                    ids[j] = table.intern(uniq_rows[j].tobytes().decode("utf-8"))
                 except UnicodeDecodeError:
                     ids[j] = -1  # decode failure: corrupt-frame semantics
             mapped = ids[inv]
             bad_mask[sel] = mapped < 0
             new_ids[sel] = np.maximum(mapped, 0)
         if bad_mask.any():
-            status[frames_of_ops(attr_rows[bad_mask])] = FRAME_CORRUPT
-        ops[attr_rows, 9] = new_ids
+            status[frames_of_ops(rows[bad_mask])] = FRAME_CORRUPT
+        ops[rows, col] = new_ids
+
+    attr_rows = np.nonzero((kinds == KIND_MARK) & (ops[:, 9] > 0))[0]
+    if len(attr_rows):
+        intern_column(attr_rows, col=9, offset=1, table=attrs)
+    # only rows the NATIVE parser emitted carry global string ids; rows the
+    # JSON loop converted above are already interned
+    if len(native_map_rows):
+        from .packed import VK_STR
+
+        intern_column(native_map_rows, col=3, offset=0, table=keys)
+        str_val_rows = native_map_rows[ops[native_map_rows, 4] == VK_STR]
+        if len(str_val_rows):
+            intern_column(str_val_rows, col=5, offset=1, table=keys)
 
     parsed = ParsedChanges(
         ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops,
-        cnt_ins, cnt_del, cnt_mark,
+        cnt_ins, cnt_del, cnt_mark, cnt_map,
     )
     return parsed, f_ch_off, status
 
@@ -475,24 +535,26 @@ def schedule_split(
     parsed: ParsedChanges,
     clock: np.ndarray,
     text_obj: int,
-    caps: Tuple[int, int, int],
+    caps: Tuple[int, int, int, int],
     out_ins: Tuple[np.ndarray, np.ndarray, np.ndarray],
     out_del: np.ndarray,
     out_marks: dict,
+    out_maps: dict,
     n_actors: int,
-) -> Tuple[int, Tuple[int, int, int], ParsedChanges]:
+) -> Tuple[int, Tuple[int, int, int, int], ParsedChanges]:
     """One round: admit the longest causally-valid prefix that fits the
     static stream widths, split its ops into the caller's padded row views,
     and advance ``clock`` in place.
 
-    Returns ``(changes_admitted, (n_ins, n_del, n_mark), deferred)``.
-    Raises FrameIngestError if an admitted op targets an object other than
-    the doc's text list (the caller demotes the doc).
+    Returns ``(changes_admitted, (n_ins, n_del, n_mark, n_map), deferred)``.
+    Raises FrameIngestError if an admitted list op targets an object other
+    than the doc's text list (the caller demotes the doc); map-register ops
+    (KIND_MAP) may target any map object.
     """
     n = parsed.num_changes
     if n == 0:
-        return 0, (0, 0, 0), parsed
-    ki, kd, km = caps
+        return 0, (0, 0, 0, 0), parsed
+    ki, kd, km, kp = caps
 
     stale = parsed.ch_seq <= clock[parsed.ch_actor]
     order = native.causal_schedule_indices(
@@ -507,6 +569,7 @@ def schedule_split(
         (np.cumsum(parsed.cnt_ins[order]) <= ki)
         & (np.cumsum(parsed.cnt_del[order]) <= kd)
         & (np.cumsum(parsed.cnt_mark[order]) <= km)
+        & (np.cumsum(parsed.cnt_map[order]) <= kp)
     )
     cut = int(np.argmax(~fits)) if not fits.all() else len(order)
     if cut == 0 and len(order) > 0:
@@ -515,19 +578,20 @@ def schedule_split(
         raise FrameIngestError("a single change exceeds the round stream widths")
     admitted = order[:cut]
     if len(admitted) == 0:
-        return 0, (0, 0, 0), parsed.select(np.nonzero(~stale)[0])
+        return 0, (0, 0, 0, 0), parsed.select(np.nonzero(~stale)[0])
 
     ops_idx, _ = _ragged_gather(parsed.ops_off, admitted)
     sel = parsed.ops[ops_idx]
     kinds = sel[:, 0]
-    live = kinds != KIND_SKIP
+    live = (kinds != KIND_SKIP) & (kinds != KIND_MAP)
     if not np.all((sel[:, 1][live] == text_obj)):
         raise FrameIngestError("op on non-text object on fast path")
 
     ins = sel[kinds == KIND_INS]
     dels = sel[kinds == KIND_DEL]
     marks = sel[kinds == KIND_MARK]
-    ni, nd, nm = len(ins), len(dels), len(marks)
+    maps = sel[kinds == KIND_MAP]
+    ni, nd, nm, np_ = len(ins), len(dels), len(marks), len(maps)
     ins_ref, ins_op, ins_char = out_ins
     ins_ref[:ni] = ins[:, 3]
     ins_op[:ni] = ins[:, 2]
@@ -539,10 +603,14 @@ def schedule_split(
         _MARK_COL_ORDER,
     ):
         out_marks[col_name][:nm] = marks[:, col]
+    for col_name, col in zip(
+        ("p_obj", "p_key", "p_op", "p_kind", "p_val"), (1, 3, 2, 4, 5)
+    ):
+        out_maps[col_name][:np_] = maps[:, col]
 
     np.maximum.at(clock, parsed.ch_actor[admitted], parsed.ch_seq[admitted])
 
     admitted_mask = np.zeros(n, bool)
     admitted_mask[admitted] = True
     deferred = parsed.select(np.nonzero(~admitted_mask & ~stale)[0])
-    return len(admitted), (ni, nd, nm), deferred
+    return len(admitted), (ni, nd, nm, np_), deferred

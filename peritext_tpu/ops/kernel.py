@@ -144,9 +144,6 @@ def _post_insert_doc(state: PackedDocs, del_target, mark_rows, mark_count):
     )
 
 
-#: map-op stream columns (encode.py fills, _apply_map_doc consumes) —
-#: single canonical definition in packed.py
-from .packed import MAP_STREAM_COLS as MAP_COLS  # noqa: E402
 
 
 def _apply_map_doc(state: PackedDocs, p_obj, p_key, p_op, p_kind, p_val, count):
@@ -269,12 +266,13 @@ def _pad_from_flat(flat, counts, width: int):
 
 def apply_batch_compact(
     state: PackedDocs,
-    stream_counts,  # (n_ins, n_del, n_mark) each (D,) int32
+    stream_counts,  # (n_ins, n_del, n_mark, n_map) each (D,) int32
     ins_flat,  # (ref, op, char) each (N_i,) int32
     del_flat,  # (N_d,) int32
     mark_flat,  # dict col -> (N_m,) int32 in MARK_COLS order
+    map_flat=None,  # dict col -> (N_p,) int32, packed.MAP_STREAM_COLS (optional)
     *,
-    widths,  # static (ki, kd, km) padded stream widths
+    widths,  # static (ki, kd, km[, kp]) padded stream widths
     insert_impl: str = "auto",
     insert_loop_slots: int | None = None,
 ) -> PackedDocs:
@@ -285,16 +283,23 @@ def apply_batch_compact(
     this cuts per-round transfer several-fold.  Flat arrays may carry
     power-of-two padding at the END (zero rows beyond sum(counts) are never
     gathered into a live slot)."""
-    n_ins, n_del, n_mark = stream_counts
-    ki, kd, km = widths
+    n_ins, n_del, n_mark = stream_counts[0], stream_counts[1], stream_counts[2]
+    ki, kd, km = widths[0], widths[1], widths[2]
     ins_ref = _pad_from_flat(ins_flat[0], n_ins, ki)
     ins_op = _pad_from_flat(ins_flat[1], n_ins, ki)
     ins_char = _pad_from_flat(ins_flat[2], n_ins, ki)
     del_target = _pad_from_flat(del_flat, n_del, kd)
     marks = {col: _pad_from_flat(mark_flat[col], n_mark, km) for col in mark_flat}
+    arrays = (ins_ref, ins_op, ins_char, del_target, marks,
+              n_mark.astype(jnp.int32))
+    if map_flat is not None:
+        n_map = stream_counts[3]
+        kp = widths[3]
+        maps = {col: _pad_from_flat(map_flat[col], n_map, kp) for col in map_flat}
+        arrays = arrays + (maps, n_map.astype(jnp.int32))
     return apply_batch(
         state,
-        (ins_ref, ins_op, ins_char, del_target, marks, n_mark.astype(jnp.int32)),
+        arrays,
         insert_impl=insert_impl,
         insert_loop_slots=insert_loop_slots,
     )
@@ -307,14 +312,14 @@ _apply_batch_compact_jit = jax.jit(
 
 
 def apply_batch_compact_jit(state, stream_counts, ins_flat, del_flat, mark_flat,
-                            *, widths, insert_impl: str = "auto",
+                            map_flat=None, *, widths, insert_impl: str = "auto",
                             insert_loop_slots: int | None = None) -> PackedDocs:
     """jit-compiled :func:`apply_batch_compact` (``"auto"`` resolved at the
     boundary, as in :func:`apply_batch_jit`)."""
     if insert_impl == "auto":
         insert_impl = resolve_insert_impl(state.elem_id)
     return _apply_batch_compact_jit(
-        state, stream_counts, ins_flat, del_flat, mark_flat,
+        state, stream_counts, ins_flat, del_flat, mark_flat, map_flat,
         widths=widths, insert_impl=insert_impl,
         insert_loop_slots=insert_loop_slots,
     )

@@ -37,6 +37,7 @@ def doc_chars_device(
     attr_table: Interner,
     elem_ids: np.ndarray,
     actor_table: OrderedActorTable,
+    comment_table: "Interner | None" = None,
 ) -> List[CharState]:
     """Per-character (identity, char, marks) for one device doc.  Identities
     are unpacked to ``(ctr, actor_string)`` so they are stable across the
@@ -45,7 +46,7 @@ def doc_chars_device(
     (decode.DocMarkDecoder) so the two can never diverge."""
     from .decode import DocMarkDecoder
 
-    dec = DocMarkDecoder(resolved, doc_index, attr_table)
+    dec = DocMarkDecoder(resolved, doc_index, attr_table, comment_table)
     out: List[CharState] = []
     for slot in np.nonzero(dec.visible)[0]:
         ctr, actor_idx = unpack_id(int(elem_ids[slot]))

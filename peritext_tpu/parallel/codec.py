@@ -38,6 +38,13 @@ _BK_TO_INT = {BEFORE: 0, AFTER: 1, START_OF_TEXT: 2, END_OF_TEXT: 3}
 _INT_TO_BK = {v: k for k, v in _BK_TO_INT.items()}
 
 _OP_INSERT, _OP_DEL, _OP_ADDMARK, _OP_REMOVEMARK, _OP_JSON = 0, 1, 2, 3, 4
+# map-object ops (device map-register path; reference map LWW
+# src/micromerge.ts:1151-1175)
+_OP_MAKEMAP, _OP_MAPSET, _OP_MAPDEL = 5, 6, 7
+
+# value-kind encoding inside _OP_MAPSET (packed.VK_*: 1 str, 2 int, 3 true,
+# 4 false, 5 null — VK_STR payload is a string-table index)
+_VK_STR, _VK_INT, _VK_TRUE, _VK_FALSE, _VK_NULL = 1, 2, 3, 4, 5
 
 
 # -- pure-python varint fallback (same bytes as the native core) ------------
@@ -148,6 +155,29 @@ def _flatten_op(op: Operation, table: _StringTable, ints: List[int]) -> None:
             *boundary(op.end),
             attr_idx,
         ]
+    elif op.action == "makeMap" and op.key is not None:
+        ints += [_OP_MAKEMAP, *obj_triple(op.obj), *opid_pair(op.opid),
+                 table.intern(op.key)]
+    elif (
+        op.action == "del" and op.key is not None and op.elem_id is None
+    ):
+        ints += [_OP_MAPDEL, *obj_triple(op.obj), *opid_pair(op.opid),
+                 table.intern(op.key)]
+    elif op.action == "set" and not op.insert and op.key is not None:
+        v = op.value
+        if isinstance(v, bool):
+            enc = (_VK_TRUE if v else _VK_FALSE, 0)
+        elif v is None:
+            enc = (_VK_NULL, 0)
+        elif isinstance(v, str):
+            enc = (_VK_STR, table.intern(v))
+        elif isinstance(v, int) and -(2**31) <= v < 2**31:
+            enc = (_VK_INT, v)
+        else:  # floats / containers: JSON spillover keeps the codec lossless
+            ints += [_OP_JSON, table.intern(json.dumps(op.to_json()))]
+            return
+        ints += [_OP_MAPSET, *obj_triple(op.obj), *opid_pair(op.opid),
+                 table.intern(op.key), *enc]
     else:
         ints += [_OP_JSON, table.intern(json.dumps(op.to_json()))]
 
@@ -215,6 +245,34 @@ def _read_op(r: _IntReader, strings: List[str]) -> Operation:
     obj = obj_of(r.take(3))
     ctr, actor = r.take(2)
     opid = (ctr, _string(strings, actor))
+    if kind == _OP_MAKEMAP:
+        (key_idx,) = r.take()
+        return Operation(
+            action="makeMap", obj=obj, opid=opid, key=_string(strings, key_idx)
+        )
+    if kind == _OP_MAPDEL:
+        (key_idx,) = r.take()
+        return Operation(
+            action="del", obj=obj, opid=opid, key=_string(strings, key_idx)
+        )
+    if kind == _OP_MAPSET:
+        key_idx, vkind, payload = r.take(3)
+        if vkind == _VK_STR:
+            value = _string(strings, payload)
+        elif vkind == _VK_INT:
+            value = payload
+        elif vkind == _VK_TRUE:
+            value = True
+        elif vkind == _VK_FALSE:
+            value = False
+        elif vkind == _VK_NULL:
+            value = None
+        else:
+            raise ValueError(f"unknown map value kind {vkind}")
+        return Operation(
+            action="set", obj=obj, opid=opid, key=_string(strings, key_idx),
+            value=value,
+        )
     if kind == _OP_INSERT:
         flag, rctr, ractor, cp = r.take(4)
         elem = HEAD if flag == 0 else (rctr, _string(strings, ractor))

@@ -49,17 +49,19 @@ from ..core.types import Change, Clock, FormatSpan
 from ..observability import GLOBAL_COUNTERS
 from ..ops.decode import decode_doc_spans
 from ..ops.encode import DocEncoder, _DocStreams
-from ..ops.encode import MARK_COLS
+from ..ops.encode import MAP_STREAM_COLS, MARK_COLS
 from ..ops.frames import (
     FRAME_CORRUPT,
     FRAME_DEMOTE,
+    KIND_MARK,
     FrameIngestError,
     ParsedChanges,
     parse_frames_bulk,
     schedule_split,
 )
+from ..schema import MARK_INDEX
 from ..ops.kernel import apply_batch_jit, encoded_arrays_of
-from ..ops.packed import VK_TEXT, PackedDocs, empty_docs
+from ..ops.packed import PackedDocs, empty_docs
 from ..ops.resolve import resolve, resolve_jit
 from ..utils.interning import Interner, OrderedActorTable
 from .causal import causal_schedule
@@ -92,6 +94,7 @@ class _DocSession:
     frame_mode: bool = False
     frames: List[bytes] = field(default_factory=list)
     text_obj: int = 0
+    text_key: Optional[str] = None  # root key the text list hangs under
 
 
 class _RoundBuffers:
@@ -103,17 +106,20 @@ class _RoundBuffers:
     to what kernel.encoded_arrays_of consumes."""
 
     __slots__ = ("ins_ref", "ins_op", "ins_char", "del_target", "marks",
-                 "ins_count", "del_count", "mark_count", "num_ops")
+                 "map_ops", "ins_count", "del_count", "mark_count",
+                 "map_count", "num_ops")
 
-    def __init__(self, d: int, ki: int, kd: int, km: int) -> None:
+    def __init__(self, d: int, ki: int, kd: int, km: int, kp: int) -> None:
         self.ins_ref = np.zeros((d, ki), np.int32)
         self.ins_op = np.zeros((d, ki), np.int32)
         self.ins_char = np.zeros((d, ki), np.int32)
         self.del_target = np.zeros((d, kd), np.int32)
         self.marks = {col: np.zeros((d, km), np.int32) for col in MARK_COLS}
+        self.map_ops = {col: np.zeros((d, kp), np.int32) for col in MAP_STREAM_COLS}
         self.ins_count = np.zeros(d, np.int32)
         self.del_count = np.zeros(d, np.int32)
         self.mark_count = np.zeros(d, np.int32)
+        self.map_count = np.zeros(d, np.int32)
         self.num_ops = np.zeros(d, np.int32)
 
 
@@ -135,14 +141,17 @@ class StreamingMerge:
         round_insert_capacity: int = 64,
         round_delete_capacity: int = 32,
         round_mark_capacity: int = 32,
+        round_map_capacity: int = 16,
         comment_capacity: int = 32,
+        map_capacity: int = 32,
         read_chunk: int = 8192,
         mesh=None,
     ) -> None:
         self.num_docs = num_docs
         self.actors = list(actors)
         self.mesh = mesh
-        self.round_caps = (round_insert_capacity, round_delete_capacity, round_mark_capacity)
+        self.round_caps = (round_insert_capacity, round_delete_capacity,
+                           round_mark_capacity, round_map_capacity)
         self.comment_capacity = comment_capacity
         # Sharding needs equal shards: pad the DEVICE doc axis up to a mesh
         # multiple; padded rows are permanently empty docs (all-zero streams
@@ -170,9 +179,15 @@ class StreamingMerge:
         self._frame_mode = np.zeros(num_docs, bool)
         self._clock_mat = np.zeros((num_docs, len(self._actor_table)), np.int32)
         self._frame_attrs = Interner()
+        # map keys + string values share one session interner (read_root)
+        self._map_keys = Interner()
+        # comment-mark ids must be PER-DOC dense (they index the capacity-C
+        # comment planes); link urls etc. stay in the session table
+        self._doc_comment_ids: Dict[int, Interner] = {}
         # object-path docs with pending changes (so step() never scans all D)
         self._object_pending: set = set()
-        state = empty_docs(self._padded_docs, slot_capacity, mark_capacity, tomb_capacity)
+        state = empty_docs(self._padded_docs, slot_capacity, mark_capacity,
+                           tomb_capacity, map_capacity=map_capacity)
         self.state: PackedDocs = shard_docs(state, mesh) if mesh is not None else state
 
     # -- ingestion ---------------------------------------------------------
@@ -238,6 +253,7 @@ class StreamingMerge:
             [[0], np.cumsum([len(f) for f in frames], dtype=np.int64)]
         ).astype(np.int64)
         text_objs: Dict[int, int] = {}
+        text_keys: Dict[int, str] = {}
         for d in doc_ids:
             d = int(d)
             sess = self.docs[d]
@@ -245,10 +261,13 @@ class StreamingMerge:
                 sess.frame_mode = True
                 self._frame_mode[d] = True
             text_objs.setdefault(d, sess.text_obj)
+            if sess.text_key is not None:
+                text_keys.setdefault(d, sess.text_key)
 
         out = parse_frames_bulk(
             b"".join(frames), frame_off, self._actor_table,
             self._frame_attrs, doc_ids, text_objs,
+            keys=self._map_keys, text_key_by_doc=text_keys,
         )
         if out is None:  # pragma: no cover - native.available() checked
             corrupt = []
@@ -259,6 +278,29 @@ class StreamingMerge:
                     corrupt.append(int(d))
             return corrupt
         parsed, f_ch_off, status = out
+
+        # Re-map comment-mark attr ids from the session table to PER-DOC
+        # dense ids: comment ids index capacity-C resolution planes, and a
+        # session-wide numbering would overflow every doc's capacity once
+        # the session has seen more than C distinct ids anywhere.
+        ops = parsed.ops
+        sel = np.nonzero(
+            (ops[:, 0] == KIND_MARK)
+            & (ops[:, 4] == MARK_INDEX["comment"])
+            & (ops[:, 9] > 0)
+        )[0]
+        if len(sel):
+            ch_idx = np.searchsorted(parsed.ops_off, sel, side="right") - 1
+            f_idx = np.searchsorted(f_ch_off, ch_idx, side="right") - 1
+            docs_of_rows = doc_ids[f_idx].astype(np.int64)
+            keycode = (docs_of_rows << 32) | ops[sel, 9].astype(np.int64)
+            uniq, inv = np.unique(keycode, return_inverse=True)
+            local_ids = np.empty(len(uniq), np.int32)
+            for j, kc in enumerate(uniq):
+                doc, gid = int(kc >> 32), int(kc & 0xFFFFFFFF)
+                table = self._doc_comment_ids.setdefault(doc, Interner())
+                local_ids[j] = table.intern(self._frame_attrs.lookup(gid))
+            ops[sel, 9] = local_ids[inv]
 
         # Per-frame bookkeeping in arrival order: a demotion mid-call routes
         # the same doc's later frames to the object path (its pooled changes
@@ -288,6 +330,8 @@ class StreamingMerge:
             else:
                 sess.frames.append(data)
                 sess.text_obj = text_objs[d]
+                if d in text_keys:
+                    sess.text_key = text_keys[d]
                 keep_frame[f] = True
 
         if keep_frame.all() and parsed.num_changes:
@@ -320,6 +364,7 @@ class StreamingMerge:
         self._frame_mode[doc_index] = False
         sess.frames = []
         sess.text_obj = 0
+        sess.text_key = None
         sess.fallback = True
         GLOBAL_COUNTERS.add("streaming.fallback_docs")
 
@@ -332,7 +377,7 @@ class StreamingMerge:
         dispatched asynchronously; the caller may immediately ingest and
         schedule the next round while the TPU runs this one.
         """
-        ki, kd, km = self.round_caps
+        ki, kd, km, kp = self.round_caps
         scheduled = 0
 
         # ---- object-path docs (editor-style sessions): per-doc encode ----
@@ -350,8 +395,8 @@ class StreamingMerge:
             # budget the round to the static stream widths: admit a prefix
             # whose stream usage fits; the rest waits (shapes stay constant,
             # docs just take extra rounds)
-            admitted, deferred = self._budget(ordered, ki, kd, km)
-            if not admitted and ordered and self._never_fits(ordered[0], ki, kd, km):
+            admitted, deferred = self._budget(ordered, ki, kd, km, kp)
+            if not admitted and ordered and self._never_fits(ordered[0], ki, kd, km, kp):
                 # a single change larger than a round width can never be
                 # admitted: demote instead of wedging the doc (and every
                 # change behind it) forever — the frame path's batched
@@ -359,13 +404,6 @@ class StreamingMerge:
                 sess.fallback = True
                 GLOBAL_COUNTERS.add("streaming.fallback_docs")
             streams, ok = sess.encoder.encode_increment(admitted)
-            if any(row[3] != VK_TEXT for row in streams.maps):
-                # map-register rounds are not wired into the streaming round
-                # buffers yet; until then a map op demotes the doc (replay
-                # stays correct), exactly as before the device map path.
-                # (The text list's own VK_TEXT register row is host-tracked
-                # via the encoder's text_obj/text_key and safe to drop here.)
-                ok = False
             if not ok:
                 sess.fallback = True
                 GLOBAL_COUNTERS.add("streaming.fallback_docs")
@@ -373,7 +411,7 @@ class StreamingMerge:
                 for ch in admitted:
                     sess.clock[ch.actor] = ch.seq
                 scheduled += len(admitted)
-                if streams.ins or streams.dels or streams.marks:
+                if streams.ins or streams.dels or streams.marks or streams.maps:
                     obj_streams[i] = streams
             sess.log.extend(admitted)
             sess.pending = deferred + stuck
@@ -392,9 +430,9 @@ class StreamingMerge:
         # (host->device transfer every round), so trickle rounds shrink them.
         # One shared power-of-two shift keeps the apply-program variant count
         # logarithmic; any doc with large pending work keeps the full widths.
-        ki, kd, km = self._round_widths(pool, obj_streams, ki, kd, km)
+        ki, kd, km, kp = self._round_widths(pool, obj_streams, ki, kd, km, kp)
 
-        enc = _RoundBuffers(self._padded_docs, ki, kd, km)
+        enc = _RoundBuffers(self._padded_docs, ki, kd, km, kp)
         for i, streams in obj_streams.items():
             if streams.ins:
                 arr = np.asarray(streams.ins, np.int32)
@@ -408,17 +446,23 @@ class StreamingMerge:
                 for c, col in enumerate(MARK_COLS):
                     enc.marks[col][i, : len(arr)] = arr[:, c]
                 enc.mark_count[i] = len(arr)
+            if streams.maps:
+                arr = np.asarray(streams.maps, np.int32)
+                for c, col in enumerate(MAP_STREAM_COLS):
+                    enc.map_ops[col][i, : len(arr)] = arr[:, c]
+                enc.map_count[i] = len(arr)
             enc.ins_count[i] = len(streams.ins)
             enc.del_count[i] = len(streams.dels)
             enc.num_ops[i] = (
-                len(streams.ins) + len(streams.dels) + len(streams.marks)
+                len(streams.ins) + len(streams.dels)
+                + len(streams.marks) + len(streams.maps)
             )
 
         # Frame-native pass: ONE C++ call schedules + splits every frame-mode
         # doc's pooled parsed changes into its padded row (the per-doc Python
         # version is the no-native fallback).
         if pool is not None:
-            scheduled += self._step_frame_docs(pool, enc, (ki, kd, km))
+            scheduled += self._step_frame_docs(pool, enc, (ki, kd, km, kp))
 
         if scheduled == 0:
             return 0
@@ -430,7 +474,7 @@ class StreamingMerge:
         else:
             # single-device path: ship flat streams proportional to real ops
             # and rebuild the padded layout on device (kernel._pad_from_flat)
-            self.state = self._apply_compact(enc, (ki, kd, km))
+            self.state = self._apply_compact(enc, (ki, kd, km, kp))
         self.rounds += 1
         GLOBAL_COUNTERS.add("streaming.rounds")
         GLOBAL_COUNTERS.add("streaming.scheduled_changes", scheduled)
@@ -442,10 +486,11 @@ class StreamingMerge:
         counts instead of the mostly-zero (D, K) staging rows."""
         from ..ops.kernel import apply_batch_compact_jit
 
-        ki, kd, km = widths
+        ki, kd, km, kp = widths
         mi = np.arange(ki, dtype=np.int32)[None, :] < enc.ins_count[:, None]
         md = np.arange(kd, dtype=np.int32)[None, :] < enc.del_count[:, None]
         mm = np.arange(km, dtype=np.int32)[None, :] < enc.mark_count[:, None]
+        mp = np.arange(kp, dtype=np.int32)[None, :] < enc.map_count[:, None]
 
         def pad(v: np.ndarray) -> np.ndarray:
             cap = 8
@@ -459,19 +504,21 @@ class StreamingMerge:
 
         return apply_batch_compact_jit(
             self.state,
-            (enc.ins_count, enc.del_count, enc.mark_count),
+            (enc.ins_count, enc.del_count, enc.mark_count, enc.map_count),
             (pad(enc.ins_ref[mi]), pad(enc.ins_op[mi]), pad(enc.ins_char[mi])),
             pad(enc.del_target[md]),
             {col: pad(enc.marks[col][mm]) for col in MARK_COLS},
+            {col: pad(enc.map_ops[col][mp]) for col in MAP_STREAM_COLS},
             widths=widths,
         )
 
-    def _round_widths(self, pool, obj_streams, ki: int, kd: int, km: int):
+    def _round_widths(self, pool, obj_streams, ki: int, kd: int, km: int, kp: int):
         """Shrink this round's stream widths by a shared power-of-two shift
         while every doc's pending need (clamped at the session caps) fits."""
         need_i = max((len(s.ins) for s in obj_streams.values()), default=0)
         need_d = max((len(s.dels) for s in obj_streams.values()), default=0)
         need_m = max((len(s.marks) for s in obj_streams.values()), default=0)
+        need_p = max((len(s.maps) for s in obj_streams.values()), default=0)
         if pool is not None:
             doc_of, parsed = pool
             starts = np.nonzero(
@@ -480,14 +527,16 @@ class StreamingMerge:
             need_i = max(need_i, min(ki, int(np.add.reduceat(parsed.cnt_ins, starts).max())))
             need_d = max(need_d, min(kd, int(np.add.reduceat(parsed.cnt_del, starts).max())))
             need_m = max(need_m, min(km, int(np.add.reduceat(parsed.cnt_mark, starts).max())))
+            need_p = max(need_p, min(kp, int(np.add.reduceat(parsed.cnt_map, starts).max())))
         shift = 0
         while (
             (ki >> (shift + 1)) >= max(need_i, 8)
             and (kd >> (shift + 1)) >= max(need_d, 8)
             and (km >> (shift + 1)) >= max(need_m, 8)
+            and (kp >> (shift + 1)) >= max(need_p, 8)
         ):
             shift += 1
-        return ki >> shift, kd >> shift, km >> shift
+        return ki >> shift, kd >> shift, km >> shift, kp >> shift
 
     def _gather_pool(self):
         """Merge pooled parsed-change chunks into one doc-grouped batch:
@@ -541,14 +590,16 @@ class StreamingMerge:
             (enc.ins_ref, enc.ins_op, enc.ins_char),
             enc.del_target,
             enc.marks,
+            enc.map_ops,
         )
         if batch is None:  # pragma: no cover - available() checked above
             return self._step_frame_docs_python(pool, enc, caps)
 
-        _, n_ins, n_del, n_mark, n_admitted, admitted, status = batch
+        _, n_ins, n_del, n_mark, n_map, n_admitted, admitted, status = batch
         self._clock_mat[frame_docs] = clock
         enc.mark_count[frame_docs] = n_mark
-        enc.num_ops[frame_docs] = n_ins + n_del + n_mark
+        enc.map_count[frame_docs] = n_map
+        enc.num_ops[frame_docs] = n_ins + n_del + n_mark + n_map
         scheduled = int(n_admitted.sum())
 
         enc.ins_count[frame_docs] = n_ins
@@ -561,6 +612,7 @@ class StreamingMerge:
                 enc.ins_count[i] = 0
                 enc.del_count[i] = 0
                 enc.mark_count[i] = 0
+                enc.map_count[i] = 0
                 enc.num_ops[i] = 0
                 self._demote_frame_doc(i)  # folds + zeroes the doc's clock row
 
@@ -575,7 +627,7 @@ class StreamingMerge:
     def _step_frame_docs_python(self, pool, enc, caps) -> int:
         """Per-doc Python fallback (no native library)."""
         doc_of, parsed = pool
-        ki, kd, km = caps
+        ki, kd, km, kp = caps
         scheduled = 0
         frame_docs = np.unique(doc_of)
         bounds = np.concatenate(
@@ -588,19 +640,22 @@ class StreamingMerge:
                 np.arange(bounds[j], bounds[j + 1], dtype=np.int64)
             )
             try:
-                nch, (ni, nd, nm), deferred = schedule_split(
+                nch, (ni, nd, nm, np_), deferred = schedule_split(
                     doc_parsed,
                     self._clock_mat[i],  # row view: advanced in place
                     sess.text_obj,
-                    (ki, kd, km),
+                    (ki, kd, km, kp),
                     (enc.ins_ref[i], enc.ins_op[i], enc.ins_char[i]),
                     enc.del_target[i],
                     {col: enc.marks[col][i] for col in enc.marks},
+                    {col: enc.map_ops[col][i] for col in enc.map_ops},
                     len(self._actor_table),
                 )
             except FrameIngestError:
                 for col in enc.marks:  # discard any partial row writes
                     enc.marks[col][i] = 0
+                for col in enc.map_ops:
+                    enc.map_ops[col][i] = 0
                 enc.ins_ref[i] = 0
                 enc.ins_op[i] = 0
                 enc.ins_char[i] = 0
@@ -614,7 +669,8 @@ class StreamingMerge:
             enc.ins_count[i] = ni
             enc.del_count[i] = nd
             enc.mark_count[i] = nm
-            enc.num_ops[i] = ni + nd + nm
+            enc.map_count[i] = np_
+            enc.num_ops[i] = ni + nd + nm + np_
             scheduled += nch
         return scheduled
 
@@ -627,29 +683,36 @@ class StreamingMerge:
 
     @staticmethod
     def _op_counts(change: Change) -> tuple:
-        """(inserts, deletes, marks) — the round-width cost model shared by
-        admission budgeting and the never-fits demotion check."""
-        ci = sum(1 for op in change.ops if op.action == "set" and op.insert)
-        cd = sum(1 for op in change.ops if op.action == "del")
-        cm = sum(1 for op in change.ops if op.action in ("addMark", "removeMark"))
-        return ci, cd, cm
+        """(inserts, deletes, marks, map-register ops) — the round-width cost
+        model shared by admission budgeting and the never-fits check."""
+        ci = cd = cm = cp = 0
+        for op in change.ops:
+            if op.action == "set" and op.insert:
+                ci += 1
+            elif op.action == "del" and op.elem_id is not None:
+                cd += 1
+            elif op.action in ("addMark", "removeMark"):
+                cm += 1
+            else:  # map set/del/makeMap/makeList -> one register row
+                cp += 1
+        return ci, cd, cm, cp
 
     @classmethod
-    def _never_fits(cls, change: Change, ki: int, kd: int, km: int) -> bool:
-        ci, cd, cm = cls._op_counts(change)
-        return ci > ki or cd > kd or cm > km
+    def _never_fits(cls, change: Change, ki: int, kd: int, km: int, kp: int) -> bool:
+        ci, cd, cm, cp = cls._op_counts(change)
+        return ci > ki or cd > kd or cm > km or cp > kp
 
     @classmethod
-    def _budget(cls, ordered: List[Change], ki: int, kd: int, km: int):
+    def _budget(cls, ordered: List[Change], ki: int, kd: int, km: int, kp: int):
         """Admit the longest causal prefix whose op streams fit the static
         round widths."""
-        ins = dels = marks = 0
+        ins = dels = marks = maps = 0
         admitted: List[Change] = []
         for idx, ch in enumerate(ordered):
-            ci, cd, cm = cls._op_counts(ch)
-            if ins + ci > ki or dels + cd > kd or marks + cm > km:
+            ci, cd, cm, cp = cls._op_counts(ch)
+            if ins + ci > ki or dels + cd > kd or marks + cm > km or maps + cp > kp:
                 return admitted, ordered[idx:]
-            ins, dels, marks = ins + ci, dels + cd, marks + cm
+            ins, dels, marks, maps = ins + ci, dels + cd, marks + cm, maps + cp
             admitted.append(ch)
         return admitted, []
 
@@ -663,10 +726,12 @@ class StreamingMerge:
             return [ch for f in sess.frames for ch in decode_frame(f)]
         return sess.log + sess.pending
 
-    def _attr_table(self, sess: _DocSession):
+    def _attr_tables(self, sess: _DocSession, doc_index: int):
+        """(link/general attr table, per-doc comment-id table) for decode."""
         if sess.frame_mode:
-            return self._frame_attrs
-        return sess.encoder.attrs if sess.encoder else None
+            return self._frame_attrs, self._doc_comment_ids.get(doc_index)
+        attrs = sess.encoder.attrs if sess.encoder else None
+        return attrs, attrs  # object path interns per doc already
 
     # -- block-cached resolution ------------------------------------------
     #
@@ -718,7 +783,8 @@ class StreamingMerge:
         resolved, local = self._resolved_doc(doc_index)
         if bool(resolved.overflow[local]):
             return _replay_spans(self._replay_changes(sess))
-        return decode_doc_spans(resolved, local, self._attr_table(sess))
+        attrs, comments = self._attr_tables(sess, doc_index)
+        return decode_doc_spans(resolved, local, attrs, comments)
 
     def read_patches(self, doc_index: int) -> List:
         """Incremental reference-shaped patches since this doc's previous
@@ -744,12 +810,14 @@ class StreamingMerge:
         resolved, local = self._resolved_doc(doc_index)
         if bool(resolved.overflow[local]):
             return doc_chars_scalar(_replay_doc(self._replay_changes(sess)))
+        attrs, comments = self._attr_tables(sess, doc_index)
         return doc_chars_device(
             resolved,
             local,
-            self._attr_table(sess),
+            attrs,
             np.asarray(self.state.elem_id[doc_index]),
             self._actor_table,
+            comments,
         )
 
     def resolve_cursors(self, doc_index: int, cursors) -> List[int]:
@@ -800,6 +868,33 @@ class StreamingMerge:
             out[d] = oracle_cursor_positions(doc, cursor_map[d])
         return out
 
+    def read_root(self, doc_index: int) -> dict:
+        """Materialize one doc's root map (nested maps + the text character
+        list) — the streaming twin of MergeReport.roots: device docs decode
+        their LWW register table (ops/decode.decode_doc_root), fallback docs
+        replay through the oracle.
+
+        Frame-path docs carry no VK_TEXT register (their makeList is consumed
+        at parse time), so the text list is injected under the host-tracked
+        ``text_key``."""
+        from ..ops.decode import decode_doc_root
+
+        sess = self.docs[doc_index]
+        if sess.fallback:
+            return _replay_doc(self._replay_changes(sess)).root
+        resolved, local = self._resolved_doc(doc_index)
+        if bool(resolved.overflow[local]):
+            return _replay_doc(self._replay_changes(sess)).root
+        lo = (doc_index // self._read_chunk) * self._read_chunk
+        block_state = self._state_block(doc_index // self._read_chunk)
+        keys = (
+            self._map_keys if sess.frame_mode
+            else (sess.encoder.keys if sess.encoder else self._map_keys)
+        )
+        # both ingest paths emit a VK_TEXT register for the makeList, so the
+        # text placement resolves through register LWW like any other key
+        return decode_doc_root(block_state, resolved, doc_index - lo, keys)
+
     def read_all(self) -> List[List[FormatSpan]]:
         out: List[List[FormatSpan]] = []
         for i, sess in enumerate(self.docs):
@@ -807,7 +902,8 @@ class StreamingMerge:
             if sess.fallback or bool(resolved.overflow[local]):
                 out.append(_replay_spans(self._replay_changes(sess)))
             else:
-                out.append(decode_doc_spans(resolved, local, self._attr_table(sess)))
+                attrs, comments = self._attr_tables(sess, i)
+                out.append(decode_doc_spans(resolved, local, attrs, comments))
         return out
 
     # -- cross-shard reductions (the ICI/DCN collectives) ------------------
@@ -867,7 +963,9 @@ class StreamingMerge:
             "round_insert_capacity": self.round_caps[0],
             "round_delete_capacity": self.round_caps[1],
             "round_mark_capacity": self.round_caps[2],
+            "round_map_capacity": self.round_caps[3],
             "comment_capacity": self.comment_capacity,
+            "map_capacity": self.state.map_capacity,
             # the REQUESTED value: a mesh session's effective block is its
             # whole padded batch, but a meshless restore must block reads
             "read_chunk": self._read_chunk_requested,

@@ -297,6 +297,25 @@ def run_differential_frames(
 
     rng = random.Random(seed ^ 0xF7A3E5)
     workloads = generate_workload(seed, num_docs=num_docs, ops_per_doc=ops_per_doc)
+    # ~1 in 6 docs gets comment-body map ops (core/comment.py): these must
+    # ride the wire fast path into the device map registers, with the
+    # materialized root equal to the oracle's.  The comment is authored by a
+    # DECLARED replica (doc3) continuing its own history — streaming frames
+    # admit only declared actors with causally-valid sequence numbers.
+    from ..core.comment import Comment, put_comment
+    from ..parallel.causal import causal_sort
+
+    injected = set()
+    for d, w in enumerate(workloads):
+        if rng.random() < 1 / 6:
+            replica = Doc.resume(
+                "doc3", causal_sort([c for log in w.values() for c in log])
+            )
+            change, _ = put_comment(
+                replica, Comment(id=f"cb-{d}", actor="doc3", content="body")
+            )
+            w.setdefault("doc3", []).append(change)
+            injected.add(d)
     sess = StreamingMerge(
         num_docs=num_docs,
         actors=("doc1", "doc2", "doc3"),
@@ -338,12 +357,27 @@ def run_differential_frames(
             f"patches: {replayed}\noracle: {expected}"
         )
     assert sess.pending_count() == 0, f"seed={seed}: undelivered changes remain"
+    for d, w in enumerate(workloads):
+        oracle_root = _oracle_doc(w).root
+        got = sess.read_root(d)
+        assert got == oracle_root, (
+            f"seed={seed} doc={d}: streamed root map diverges from oracle\n"
+            f"device: {got}\noracle: {oracle_root}"
+        )
     on_fast_path = sum(1 for s in sess.docs if s.frame_mode and not s.fallback)
     # Without the native core every frame legitimately routes to the object
     # path (the native layer is an accelerator, never a requirement) — only a
     # genuine all-docs demotion with the core present is a regression.
-    if num_docs and on_fast_path == 0 and native_available():
-        raise RuntimeError(f"seed={seed}: every doc left the frame fast path")
+    if native_available():
+        fallen = injected & {
+            d for d, s in enumerate(sess.docs) if s.fallback or not s.frame_mode
+        }
+        assert not fallen, (
+            f"seed={seed}: comment-body docs {sorted(fallen)} left the frame "
+            "fast path — map ops should ride the device registers"
+        )
+        if num_docs and on_fast_path == 0:
+            raise RuntimeError(f"seed={seed}: every doc left the frame fast path")
     return on_fast_path
 
 

@@ -72,18 +72,19 @@ def test_out_of_order_delivery_with_tpu_backend():
     assert_views_match_scalar_render(alice, bob)
 
 
-def test_map_ops_demote_session_but_views_stay_correct():
+def test_map_ops_stay_on_device_and_views_stay_correct():
     _, alice, bob = make_pair()
-    # comment bodies live in a nested map: not expressible on the device
-    # fast path, so the backend session demotes to scalar replay — the
-    # patch stream (and therefore the view) must stay correct regardless
+    # comment bodies live in a nested map: the device map-register path
+    # (ops/kernel._apply_map_doc) expresses makeMap/set/del, so the backend
+    # session must NOT demote, and the root map must materialize correctly
     alice.dispatch_input_ops([{"path": [], "action": "makeMap", "key": "comments"}])
     type_text(alice, 1, "Q")
     alice.sync()
     bob.sync()
-    assert alice.session.docs[0].fallback
+    assert not alice.session.docs[0].fallback
     assert alice.view == bob.view
     assert_views_match_scalar_render(alice, bob)
+    assert alice.session.read_root(0).get("comments") == {}
 
 
 def test_unknown_backend_rejected():

@@ -111,11 +111,29 @@ def test_mixed_frame_then_object_ingest(workloads):
     assert sess.read(0) == _oracle_spans(w)
 
 
-def test_non_text_ops_demote_to_oracle_replay():
+def test_map_ops_stay_on_frame_fast_path():
+    """makeMap / map set / del ride the wire fast path into the device map
+    registers (no demotion); the materialized root equals the oracle's."""
+    docs, _, initial = generate_docs("hello", 2)
+    d1, _ = docs
+    c, _ = d1.change([
+        {"path": [], "action": "makeMap", "key": "comments"},
+        {"path": ["comments"], "action": "set", "key": "note", "value": "hi"},
+    ])
+    sess = _session(num_docs=1)
+    sess.ingest_frame(0, encode_frame([initial, c]))
+    sess.drain()
+    assert not sess.docs[0].fallback and sess.docs[0].frame_mode
+    w = {"doc1": [initial, c]}
+    assert sess.read(0) == _oracle_spans(w)
+    assert sess.read_root(0) == _oracle_doc(w).root
+
+
+def test_inexpressible_map_value_demotes_to_oracle_replay():
     docs, _, initial = generate_docs("hello", 2)
     d1, _ = docs
     c, _ = d1.change(
-        [{"path": [], "action": "makeMap", "key": "comments"}]
+        [{"path": [], "action": "set", "key": "ratio", "value": 0.5}]
     )
     sess = _session(num_docs=1)
     sess.ingest_frame(0, encode_frame([initial, c]))
@@ -123,6 +141,7 @@ def test_non_text_ops_demote_to_oracle_replay():
     assert sess.docs[0].fallback
     w = {"doc1": [initial, c]}
     assert sess.read(0) == _oracle_spans(w)
+    assert sess.read_root(0) == _oracle_doc(w).root
 
 
 def test_undeclared_actor_demotes_not_crashes(workloads):
@@ -239,7 +258,9 @@ def test_wrong_shape_spillover_json_raises_valueerror():
 
     bogus = Change(
         actor="doc1", seq=1, deps={}, start_op=1,
-        ops=[Operation(action="makeMap", obj=ROOT, opid=(1, "doc1"), key="m")],
+        # a float value spills to JSON (makeMap no longer does)
+        ops=[Operation(action="set", obj=ROOT, opid=(1, "doc1"), key="m",
+                       value=0.5)],
     )
     frame = bytearray(encode_frame([bogus]))
     # corrupt the spillover string table entry into valid-but-wrong JSON: we
@@ -259,7 +280,7 @@ def test_wrong_shape_spillover_json_raises_valueerror():
         pytest.skip("frame layout changed; spillover not found")
     with pytest.raises(ValueError):
         parse_frame(
-            patched, OrderedActorTable(["doc1"]), Interner(), 0
+            patched, OrderedActorTable(["doc1"]), Interner(), 0, Interner()
         )
 
 
@@ -281,7 +302,7 @@ def test_out_of_range_codepoint_rejected_at_ingest(workloads):
     magic, ver, nc, ns, ni, pl = hdr.unpack_from(patched)
     patched = hdr.pack(magic, ver, nc, ns, ni, pl + 2) + patched[hdr.size:]
     with pytest.raises(ValueError, match="codepoint"):
-        parse_frame(patched, OrderedActorTable(["doc1"]), Interner(), 0)
+        parse_frame(patched, OrderedActorTable(["doc1"]), Interner(), 0, Interner())
 
 
 # -- bulk-ingest edge cases (parse_frames_bulk contracts) -------------------

@@ -151,8 +151,10 @@ def test_streaming_patches_across_fallback_demotion():
          {"path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 5,
           "markType": "em"}]
     )
-    c2, _ = d1.change([{"path": [], "action": "makeMap", "key": "comments"}])
-    sess.ingest_frame(0, encode_frame([c1, c2]))  # non-text op: demotes
+    # a float value is inexpressible on device: the demotion trigger
+    # (makeMap itself now rides the device map-register path)
+    c2, _ = d1.change([{"path": [], "action": "set", "key": "r", "value": 0.5}])
+    sess.ingest_frame(0, encode_frame([c1, c2]))  # inexpressible op: demotes
     sess.drain()
     assert sess.docs[0].fallback
     increment = sess.read_patches(0)  # scalar path

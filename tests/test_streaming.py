@@ -198,15 +198,19 @@ def test_streaming_cursor_resolution_on_fallback_doc():
 
     docs, _, initial = generate_docs("fallback text", 1)
     (d1,) = docs
-    comment_change, _ = put_comment(d1, Comment(id="c9", actor="doc1", content="x"))
+    # a float value is device-inexpressible: forces the fallback path
+    # (comment-body maps themselves now ride the device registers)
+    fall_change, _ = d1.change(
+        [{"path": [], "action": "set", "key": "ratio", "value": 0.25}]
+    )
     sess = StreamingMerge(
         num_docs=1, actors=("doc1",), slot_capacity=128,
         round_insert_capacity=64, round_delete_capacity=32, round_mark_capacity=32,
     )
-    sess.ingest_frame(0, encode_frame([initial, comment_change]))
+    sess.ingest_frame(0, encode_frame([initial, fall_change]))
     sess.drain()
     assert sess.docs[0].fallback
-    w = {"doc1": [initial, comment_change]}
+    w = {"doc1": [initial, fall_change]}
     doc = _oracle_doc(w)
     cursor = doc.get_cursor(["text"], 4)
     assert sess.resolve_cursors(0, [cursor]) == [doc.resolve_cursor(cursor)]
