@@ -137,8 +137,12 @@ def _read_frames(path: Path) -> List[bytes]:
     data = path.read_bytes()
     pos = 0
     while pos < len(data):
+        if pos + 4 > len(data):
+            raise ValueError(f"truncated frame file: {path}")
         (length,) = struct.unpack_from(_LEN, data, pos)
         pos += 4
+        if length < 0 or pos + length > len(data):
+            raise ValueError(f"truncated frame file: {path}")
         frames.append(data[pos : pos + length])
         pos += length
     return frames

@@ -433,9 +433,13 @@ int32_t pt_schedule_split_batch(
                     const int32_t k = r[0];
                     if (k == 5) continue;
                     if (k == 6) {
-                        // map-register op: container is the root or a child
-                        // map (object-kind validation happened at the
-                        // sender's encoder; list objects never produce k=6)
+                        // map-register op: container must not be the text
+                        // LIST (a malformed peer targeting it would diverge
+                        // from the scalar oracle, which raises); other
+                        // object-kind validation is the sender encoder's job
+                        if (r[1] == text_obj[d] && text_obj[d] != 0) {
+                            demote = true; break;
+                        }
                         p_obj[pbase + cp] = r[1]; p_key[pbase + cp] = r[3];
                         p_op[pbase + cp] = r[2]; p_kind[pbase + cp] = r[4];
                         p_val[pbase + cp] = r[5];

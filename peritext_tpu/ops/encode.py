@@ -99,9 +99,6 @@ class EncodedBatch:
     attr_tables: List[Interner]
     #: per-doc interner for map keys and string values
     map_tables: List[Interner]
-    #: per-doc key under which the text list hangs off the root (None = no
-    #: text list yet); lets read_root place the decoded text
-    text_keys: List[Optional[str]]
     #: doc indices the device path cannot express; resolved by the oracle
     fallback_docs: List[int] = field(default_factory=list)
 
@@ -285,7 +282,6 @@ def encode_workloads(
     actor_tables: List[OrderedActorTable] = []
     attr_tables: List[Interner] = []
     map_tables: List[Interner] = []
-    text_keys: List[Optional[str]] = []
     fallback: List[int] = []
 
     for doc_index, queues in enumerate(workloads):
@@ -301,10 +297,9 @@ def encode_workloads(
         # assigned actor index is len(actors) - 1, which must fit ACTOR_BITS.
         ok = len(actors) - 1 <= MAX_ACTORS
         streams = _DocStreams()
-        text_key = None
         if ok:
             try:
-                streams, ok, _, text_key = encode_doc(ordered, actors, attrs, keys)
+                streams, ok, _, _ = encode_doc(ordered, actors, attrs, keys)
             except OverflowError:
                 ok = False
         if not ok:
@@ -314,7 +309,6 @@ def encode_workloads(
         actor_tables.append(actors)
         attr_tables.append(attrs)
         map_tables.append(keys)
-        text_keys.append(text_key)
 
     return pad_doc_streams(
         per_doc,
@@ -322,7 +316,6 @@ def encode_workloads(
         actor_tables,
         attr_tables,
         map_tables=map_tables,
-        text_keys=text_keys,
         insert_capacity=insert_capacity,
         delete_capacity=delete_capacity,
         mark_capacity=mark_capacity,
@@ -336,7 +329,6 @@ def pad_doc_streams(
     actor_tables: List[OrderedActorTable],
     attr_tables: List[Interner],
     map_tables: Optional[List[Interner]] = None,
-    text_keys: Optional[List[Optional[str]]] = None,
     insert_capacity: Optional[int] = None,
     delete_capacity: Optional[int] = None,
     mark_capacity: Optional[int] = None,
@@ -405,6 +397,5 @@ def pad_doc_streams(
         actor_tables=actor_tables,
         attr_tables=attr_tables,
         map_tables=map_tables if map_tables is not None else [Interner() for _ in range(d)],
-        text_keys=text_keys if text_keys is not None else [None] * d,
         fallback_docs=sorted(fallback),
     )

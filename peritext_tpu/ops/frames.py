@@ -179,29 +179,47 @@ def parse_frame(
     if np.any(ch_actor < 0):
         raise FrameIngestError("undeclared actor in frame")
 
-    kinds = ops[:, 0]
+    kinds = ops[:, 0]  # NOTE: a view — the JSON->map conversion mutates it
+    native_map_rows = np.nonzero(kinds == KIND_MAP)[0]
     # JSON-spillover rows: only the doc's makeList is fast-path-able; it
-    # defines the text object and becomes a no-op row.  (A re-delivered copy
-    # of the same makeList is also a no-op: duplicate frames are a routine
-    # anti-entropy condition and must not demote the doc.)
+    # defines the text object and becomes a VK_TEXT map-register row (same
+    # conversion as parse_frames_bulk, so text placement competes in register
+    # LWW).  A re-delivered copy of the same makeList is idempotent:
+    # duplicate frames are a routine anti-entropy condition.
     for row in np.nonzero(kinds == KIND_JSON)[0]:
+        from .packed import OBJ_ROOT, VK_TEXT
+
         try:
             op = Operation.from_json(json.loads(strings[int(ops[row, 3])]))
         except (ValueError, TypeError, KeyError, AttributeError) as exc:
             # same normalized contract as codec.decode_frame
             raise ValueError(f"corrupt frame: {exc!r}") from exc
-        if op.action != "makeList":
+        if op.action != "makeList" or op.key is None:
             raise FrameIngestError(f"non-text op on fast path: {op.action}")
         actor_idx = actors.get(op.opid[1])
         if actor_idx is None or op.opid[0] > MAX_CTR:
             raise FrameIngestError("makeList opid outside packed range")
+        if not isinstance(op.obj, tuple):
+            pobj = OBJ_ROOT
+        else:
+            obj_actor = actors.get(op.obj[1])
+            if obj_actor is None or op.obj[0] > MAX_CTR:
+                raise FrameIngestError("makeList container outside packed range")
+            pobj = pack_id(op.obj[0], obj_actor)
         packed = pack_id(op.opid[0], actor_idx)
         if text_obj == 0:
             text_obj = packed
         elif packed != text_obj:
             raise FrameIngestError("second list object on fast path")
-        ops[row, 0] = KIND_SKIP
-        ops[row, 1] = text_obj  # self-describing: skips obj validation
+        ch = int(np.searchsorted(ops_off, row, side="right")) - 1
+        cnt_map[ch] += 1
+        ops[row, 0] = KIND_MAP
+        ops[row, 1] = pobj
+        ops[row, 2] = packed
+        ops[row, 3] = keys.intern(op.key)
+        ops[row, 4] = VK_TEXT
+        ops[row, 5] = packed
+        ops[row, 6:] = 0
 
     if np.any(kinds == KIND_BAD):
         raise FrameIngestError("op outside packed-id range")
@@ -225,11 +243,12 @@ def parse_frame(
         for row in np.nonzero(mark_rows & (attr_col > 0))[0]:
             ops[row, 9] = attrs.intern(strings[int(attr_col[row]) - 1])
 
-    map_rows = kinds == KIND_MAP
-    if np.any(map_rows):
+    # only NATIVE-emitted map rows carry frame string-table ids; rows the
+    # JSON loop converted above are already interned
+    if len(native_map_rows):
         from .packed import VK_STR
 
-        for row in np.nonzero(map_rows)[0]:
+        for row in native_map_rows:
             ops[row, 3] = keys.intern(strings[int(ops[row, 3])])
             if ops[row, 4] == VK_STR:
                 ops[row, 5] = keys.intern(strings[int(ops[row, 5]) - 1])
@@ -290,7 +309,6 @@ def parse_frames_bulk(
     doc_ids: np.ndarray,
     text_obj_by_doc: dict,
     keys: Interner | None = None,
-    text_key_by_doc: dict | None = None,
 ):
     """Parse MANY concatenated wire frames in one native call (the bulk twin
     of :func:`parse_frame` — per-frame Python eliminated; SURVEY §5.8's
@@ -299,9 +317,8 @@ def parse_frames_bulk(
     ``data`` holds the frames back to back with ``frame_off`` (F+1 int64)
     byte offsets; ``doc_ids[f]`` is the document each frame belongs to and
     ``text_obj_by_doc`` maps doc -> packed text-list id (0 = unknown),
-    updated in place as makeList ops are consumed (``text_key_by_doc``
-    likewise records the root key the text list hangs under).  ``keys`` is
-    the session interner for map keys and string values.
+    updated in place as makeList ops are consumed.  ``keys`` is the session
+    interner for map keys and string values.
 
     Returns ``(parsed, f_ch_off, status)``: ``parsed`` is one flat
     ParsedChanges across ALL frames (including to-be-demoted ones — slice by
@@ -310,8 +327,6 @@ def parse_frames_bulk(
     """
     if keys is None:
         keys = Interner()
-    if text_key_by_doc is None:
-        text_key_by_doc = {}
     if not native.available():
         return None
     if len(actors) - 1 > MAX_ACTORS:
@@ -430,7 +445,6 @@ def parse_frames_bulk(
                 staged.append((row, pobj, packed, op.key))
             if status[f] == FRAME_OK and staged:
                 text_obj_by_doc[doc] = local_text
-                text_key_by_doc[doc] = staged[-1][3]
                 # Rewrite the spillover row into a VK_TEXT map-register row:
                 # the text list placement then competes in register LWW like
                 # any other key (the object path emits the same register),
@@ -586,6 +600,11 @@ def schedule_split(
     live = (kinds != KIND_SKIP) & (kinds != KIND_MAP)
     if not np.all((sel[:, 1][live] == text_obj)):
         raise FrameIngestError("op on non-text object on fast path")
+    # a map op whose CONTAINER is the text list is malformed (the oracle
+    # raises on it); demote rather than diverge
+    map_kind = kinds == KIND_MAP
+    if text_obj != 0 and np.any(map_kind & (sel[:, 1] == text_obj)):
+        raise FrameIngestError("map op targeting the text list")
 
     ins = sel[kinds == KIND_INS]
     dels = sel[kinds == KIND_DEL]

@@ -328,9 +328,10 @@ def apply_batch_compact_jit(state, stream_counts, ins_flat, del_flat, mark_flat,
 def encoded_arrays_of(encoded: EncodedBatch):
     """The device-array tuple for apply_batch from a host EncodedBatch.
 
-    Emits the 8-tuple (with the map-register stream) when the batch carries
-    one; sources without map streams (e.g. streaming round buffers) yield
-    the 6-tuple form apply_batch equally accepts."""
+    Emits the 8-tuple (with the map-register stream) when the source carries
+    one — both EncodedBatch and the streaming round buffers do; sources
+    without a ``map_ops`` attribute yield the 6-tuple form apply_batch
+    equally accepts."""
     base = (
         jnp.asarray(encoded.ins_ref),
         jnp.asarray(encoded.ins_op),

@@ -56,13 +56,46 @@ def shard_docs(tree, mesh: Mesh, axis_name: str = DOC_AXIS):
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
 
 
-def convergence_digest(chars: jnp.ndarray, visible: jnp.ndarray) -> jnp.ndarray:
+def doc_digest_host(codepoints, slot_positions, slot_capacity: int) -> int:
+    """uint32 digest of ONE document, bit-identical to its contribution in
+    :func:`convergence_digest` — computed host-side.
+
+    Lets scalar-replay (fallback/overflow) docs participate in cross-session
+    digest comparison: the device formula depends only on visible codepoints,
+    their slot positions in the convergent element order (tombstones
+    included), and the pad-slot count — all of which a scalar replica can
+    reproduce whenever the doc fits the device capacities.  ``codepoints``
+    and ``slot_positions`` are the visible characters and their indices in
+    full element order."""
+    import numpy as np
+
+    with np.errstate(over="ignore"):  # uint32 wraparound is the point
+        k1, k2, k3 = np.uint32(2654435761), np.uint32(40503), np.uint32(2246822519)
+        pad = np.uint32(0x9E3779B9) * k3
+        pad = pad ^ (pad >> np.uint32(15))
+        cps = np.asarray(codepoints, np.uint32)
+        pos = np.asarray(slot_positions, np.uint32)
+        x = (cps * k1) ^ (pos * k2)
+        x = x * k3
+        x = x ^ (x >> np.uint32(15))
+        n_pad = np.uint32(max(slot_capacity - len(cps), 0))
+        total = np.uint32(x.sum(dtype=np.uint32)) + n_pad * pad
+    return int(total & np.uint32(0xFFFFFFFF))
+
+
+def convergence_digest(
+    chars: jnp.ndarray, visible: jnp.ndarray, doc_mask: jnp.ndarray | None = None
+) -> jnp.ndarray:
     """Order-sensitive scalar digest of all documents' visible text.
 
     Computed inside the sharded program, so the final sum lowers to an XLA
     all-reduce across the mesh — the "global convergence check" collective.
     Two replicas of a batch converged iff their digests match (probabilistic,
     64-ish bits folded into int32 pairs).
+
+    ``doc_mask`` (bool (D,)) zeroes excluded docs' contributions ENTIRELY —
+    an excluded doc must not add even the pad-slot constant, so its host-side
+    stand-in (:func:`doc_digest_host`) can be summed in instead.
     """
     d, s = chars.shape
     # Per-slot mix of (char, visible, position) with distinct odd multipliers.
@@ -73,4 +106,6 @@ def convergence_digest(chars: jnp.ndarray, visible: jnp.ndarray) -> jnp.ndarray:
     x = x * jnp.uint32(2246822519)
     x = x ^ (x >> 15)
     per_doc = jnp.sum(x, axis=1, dtype=jnp.uint32)
+    if doc_mask is not None:
+        per_doc = jnp.where(doc_mask, per_doc, jnp.uint32(0))
     return jnp.sum(per_doc, dtype=jnp.uint32)  # cross-shard all-reduce

@@ -76,8 +76,12 @@ def _resolve_digest_jit(state: PackedDocs, comment_capacity: int, row_mask):
     overflow vector ever reach the host."""
     resolved = resolve(state, comment_capacity, with_comments=False)
     mask = row_mask & ~resolved.overflow
-    visible = resolved.visible & mask[:, None]
-    return convergence_digest(resolved.char, visible), resolved.overflow
+    # masked docs contribute ZERO (not the pad constant): their host-side
+    # replay hash is summed in instead (digest())
+    return (
+        convergence_digest(resolved.char, resolved.visible, doc_mask=mask),
+        resolved.overflow,
+    )
 
 
 @dataclass
@@ -94,7 +98,6 @@ class _DocSession:
     frame_mode: bool = False
     frames: List[bytes] = field(default_factory=list)
     text_obj: int = 0
-    text_key: Optional[str] = None  # root key the text list hangs under
 
 
 class _RoundBuffers:
@@ -196,14 +199,15 @@ class StreamingMerge:
         """Queue newly-arrived changes for one document (any order, dups ok)."""
         sess = self.docs[doc_index]
         changes = list(changes)
+        if not changes:
+            return  # a zero-change frame would only grow durable history
         if sess.frame_mode:
             # the doc's pending state lives as parsed arrays; route object
             # arrivals through the same (cheap) frame parse
             self.ingest_frame(doc_index, encode_frame(changes))
             return
-        if changes:
-            sess.pending.extend(changes)
-            self._object_pending.add(doc_index)
+        sess.pending.extend(changes)
+        self._object_pending.add(doc_index)
 
     def ingest_frame(self, doc_index: int, data: bytes) -> None:
         """Queue one binary change frame (the wire format a peer host ships,
@@ -253,7 +257,6 @@ class StreamingMerge:
             [[0], np.cumsum([len(f) for f in frames], dtype=np.int64)]
         ).astype(np.int64)
         text_objs: Dict[int, int] = {}
-        text_keys: Dict[int, str] = {}
         for d in doc_ids:
             d = int(d)
             sess = self.docs[d]
@@ -261,13 +264,11 @@ class StreamingMerge:
                 sess.frame_mode = True
                 self._frame_mode[d] = True
             text_objs.setdefault(d, sess.text_obj)
-            if sess.text_key is not None:
-                text_keys.setdefault(d, sess.text_key)
 
         out = parse_frames_bulk(
             b"".join(frames), frame_off, self._actor_table,
             self._frame_attrs, doc_ids, text_objs,
-            keys=self._map_keys, text_key_by_doc=text_keys,
+            keys=self._map_keys,
         )
         if out is None:  # pragma: no cover - native.available() checked
             corrupt = []
@@ -330,8 +331,6 @@ class StreamingMerge:
             else:
                 sess.frames.append(data)
                 sess.text_obj = text_objs[d]
-                if d in text_keys:
-                    sess.text_key = text_keys[d]
                 keep_frame[f] = True
 
         if keep_frame.all() and parsed.num_changes:
@@ -364,7 +363,6 @@ class StreamingMerge:
         self._frame_mode[doc_index] = False
         sess.frames = []
         sess.text_obj = 0
-        sess.text_key = None
         sess.fallback = True
         GLOBAL_COUNTERS.add("streaming.fallback_docs")
 
@@ -837,10 +835,16 @@ class StreamingMerge:
             resolve_cursors_jit,
         )
 
-        overflow = np.asarray(self.state.overflow)
+        # Route on the per-block RESOLVED overflow (apply-time overflow plus
+        # resolve-time mark/comment errors) so cursor fallback matches
+        # read()/read_all() exactly; blocks are cached per round.
         device_map, replay_docs = {}, []
         for d, cursors in cursor_map.items():
-            if self.docs[d].fallback or bool(overflow[d]):
+            if self.docs[d].fallback:
+                replay_docs.append(d)
+                continue
+            resolved, local = self._resolved_doc(d)
+            if bool(resolved.overflow[local]):
                 replay_docs.append(d)
             else:
                 device_map[d] = cursors
@@ -871,12 +875,10 @@ class StreamingMerge:
     def read_root(self, doc_index: int) -> dict:
         """Materialize one doc's root map (nested maps + the text character
         list) — the streaming twin of MergeReport.roots: device docs decode
-        their LWW register table (ops/decode.decode_doc_root), fallback docs
-        replay through the oracle.
-
-        Frame-path docs carry no VK_TEXT register (their makeList is consumed
-        at parse time), so the text list is injected under the host-tracked
-        ``text_key``."""
+        their LWW register table (ops/decode.decode_doc_root; both ingest
+        paths emit a VK_TEXT register for the makeList, so text placement
+        resolves through register LWW), fallback docs replay through the
+        oracle."""
         from ..ops.decode import decode_doc_root
 
         sess = self.docs[doc_index]
@@ -909,33 +911,50 @@ class StreamingMerge:
     # -- cross-shard reductions (the ICI/DCN collectives) ------------------
 
     def digest(self) -> int:
-        """Global convergence digest over every DEVICE-RESIDENT doc's visible
-        text: with a mesh, XLA lowers the cross-doc reduction to an all-reduce
-        over ICI.  Two sessions that converged hold equal digests.
+        """Global convergence digest over every doc's visible text: with a
+        mesh, XLA lowers the cross-doc reduction to an all-reduce over ICI.
+        Two sessions that converged hold equal digests.
 
-        Fallback and overflowed docs are masked out — exactly the docs the
-        read paths route to scalar replay: their truth lives host-side and
-        their device rows may hold residue whose exact content depends on
-        round partitioning (compare those docs via read()).
+        Device-resident docs hash on device; fallback and overflowed docs —
+        the ones the read paths route to scalar replay — are masked out of
+        the device sum and hashed HOST-SIDE with the bit-identical per-doc
+        formula (mesh.doc_digest_host), so two converged peers agree even
+        when their demotion histories differ.  (The equivalence needs the
+        replayed doc to fit the device capacities; a doc too large for any
+        device row hashes consistently between fallback peers only.)
 
         The digest is a doc-sum of per-doc hashes, so it is computed per
         read-block and summed mod 2^32 — identical to the whole-batch value
         while bounding device memory at 100K-doc scale."""
+        from .mesh import doc_digest_host
+
         on_device_all = np.asarray(
             [not s.fallback for s in self.docs]
             + [False] * (self._padded_docs - self.num_docs),
             bool,
         )
         total = 0
+        replay_docs = [i for i, s in enumerate(self.docs) if s.fallback]
         n_blocks = -(-self._padded_docs // self._read_chunk)
         for bi in range(n_blocks):
             lo, hi = self._block_bounds(bi)
-            digest, _ = _resolve_digest_jit(
+            digest, overflow = _resolve_digest_jit(
                 self._state_block(bi),
                 self.comment_capacity,
                 jnp.asarray(on_device_all[lo:hi]),
             )
             total = (total + int(digest)) & 0xFFFFFFFF
+            ov = np.asarray(overflow)
+            replay_docs.extend(
+                int(d) + lo
+                for d in np.nonzero(ov & on_device_all[lo:hi])[0]
+                if int(d) + lo < self.num_docs
+            )
+        s_cap = self.state.slot_capacity
+        for i in replay_docs:
+            doc = _replay_doc(self._replay_changes(self.docs[i]))
+            cps, slots = _doc_char_slots(doc)
+            total = (total + doc_digest_host(cps, slots, s_cap)) & 0xFFFFFFFF
         return total
 
     # -- checkpoint support (peritext_tpu.checkpoint.save_session) ----------
@@ -999,6 +1018,27 @@ class StreamingMerge:
     def pending_count(self) -> int:
         pooled = sum(int(self._frame_mode[d].sum()) for d, _ in self._pool)
         return pooled + sum(len(s.pending) for s in self.docs)
+
+
+def _doc_char_slots(doc: Doc):
+    """(visible codepoints, their slot positions in full element order incl.
+    tombstones) for a scalar replica's text list — the inputs the device
+    digest formula needs (mesh.doc_digest_host)."""
+    try:
+        list_id = doc.get_object_id_for_path(["text"])
+    except Exception:
+        return [], []
+    meta = doc._metadata.get(list_id)
+    text = doc._objects.get(list_id)
+    if meta is None or text is None:
+        return [], []
+    cps, slots, vis = [], [], 0
+    for i, el in enumerate(meta):
+        if not el.deleted:
+            cps.append(ord(text[vis]))
+            slots.append(i)
+            vis += 1
+    return cps, slots
 
 
 def _replay_doc(changes: List[Change]) -> Doc:

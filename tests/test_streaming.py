@@ -255,3 +255,37 @@ def test_block_chunked_reads_match_single_block():
     assert chunked.resolve_cursors_batch(cursor_map) == whole.resolve_cursors_batch(
         cursor_map
     )
+
+
+def test_digest_equal_across_different_demotion_sets():
+    """Two converged peers whose demotion histories differ must report EQUAL
+    digests: fallback docs hash host-side with the device-identical per-doc
+    formula (mesh.doc_digest_host) instead of being masked away."""
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.testing.generate import generate_docs
+
+    docs, _, initial = generate_docs("converged text", 1)
+    (d1,) = docs
+    c1, _ = d1.change(
+        [{"path": ["text"], "action": "insert", "index": 4, "values": list("XY")},
+         {"path": ["text"], "action": "delete", "index": 0, "count": 2}]
+    )
+    mk = lambda: StreamingMerge(  # noqa: E731
+        num_docs=1, actors=("doc1",), slot_capacity=64,
+        round_insert_capacity=32, round_delete_capacity=16, round_mark_capacity=16,
+    )
+    on_device = mk()
+    on_device.ingest_frame(0, encode_frame([initial, c1]))
+    on_device.drain()
+    assert not on_device.docs[0].fallback
+
+    demoted = mk()
+    demoted.ingest_frame(0, encode_frame([initial, c1]))
+    demoted.drain()
+    # demote AFTER convergence via a device-inexpressible op
+    fl, _ = d1.change([{"path": [], "action": "set", "key": "r", "value": 0.5}])
+    demoted.ingest_frame(0, encode_frame([fl]))
+    demoted.drain()
+    assert demoted.docs[0].fallback
+    # the float map entry does not touch the text, so the text digests agree
+    assert on_device.digest() == demoted.digest()
