@@ -23,10 +23,8 @@ import time
 import numpy as np
 
 
-def measure_scalar_baseline(num_ops: int = 4000, seed: int = 7) -> float:
-    """Single-thread ops/sec: replay fuzz-generated change logs through the
-    scalar oracle's apply_change path."""
-    from peritext_tpu.core.doc import Doc
+def _baseline_changes(num_ops: int = 4000, seed: int = 7):
+    """Causally-ordered fuzz change log shared by both scalar baselines."""
     from peritext_tpu.parallel.causal import causal_sort
     from peritext_tpu.testing.fuzz import make_fuzz_state, fuzz_step
 
@@ -36,14 +34,57 @@ def measure_scalar_baseline(num_ops: int = 4000, seed: int = 7) -> float:
     changes = causal_sort(
         [ch for actor in state.store.actors() for ch in state.store.log(actor)]
     )
-    total_ops = sum(len(ch.ops) for ch in changes)
+    return changes, sum(len(ch.ops) for ch in changes)
 
+
+def measure_scalar_baseline(num_ops: int = 4000, seed: int = 7) -> float:
+    """Single-thread ops/sec: replay fuzz-generated change logs through the
+    scalar oracle's apply_change path (pure Python)."""
+    from peritext_tpu.core.doc import Doc
+
+    changes, total_ops = _baseline_changes(num_ops, seed)
     doc = Doc("baseline")
     t0 = time.perf_counter()
     for ch in changes:
         doc.apply_change(ch)
     elapsed = time.perf_counter() - t0
     return total_ops / elapsed
+
+
+def measure_native_baseline(num_docs: int = 16, ops_per_doc: int = 256, seed: int = 7):
+    """Single-CORE ops/sec through the C++ scalar apply (pt_scalar_apply) —
+    the defensible stand-in for the reference's single-thread TS baseline
+    (no node runtime in this image; an optimized native single core is a
+    strictly harder bar than interpreted TS, which pays for JS objects,
+    per-mark gap-set maintenance and patch emission this baseline skips).
+    Callers pass the device benchmark's ops_per_doc so per-op scan lengths
+    match the workload being compared against.  Every doc's applied text is
+    validated against the Python oracle before timing.  Returns None if the
+    native core is unavailable."""
+    from peritext_tpu import native
+    from peritext_tpu.testing.baseline import (
+        check_scalar_apply_matches_oracle,
+        workload_op_matrices,
+    )
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    if not native.available():
+        return None
+    workloads = generate_workload(seed, num_docs=num_docs, ops_per_doc=ops_per_doc)
+    matrices, total_ops = workload_op_matrices(workloads)
+    check_scalar_apply_matches_oracle(workloads, matrices)
+
+    # a single sweep is fast; amortize wrapper overhead over repetitions
+    reps = 20
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for m in matrices:
+                native.scalar_apply(m)
+        dt = (time.perf_counter() - t0) / reps
+        best = dt if best is None or dt < best else best
+    return total_ops / best
 
 
 def run(args) -> dict:
@@ -125,14 +166,19 @@ def run(args) -> dict:
     resolve_time = (time.perf_counter() - t0) / args.iters
 
     baseline = measure_scalar_baseline()
+    native_baseline = measure_native_baseline(ops_per_doc=args.ops_per_doc, seed=args.seed or 7)
+    honest = native_baseline or baseline
 
     return {
         "metric": "crdt_ops_per_sec_per_chip",
         "value": round(device_ops_per_sec, 1),
         "unit": "ops/s",
-        "vs_baseline": round(device_ops_per_sec / baseline, 2),
-        "baseline_ops_per_sec": round(baseline, 1),
-        "baseline_impl": "scalar-python-oracle-1-core (no node runtime in image for TS reference)",
+        "vs_baseline": round(device_ops_per_sec / honest, 2),
+        "baseline_ops_per_sec": round(honest, 1),
+        "baseline_impl": "cpp-single-core-scalar-apply (native.pt_scalar_apply; "
+                         "no node runtime in image for the TS reference)",
+        "python_oracle_ops_per_sec": round(baseline, 1),
+        "vs_python_oracle": round(device_ops_per_sec / baseline, 2),
         "docs": d,
         "ops_per_doc": k,
         "slot_capacity": s,
@@ -238,13 +284,17 @@ def run_streaming(args) -> dict:
         len(ch.ops) for w in workloads for log in w.values() for ch in log
     )
     baseline = measure_scalar_baseline()
+    native_baseline = measure_native_baseline(ops_per_doc=args.ops_per_doc, seed=args.seed or 7)
+    honest = native_baseline or baseline
     value = total_ops / elapsed
     return {
         "metric": "streaming_crdt_ops_per_sec_per_chip",
         "value": round(value, 1),
         "unit": "ops/s",
-        "vs_baseline": round(value / baseline, 2),
-        "baseline_ops_per_sec": round(baseline, 1),
+        "vs_baseline": round(value / honest, 2),
+        "baseline_ops_per_sec": round(honest, 1),
+        "baseline_impl": "cpp-single-core-scalar-apply",
+        "python_oracle_ops_per_sec": round(baseline, 1),
         "docs": d,
         "rounds": rounds,
         "ops_per_doc": args.ops_per_doc,

@@ -107,6 +107,12 @@ def load() -> Optional[ctypes.CDLL]:
             + [i32p] * 5  # n_ins, n_del, n_mark, n_map, n_admitted
             + [u8p] * 2  # admitted, status
         )
+        lib.pt_scalar_apply.restype = ctypes.c_int64
+        lib.pt_scalar_apply.argtypes = [
+            i32p, ctypes.c_int64,  # ops, n_ops
+            i32p, ctypes.c_int64,  # out_text, out_cap
+            i64p, i64p,  # out_visible, out_check
+        ]
         lib.pt_parse_frames.restype = ctypes.c_int32
         lib.pt_parse_frames.argtypes = [
             u8p, i64p, ctypes.c_int32,  # data, frame_off, n_frames
@@ -336,6 +342,24 @@ def schedule_split_batch(
         admitted, status,
     )
     return total, n_ins, n_del, n_mark, n_map, n_admitted, admitted, status
+
+
+def scalar_apply(ops: np.ndarray):
+    """Single-core scalar baseline apply (see pt_scalar_apply): ops is the
+    (N, 10) parsed op matrix in causal application order.  Returns
+    ``(applied, visible_codepoints)`` or None when no native library."""
+    lib = load()
+    if lib is None:
+        return None
+    ops = np.ascontiguousarray(ops, np.int32)
+    cap = int(ops.shape[0]) + 8
+    out_text = np.empty(cap, np.int32)
+    out_visible = np.zeros(1, np.int64)
+    out_check = np.zeros(1, np.int64)
+    applied = lib.pt_scalar_apply(
+        ops.reshape(-1), int(ops.shape[0]), out_text, cap, out_visible, out_check
+    )
+    return int(applied), out_text[: int(out_visible[0])].copy()
 
 
 def varint_encode(values: np.ndarray) -> Optional[bytes]:

@@ -782,4 +782,84 @@ int32_t pt_parse_frames(
     return 0;
 }
 
+// ---------------------------------------------------------------------------
+// pt_scalar_apply — the single-core scalar BASELINE the device path is
+// measured against (BASELINE config 1).
+//
+// An honest C++ re-expression of the reference's applyChange hot loop
+// (src/micromerge.ts:892-1297) over the parsed op matrix: sequential RGA
+// insert with the convergence skip and O(n) reference scans
+// (:1187-1245, :1304), tombstone deletes (:1250-1277), mark ops paying the
+// reference's per-op anchor walk (the gap walk scans the whole metadata,
+// :1002-1138 — modeled here as the two anchor scans), and map-register LWW
+// (:1151-1175).  No batching, no vectorization — one op at a time on one
+// core, exactly what "single-thread native baseline" means.
+//
+// ops: (n_ops, 10) rows in causally-applied order (pt_parse_changes layout).
+// out_text receives the visible codepoints (capacity out_cap); returns the
+// number of ops applied, visible count via *out_visible, and an anchor
+// checksum via *out_check (defeats dead-code elimination of the scans).
+int64_t pt_scalar_apply(
+    const int32_t* ops, int64_t n_ops,
+    int32_t* out_text, int64_t out_cap,
+    int64_t* out_visible, int64_t* out_check) {
+    struct Elem { int32_t id; int32_t ch; bool deleted; };
+    std::vector<Elem> elems;
+    elems.reserve(4096);
+    struct Reg { int32_t obj, key, op, kind, val; };
+    std::vector<Reg> regs;
+    int64_t applied = 0;
+    int64_t check = 0;
+
+    auto find = [&](int32_t id) -> int64_t {
+        for (int64_t i = 0; i < static_cast<int64_t>(elems.size()); ++i) {
+            if (elems[i].id == id) return i;
+        }
+        return -1;
+    };
+
+    for (int64_t o = 0; o < n_ops; ++o) {
+        const int32_t* r = ops + o * 10;
+        const int32_t k = r[0];
+        if (k == 0) {  // insert after ref (0 = HEAD), RGA skip rule
+            int64_t p = -1;
+            if (r[3] != 0) {
+                p = find(r[3]);
+                if (p < 0) continue;  // malformed: skip (oracle would throw)
+            }
+            int64_t q = p + 1;
+            while (q < static_cast<int64_t>(elems.size()) && elems[q].id > r[2]) ++q;
+            elems.insert(elems.begin() + q, Elem{r[2], r[4], false});
+        } else if (k == 1) {  // delete -> tombstone
+            int64_t p = find(r[3]);
+            if (p < 0) continue;
+            elems[p].deleted = true;
+        } else if (k == 2) {  // mark: the reference walks the metadata per op
+            if (r[6] != 0) check += find(r[6]);
+            if (r[8] != 0) check += find(r[8]);
+        } else if (k == 6) {  // map register LWW
+            bool found = false;
+            for (auto& g : regs) {
+                if (g.obj == r[1] && g.key == r[3]) {
+                    if (r[2] > g.op) { g.op = r[2]; g.kind = r[4]; g.val = r[5]; }
+                    found = true;
+                    break;
+                }
+            }
+            if (!found) regs.push_back(Reg{r[1], r[3], r[2], r[4], r[5]});
+        } else {
+            continue;  // JSON / SKIP rows
+        }
+        ++applied;
+    }
+
+    int64_t vis = 0;
+    for (const auto& e : elems) {
+        if (!e.deleted && vis < out_cap) out_text[vis++] = e.ch;
+    }
+    *out_visible = vis;
+    *out_check = check;
+    return applied;
+}
+
 }  // extern "C"

@@ -229,3 +229,23 @@ class TestCodecRobustness:
         bad = _struct.Struct("<4sBIIQQ").pack(*hdr) + frame[_struct.Struct("<4sBIIQQ").size:]
         with pytest.raises(ValueError):
             decode_frame(bad)
+
+
+def test_scalar_apply_matches_oracle():
+    """The C++ single-core baseline (pt_scalar_apply) must replay a fuzz
+    workload to the oracle's exact visible text (BASELINE config 1)."""
+    import pytest
+
+    from peritext_tpu import native
+    from peritext_tpu.testing.baseline import (
+        check_scalar_apply_matches_oracle,
+        workload_op_matrices,
+    )
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    if not native.available():
+        pytest.skip("native core unavailable")
+    workloads = generate_workload(seed=77, num_docs=3, ops_per_doc=120)
+    matrices, total = workload_op_matrices(workloads)
+    assert total > 0
+    check_scalar_apply_matches_oracle(workloads, matrices)
