@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Multi-chip weak-scaling evidence on the virtual device mesh.
+
+Real multi-chip hardware is not reachable from this harness (one tunneled
+TPU chip), so this is the next-best artifact, per SURVEY §4's "multi-node
+without a cluster" recipe: N virtual CPU devices
+(``--xla_force_host_platform_device_count``), a ``jax.sharding.Mesh`` over
+the doc axis, and the SAME merge programs the TPU path runs.
+
+For each mesh size 1/2/4/8 it measures, at FIXED docs-per-device (weak
+scaling):
+
+* batch merge wall time + per-device throughput (DocBatch over the mesh),
+* streaming merge wall time + per-device throughput (StreamingMerge rounds),
+* the convergence digest of a FIXED 16-doc probe workload, which must be
+  IDENTICAL across every mesh size (re-sharding must never change content).
+
+Emits one JSON line per mesh size plus a final summary line; the BASELINE.md
+weak-scaling table is generated from this output.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--docs-per-device", type=int, default=64)
+    parser.add_argument("--ops-per-doc", type=int, default=96)
+    parser.add_argument("--seed", type=int, default=11)
+    parser.add_argument("--sizes", type=int, nargs="+", default=[1, 2, 4, 8])
+    args = parser.parse_args()
+
+    sys.path.insert(0, ".")
+    from peritext_tpu.utils.platform import pin_cpu_platform
+
+    devices = pin_cpu_platform(max(args.sizes))
+
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    from peritext_tpu.api.batch import DocBatch
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.parallel.streaming import StreamingMerge
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    probe = generate_workload(args.seed ^ 0xD16, num_docs=16, ops_per_doc=48)
+    digests = {}
+
+    for n in args.sizes:
+        mesh = Mesh(np.asarray(devices[:n]), ("docs",))
+        docs = args.docs_per_device * n
+        workloads = generate_workload(args.seed, num_docs=docs,
+                                      ops_per_doc=args.ops_per_doc)
+        total_ops = sum(
+            len(ch.ops) for w in workloads for log in w.values() for ch in log
+        )
+
+        # ---- batch merge over the mesh ----
+        batch = DocBatch(slot_capacity=4 * args.ops_per_doc,
+                         mark_capacity=2 * args.ops_per_doc,
+                         comment_capacity=32, mesh=mesh)
+        batch.merge(workloads)  # warm: compiles are per (docs, caps) shape
+        t0 = time.perf_counter()
+        report = batch.merge(workloads)
+        batch_s = time.perf_counter() - t0
+        assert not report.fallback_docs, report.fallback_docs
+
+        # ---- streaming merge over the mesh ----
+        def mk():
+            return StreamingMerge(
+                num_docs=docs, actors=("doc1", "doc2", "doc3"), mesh=mesh,
+                slot_capacity=4 * args.ops_per_doc,
+                mark_capacity=2 * args.ops_per_doc,
+                tomb_capacity=2 * args.ops_per_doc,
+                round_insert_capacity=128, round_delete_capacity=64,
+                round_mark_capacity=64,
+            )
+
+        frames = [
+            encode_frame([ch for log in w.values() for ch in log])
+            for w in workloads
+        ]
+        s = mk()  # warm
+        s.ingest_frames(list(enumerate(frames)))
+        s.drain()
+        s.digest()
+        t0 = time.perf_counter()
+        s = mk()
+        s.ingest_frames(list(enumerate(frames)))
+        s.drain()
+        s.digest()
+        stream_s = time.perf_counter() - t0
+
+        # shard-count sanity: the doc axis really spans all n devices
+        n_shards = len(s.state.elem_id.sharding.device_set)
+        assert n_shards == n, f"expected {n} shards, got {n_shards}"
+
+        # ---- fixed-probe digest: content must be mesh-size invariant ----
+        ps = StreamingMerge(
+            num_docs=16, actors=("doc1", "doc2", "doc3"), mesh=mesh,
+            slot_capacity=256, mark_capacity=128, tomb_capacity=128,
+        )
+        for d, w in enumerate(probe):
+            ps.ingest(d, [ch for log in w.values() for ch in log])
+        ps.drain()
+        digests[n] = ps.digest()
+
+        print(json.dumps({
+            "mesh_devices": n,
+            "docs": docs,
+            "total_ops": total_ops,
+            "batch_seconds": round(batch_s, 3),
+            "batch_ops_per_sec_per_device": round(total_ops / batch_s / n, 1),
+            "streaming_seconds": round(stream_s, 3),
+            "streaming_ops_per_sec_per_device": round(total_ops / stream_s / n, 1),
+            "probe_digest": digests[n],
+        }))
+
+    assert len(set(digests.values())) == 1, f"digest mismatch across meshes: {digests}"
+    print(json.dumps({
+        "summary": "weak-scaling",
+        "sizes": args.sizes,
+        "digest_equal_across_mesh_sizes": True,
+        "probe_digest": digests[args.sizes[0]],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -120,3 +120,31 @@ def test_pin_cpu_platform_raises_small_flag_count(monkeypatch):
     except RuntimeError:
         pass  # acceptable iff the client predates the flag; env still checked
     assert "device_count=8" in os.environ["XLA_FLAGS"]
+
+
+def test_digest_and_shards_invariant_across_mesh_sizes():
+    """The same workload merged on 1/2/4/8-device meshes must (a) actually
+    shard the doc axis across all devices and (b) produce identical
+    convergence digests — re-sharding never changes content (the committed
+    weak-scaling evidence, scripts/weak_scaling.py, asserts the same)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from peritext_tpu.parallel.streaming import StreamingMerge
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    workloads = generate_workload(seed=77, num_docs=16, ops_per_doc=40)
+    devices = jax.devices()
+    digests = {}
+    for n in (1, 2, 4, 8):
+        mesh_n = Mesh(np.asarray(devices[:n]), ("docs",))
+        s = StreamingMerge(
+            num_docs=16, actors=("doc1", "doc2", "doc3"), mesh=mesh_n,
+            slot_capacity=256, mark_capacity=128, tomb_capacity=128,
+        )
+        for d, w in enumerate(workloads):
+            s.ingest(d, [ch for log in w.values() for ch in log])
+        s.drain()
+        assert len(s.state.elem_id.sharding.device_set) == n
+        digests[n] = s.digest()
+    assert len(set(digests.values())) == 1, digests
