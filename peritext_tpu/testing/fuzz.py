@@ -279,6 +279,24 @@ def run_differential(
     return device_docs
 
 
+def _campaign_session(num_docs: int, ops_per_doc: int, mesh=None):
+    """The streaming-session configuration shared by every streaming fuzz
+    campaign (capacities scale with the workload's op count)."""
+    from ..parallel.streaming import StreamingMerge
+
+    return StreamingMerge(
+        num_docs=num_docs,
+        actors=("doc1", "doc2", "doc3"),
+        slot_capacity=max(256, 4 * ops_per_doc),
+        mark_capacity=max(64, ops_per_doc),
+        tomb_capacity=max(128, ops_per_doc),
+        round_insert_capacity=128,
+        round_delete_capacity=64,
+        round_mark_capacity=64,
+        mesh=mesh,
+    )
+
+
 def run_differential_frames(
     seed: int, num_docs: int, ops_per_doc: int, chunk: int = 9, mesh=None
 ) -> int:
@@ -288,11 +306,8 @@ def run_differential_frames(
     ``read_patches`` stream every round.  Final spans AND the accumulated
     patch streams must equal the scalar oracle.  Returns the number of docs
     that stayed on the frame fast path."""
-    import random
-
     from ..api.batch import _oracle_doc
     from ..parallel.codec import encode_frame
-    from ..parallel.streaming import StreamingMerge
     from .accumulate import accumulate_patches
 
     rng = random.Random(seed ^ 0xF7A3E5)
@@ -316,17 +331,7 @@ def run_differential_frames(
             )
             w.setdefault("doc3", []).append(change)
             injected.add(d)
-    sess = StreamingMerge(
-        num_docs=num_docs,
-        actors=("doc1", "doc2", "doc3"),
-        slot_capacity=max(256, 4 * ops_per_doc),
-        mark_capacity=max(64, ops_per_doc),
-        tomb_capacity=max(128, ops_per_doc),
-        round_insert_capacity=128,
-        round_delete_capacity=64,
-        round_mark_capacity=64,
-        mesh=mesh,
-    )
+    sess = _campaign_session(num_docs, ops_per_doc, mesh)
     patch_streams = {d: [] for d in range(num_docs)}
     for d, w in enumerate(workloads):
         changes = [ch for log in w.values() for ch in log]
@@ -381,6 +386,101 @@ def run_differential_frames(
     return on_fast_path
 
 
+def run_crash_restore(
+    seed: int, num_docs: int = 8, ops_per_doc: int = 80, mesh=None
+) -> int:
+    """Crash-consistency campaign: kill a streaming session mid-stream and
+    restore it from a CheckpointManager checkpoint (event-sourced frame
+    histories, checkpoint.py), then repair via one anti-entropy redelivery.
+
+    Per seed: deliver each doc's changes as shuffled chunked frames with
+    device rounds interleaved; checkpoint at a random mid-point; "crash"
+    (drop the session object); restore from the LATEST checkpoint — a mesh
+    session restores MESHLESS, exercising the digest's mesh invariance —
+    then redeliver a random overlapping suffix of every doc's frames
+    (duplicate-tolerant anti-entropy).  The restored session must reach the
+    clean session's digest, spans and roots, all equal to the oracle.
+    Returns the number of frames redelivered after restore."""
+    import tempfile
+
+    from ..api.batch import _oracle_doc
+    from ..checkpoint import CheckpointManager
+    from ..parallel.codec import encode_frame
+
+    rng = random.Random(seed ^ 0xC4A54)
+    workloads = generate_workload(seed, num_docs=num_docs, ops_per_doc=ops_per_doc)
+
+    def mk(use_mesh):
+        return _campaign_session(num_docs, ops_per_doc, use_mesh)
+
+    # per-doc frame schedule
+    plans = []
+    for w in workloads:
+        changes = [ch for log in w.values() for ch in log]
+        rng.shuffle(changes)
+        chunk = rng.randrange(5, 12)
+        plans.append(
+            [encode_frame(changes[i : i + chunk]) for i in range(0, len(changes), chunk)]
+        )
+
+    # clean reference session (no crash)
+    clean = mk(None)
+    for d, frames in enumerate(plans):
+        for f in frames:
+            clean.ingest_frame(d, f)
+    clean.drain()
+    clean_digest = clean.digest()
+
+    # crashing session: deliver a prefix, checkpoint, deliver a bit more, die
+    sess = mk(mesh)
+    cut = [rng.randrange(1, len(frames) + 1) for frames in plans]
+    for d, frames in enumerate(plans):
+        for f in frames[: cut[d]]:
+            sess.ingest_frame(d, f)
+            if rng.random() < 0.4:
+                sess.step()
+    sess.drain()
+    with tempfile.TemporaryDirectory() as tmp:
+        manager = CheckpointManager(tmp, keep=2)
+        manager.save(step=1, session=sess)
+        # post-checkpoint deliveries that will be LOST in the crash
+        for d, frames in enumerate(plans):
+            for f in frames[cut[d] : cut[d] + 1]:
+                sess.ingest_frame(d, f)
+        sess.step()
+        del sess  # crash
+
+        restored = manager.latest().session(mesh=None)  # meshless restore
+        assert restored is not None
+
+        # anti-entropy repair: redeliver an overlapping suffix (dup-tolerant)
+        redelivered = 0
+        for d, frames in enumerate(plans):
+            start = max(0, cut[d] - rng.randrange(0, 3))  # overlap into the ckpt
+            for f in frames[start:]:
+                restored.ingest_frame(d, f)
+                redelivered += 1
+                if rng.random() < 0.3:
+                    restored.step()
+        restored.drain()
+
+    assert restored.pending_count() == 0, f"seed={seed}: stuck changes after repair"
+    assert restored.digest() == clean_digest, (
+        f"seed={seed}: restored digest diverges after crash/repair"
+    )
+    for d, w in enumerate(workloads):
+        oracle = _oracle_doc(w)
+        expected = oracle.get_text_with_formatting(["text"])
+        got = restored.read(d)
+        assert got == expected, (
+            f"seed={seed} doc={d}: restored spans diverge from oracle"
+        )
+        assert restored.read_root(d) == oracle.root, (
+            f"seed={seed} doc={d}: restored root diverges from oracle"
+        )
+    return redelivered
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     """CLI for ``make fuzz`` (the reference's ``npm run fuzz`` analog,
     test/fuzz.ts:167 — but bounded by default and with real removeMark fuzzing).
@@ -422,10 +522,21 @@ def main(argv: Optional[List[str]] = None) -> None:
         help="scalar fuzz: inject delivery faults (drop 10%%, dup 10%%, "
              "reorder) on every sync hop; anti-entropy must still converge",
     )
+    parser.add_argument(
+        "--crash-restore", action="store_true",
+        help="streaming crash-consistency: checkpoint mid-stream, kill the "
+             "session, restore from CheckpointManager (meshless), redeliver "
+             "an overlapping suffix; digest/spans/roots must equal a clean "
+             "session and the oracle",
+    )
     args = parser.parse_args(argv)
-    if args.faults and (args.differential or args.differential_frames):
+    if args.faults and (args.differential or args.differential_frames
+                        or args.crash_restore):
         parser.error("--faults applies to the scalar fuzz only; it would be "
-                     "silently ignored with --differential/--differential-frames")
+                     "silently ignored with the other campaign flags")
+    if args.crash_restore and (args.differential or args.differential_frames):
+        parser.error("--crash-restore is its own campaign; combine with "
+                     "--mesh/--docs/--ops-per-doc only")
 
     mesh = None
     if args.mesh:
@@ -458,7 +569,16 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     seed = args.seed
     while True:
-        if args.differential_frames:
+        if args.crash_restore:
+            redelivered = run_crash_restore(
+                seed, num_docs=args.docs, ops_per_doc=args.ops_per_doc, mesh=mesh
+            )
+            print(
+                f"crash-restore seed={seed}: {args.docs} docs x "
+                f"{args.ops_per_doc} ops survived kill+restore+repair "
+                f"({redelivered} frames redelivered)", flush=True,
+            )
+        elif args.differential_frames:
             fast = run_differential_frames(seed, args.docs, args.ops_per_doc, mesh=mesh)
             print(
                 f"frames-differential seed={seed}: {args.docs} docs x "

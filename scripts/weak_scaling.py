@@ -35,7 +35,9 @@ def main() -> None:
     parser.add_argument("--sizes", type=int, nargs="+", default=[1, 2, 4, 8])
     args = parser.parse_args()
 
-    sys.path.insert(0, ".")
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from peritext_tpu.utils.platform import pin_cpu_platform
 
     devices = pin_cpu_platform(max(args.sizes))
@@ -116,8 +118,10 @@ def main() -> None:
             "docs": docs,
             "total_ops": total_ops,
             "batch_seconds": round(batch_s, 3),
+            "batch_ops_per_sec_total": round(total_ops / batch_s, 1),
             "batch_ops_per_sec_per_device": round(total_ops / batch_s / n, 1),
             "streaming_seconds": round(stream_s, 3),
+            "streaming_ops_per_sec_total": round(total_ops / stream_s, 1),
             "streaming_ops_per_sec_per_device": round(total_ops / stream_s / n, 1),
             "probe_digest": digests[n],
         }))

@@ -276,3 +276,14 @@ class TestSessionCheckpoint:
         assert restored.docs[0].fallback
         assert restored.digest() == sess.digest()
         assert restored.read_all() == sess.read_all()
+
+
+def test_crash_restore_campaign():
+    """Kill + checkpoint-restore + anti-entropy repair reaches the clean
+    session's digest and the oracle's spans/roots (fuzz.run_crash_restore;
+    the mesh variant restores MESHLESS, exercising digest mesh-invariance)."""
+    from peritext_tpu.parallel.mesh import make_mesh
+    from peritext_tpu.testing.fuzz import run_crash_restore
+
+    assert run_crash_restore(seed=11, num_docs=6, ops_per_doc=60) > 0
+    assert run_crash_restore(seed=12, num_docs=6, ops_per_doc=60, mesh=make_mesh(4)) > 0
