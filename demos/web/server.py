@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Two live editors against the TPU merge backend, in a browser.
+
+The reference ships its two-editor demo on ProseMirror in the browser
+(``/root/reference/src/index.ts:122-126``, ``index.html:41``).  This is the
+framework's equivalent: a dependency-free page (demos/web/index.html) with two
+editable panes talking to this server, which hosts two ``bridge.Editor``
+instances on the ``tpu`` backend sharing an in-memory ``Publisher`` — the
+exact replication topology of the reference demo, including the manual Sync
+button (changes queue locally until synced, then anti-entropy merges both
+ways).
+
+Run:  python demos/web/server.py [--port 8700] [--backend tpu|scalar]
+then open http://localhost:8700/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from peritext_tpu.bridge.bridge import create_editor, initialize_docs
+from peritext_tpu.parallel.pubsub import Publisher
+
+_HERE = Path(__file__).parent
+
+
+class Session:
+    """The two editors plus a lock (bridge editors are single-threaded)."""
+
+    def __init__(self, backend: str = "tpu") -> None:
+        self.lock = threading.Lock()
+        self.pub = Publisher()
+        actors = ("alice", "bob", "init")
+        self.editors = {
+            "alice": create_editor("alice", self.pub, backend=backend, actors=actors),
+            "bob": create_editor("bob", self.pub, backend=backend, actors=actors),
+        }
+        initialize_docs(
+            [self.editors["alice"], self.editors["bob"]],
+            "The Peritext editor",
+        )
+
+    def state(self) -> dict:
+        return {
+            name: {
+                "spans": ed.view.spans(),
+                "pending": len(ed.queue) if hasattr(ed, "queue") else 0,
+            }
+            for name, ed in self.editors.items()
+        }
+
+    def dispatch(self, editor: str, ops) -> None:
+        self.editors[editor].dispatch_input_ops(ops)
+
+    def sync(self) -> None:
+        for ed in self.editors.values():
+            ed.sync()
+
+
+SESSION: Session = None  # set in main()
+
+
+class Handler(BaseHTTPRequestHandler):
+    def _json(self, payload, status=200):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path in ("/", "/index.html"):
+            body = (_HERE / "index.html").read_bytes()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/state":
+            with SESSION.lock:
+                self._json(SESSION.state())
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            with SESSION.lock:
+                if self.path == "/op":
+                    SESSION.dispatch(payload["editor"], payload["ops"])
+                elif self.path == "/sync":
+                    SESSION.sync()
+                else:
+                    self._json({"error": "not found"}, 404)
+                    return
+                self._json(SESSION.state())
+        except Exception as exc:  # surface editor errors to the page
+            self._json({"error": repr(exc)}, 400)
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+
+def main() -> None:
+    global SESSION
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--port", type=int, default=8700)
+    parser.add_argument("--backend", default="tpu", choices=("tpu", "scalar"))
+    args = parser.parse_args()
+    SESSION = Session(backend=args.backend)
+    server = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
+    print(f"two-editor demo ({args.backend} backend): http://127.0.0.1:{args.port}/")
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

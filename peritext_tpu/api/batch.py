@@ -27,7 +27,7 @@ import numpy as np
 from ..core.doc import Doc
 from ..core.types import Change, FormatSpan
 from ..observability import GLOBAL_COUNTERS, MergeStats
-from ..ops.decode import decode_doc_spans
+from ..ops.decode import decode_block_spans
 from ..ops.encode import EncodedBatch, encode_workloads
 from ..ops.kernel import apply_batch, apply_batch_jit, encoded_arrays_of
 from ..ops.packed import PackedDocs, empty_docs
@@ -190,6 +190,17 @@ class DocBatch:
             r_op=np.asarray(state.r_op), r_kind=np.asarray(state.r_kind),
             r_val=np.asarray(state.r_val), num_regs=np.asarray(state.num_regs),
         )
+        # one vectorized span decode for the whole batch (Python touches only
+        # mark-run segments); fallback docs replay through the oracle
+        device_mask = np.zeros(resolved.visible.shape[0], bool)
+        for d in range(len(workloads)):
+            device_mask[d] = d not in fallback
+        block_spans = decode_block_spans(
+            resolved,
+            lambda d: encoded.attr_tables[d],
+            lambda d: encoded.attr_tables[d],
+            doc_mask=device_mask,
+        )
         spans: List[List[FormatSpan]] = []
         roots: List[dict] = []
         device_ops = 0
@@ -201,7 +212,7 @@ class DocBatch:
                 roots.append(doc.root)
                 fallback_ops += int(encoded.num_ops[d])
             else:
-                spans.append(decode_doc_spans(resolved, d, encoded.attr_tables[d]))
+                spans.append(block_spans[d])
                 roots.append(
                     decode_doc_root(regs, resolved, d, encoded.map_tables[d])
                 )

@@ -408,13 +408,26 @@ def parse_frames_bulk(
         from .packed import OBJ_ROOT, VK_TEXT
 
         jr_frames = frames_of_ops(json_rows)
-        for f in np.unique(jr_frames):
+        # change index of every json row, vectorized once (a per-row
+        # searchsorted over a 20M-entry ops_off would dominate at pod scale)
+        jr_chs = np.searchsorted(ops_off, json_rows, side="right") - 1
+        ch_of_row = dict(zip(json_rows.tolist(), jr_chs.tolist()))
+        # group rows per frame ONCE (a per-frame boolean scan would be
+        # quadratic at 100K frames/call)
+        order = np.argsort(jr_frames, kind="stable")
+        sorted_frames = jr_frames[order]
+        grp_starts = np.nonzero(
+            np.concatenate([[True], sorted_frames[1:] != sorted_frames[:-1]])
+        )[0]
+        grp_ends = np.append(grp_starts[1:], len(order))
+        for gs, ge in zip(grp_starts.tolist(), grp_ends.tolist()):
+            f = int(sorted_frames[gs])
             if status[f]:
                 continue
             doc = int(doc_ids[f])
             local_text = text_obj_by_doc.get(doc, 0)
             staged: list = []
-            for row in json_rows[jr_frames == f]:
+            for row in json_rows[order[gs:ge]]:
                 try:
                     op = Operation.from_json(json.loads(string_at(int(ops[row, 3]))))
                 except (ValueError, TypeError, KeyError, AttributeError,
@@ -450,8 +463,7 @@ def parse_frames_bulk(
                 # any other key (the object path emits the same register),
                 # instead of being host-injected at read time.
                 for row, pobj, packed, key in staged:
-                    ch = int(np.searchsorted(ops_off, row, side="right")) - 1
-                    cnt_map[ch] += 1
+                    cnt_map[ch_of_row[int(row)]] += 1
                     ops[row, 0] = KIND_MAP
                     ops[row, 1] = pobj
                     ops[row, 2] = packed

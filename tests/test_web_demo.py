@@ -1,0 +1,85 @@
+"""The browser two-editor demo's HTTP contract (demos/web/server.py):
+edits dispatch through the TPU bridge backend, queue until Sync, and
+anti-entropy converges both panes — the reference's index.ts experience."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def demo_url():
+    import importlib.util
+    from http.server import ThreadingHTTPServer
+    from pathlib import Path
+
+    path = Path(__file__).parents[1] / "demos" / "web" / "server.py"
+    spec = importlib.util.spec_from_file_location("web_demo_server", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.SESSION = mod.Session(backend="tpu")
+    server = ThreadingHTTPServer(("127.0.0.1", 0), mod.Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def _post(url, path, payload):
+    req = urllib.request.Request(url + path, data=json.dumps(payload).encode())
+    with urllib.request.urlopen(req) as res:
+        return json.loads(res.read())
+
+
+def _get(url, path):
+    with urllib.request.urlopen(url + path) as res:
+        return json.loads(res.read())
+
+
+def _text(spans):
+    return "".join(s["text"] for s in spans)
+
+
+def test_page_and_state(demo_url):
+    with urllib.request.urlopen(demo_url + "/") as res:
+        assert b"contenteditable" in res.read()
+    state = _get(demo_url, "/state")
+    assert _text(state["alice"]["spans"]) == _text(state["bob"]["spans"])
+
+
+def test_edit_queue_sync_converges(demo_url):
+    state = _post(demo_url, "/op", {
+        "editor": "alice",
+        "ops": [{"path": ["text"], "action": "insert", "index": 0,
+                 "values": list("Yo ")}],
+    })
+    assert _text(state["alice"]["spans"]).startswith("Yo ")
+    assert state["alice"]["pending"] == 1  # queued until Sync
+    assert not _text(state["bob"]["spans"]).startswith("Yo ")
+
+    _post(demo_url, "/op", {
+        "editor": "bob",
+        "ops": [{"path": ["text"], "action": "addMark", "startIndex": 0,
+                 "endIndex": 3, "markType": "strong"}],
+    })
+    state = _post(demo_url, "/sync", {})
+    assert state["alice"]["spans"] == state["bob"]["spans"]
+    assert state["alice"]["pending"] == state["bob"]["pending"] == 0
+    assert any(
+        s["marks"].get("strong", {}).get("active") for s in state["alice"]["spans"]
+    )
+
+
+def test_bad_op_reports_error_not_500(demo_url):
+    req = urllib.request.Request(
+        demo_url + "/op",
+        data=json.dumps({"editor": "alice", "ops": [{"bogus": 1}]}).encode(),
+    )
+    try:
+        urllib.request.urlopen(req)
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as err:
+        assert err.code == 400
+        assert "error" in json.loads(err.read())
