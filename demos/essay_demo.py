@@ -114,22 +114,37 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--realtime", action="store_true", help="honor event delays")
     parser.add_argument("--loop", type=int, default=1, help="play the trace N times")
+    parser.add_argument(
+        "--short", action="store_true",
+        help="play the short pedagogical trace instead of the full scripted "
+             "essay session (demos/essay_content.py)",
+    )
     args = parser.parse_args()
 
     publisher = Publisher()
     highlights = {}
     editors = make_editors(publisher, highlights)
 
-    sections = iter(
-        ["typing", "concurrent bold+italic overlap", "conflicting links (LWW)", "comments co-exist"]
-    )
+    if args.short:
+        section_names = ["typing", "concurrent bold+italic overlap",
+                         "conflicting links (LWW)", "comments co-exist"]
+    else:
+        from essay_content import ESSAY_SECTIONS
+
+        section_names = ESSAY_SECTIONS
+    sections = iter(section_names)
 
     def on_sync():
         label = next(sections, "sync")
         print(f"\n-- sync: {label} --")
         # flush happens after this hook, so render post-event below
 
-    trace = build_trace()
+    if args.short:
+        trace = build_trace()
+    else:
+        from essay_content import build_essay_trace
+
+        trace = build_essay_trace()
     for _ in range(args.loop):
         for event in trace:
             execute_trace_event(event, editors, on_sync=on_sync, realtime=args.realtime)
