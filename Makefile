@@ -5,8 +5,8 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test fuzz fuzz-differential fuzz-frames bench bench-smoke \
-	bench-streaming entry dryrun lint clean
+.PHONY: test fuzz fuzz-differential fuzz-frames fuzz-crash weak-scaling \
+	bench bench-smoke bench-streaming entry dryrun lint clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -17,6 +17,14 @@ fuzz:
 # device path vs scalar oracle (spans + cursors)
 fuzz-differential:
 	$(CPU_ENV) $(PY) -m peritext_tpu.testing.fuzz --differential
+
+# crash-consistency: checkpoint mid-stream, kill, restore, repair
+fuzz-crash:
+	$(CPU_ENV) $(PY) -m peritext_tpu.testing.fuzz --crash-restore
+
+# 1/2/4/8-device virtual-mesh scaling + digest-invariance evidence
+weak-scaling:
+	$(PY) scripts/weak_scaling.py
 
 # streaming frame ingest vs oracle (spans + incremental patch streams)
 fuzz-frames:

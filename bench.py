@@ -6,10 +6,12 @@ converging a batch of concurrently-edited documents, vs the single-thread
 scalar baseline.
 
 Baseline caveat: BASELINE.json config 1 calls for the reference TypeScript
-micromerge on one CPU core, but this image has no node runtime, so the
-single-thread baseline is this framework's own scalar Python oracle
-(core/doc.py — the same semantics, measured on one core).  The oracle applies
-internal ops through the same applyChange path the reference does.
+micromerge on one CPU core, but this image has no node runtime.  Two
+stand-ins are measured every run: the C++ single-core scalar apply
+(``native.pt_scalar_apply`` — a HARDER bar than interpreted TS; this is
+what ``vs_baseline`` divides by) and the framework's own pure-Python scalar
+oracle (continuity with round-1 records, reported as
+``python_oracle_ops_per_sec``).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "ops/s", "vs_baseline": N, ...extras}
