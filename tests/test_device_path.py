@@ -304,3 +304,32 @@ def test_device_map_register_overflow_falls_back():
     from peritext_tpu.api.batch import _oracle_doc
 
     assert report.roots[0] == _oracle_doc(w).root  # served by the oracle
+
+
+def test_comment_capacity_beyond_one_bitmask_word():
+    """comment_capacity > 32 packs into multiple uint32 words (W=2); ids in
+    the second word must round-trip through resolve + decode exactly."""
+    from peritext_tpu.api.batch import _oracle_doc
+
+    docs, _, initial = generate_docs("abcdef", 1)
+    d1 = docs[0]
+    store = [initial]
+    for i in range(40):  # 40 distinct ids -> word 0 and word 1 both used
+        c, _ = d1.change([
+            {"path": ["text"], "action": "addMark", "startIndex": i % 3,
+             "endIndex": 3 + (i % 3), "markType": "comment",
+             "attrs": {"id": f"many-{i:02d}"}},
+        ])
+        store.append(c)
+    # remove a second-word id again (winner must flip back off)
+    c, _ = d1.change([
+        {"path": ["text"], "action": "removeMark", "startIndex": 0,
+         "endIndex": 6, "markType": "comment", "attrs": {"id": "many-37"}},
+    ])
+    store.append(c)
+    w = {"doc1": store}
+    report = DocBatch(
+        slot_capacity=64, mark_capacity=64, comment_capacity=64
+    ).merge([w])
+    assert report.fallback_docs == []
+    assert report.spans[0] == _oracle_doc(w).get_text_with_formatting(["text"])
