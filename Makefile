@@ -51,7 +51,7 @@ dryrun:
 # No linter is baked into the image; syntax-compile everything as a floor.
 # CI runs ruff with the config in pyproject.toml.
 lint:
-	$(PY) -m compileall -q peritext_tpu tests demos bench.py __graft_entry__.py
+	$(PY) -m compileall -q peritext_tpu tests demos scripts bench.py __graft_entry__.py
 
 clean:
 	rm -rf peritext_tpu/native/_build .pytest_cache
