@@ -15,14 +15,60 @@ oracle (continuity with round-1 records, reported as
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "ops/s", "vs_baseline": N, ...extras}
+
+Evidence-capture discipline (round 3): the default entry point is an
+ORCHESTRATOR that never hangs and always prints that JSON line.  It probes
+the TPU backend in a subprocess under a bounded timeout (the axon tunnel
+has been observed to hang ``jax.devices()`` indefinitely when down), retries
+a couple of times, and on persistent unavailability reruns the measurement
+on CPU — exit 0, with ``"tpu_unavailable": true`` and the captured error
+tail merged into the JSON.  The actual measurement runs in a worker
+subprocess (hidden ``--_worker`` flag) that is itself under a timeout, so a
+mid-benchmark wedge also converts into a structured record instead of a
+lost round.  Set ``PT_BENCH_SIMULATE_TPU=hang|fail`` to exercise the
+dead-tunnel paths without a tunnel (used by tests/test_bench_harness.py).
 """
 
 import argparse
 import json
+import os
+import signal
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+# Bounded-timeout policy for the orchestrator (seconds; env-overridable so
+# the driver or tests can tighten them).
+PROBE_TIMEOUT = float(os.environ.get("PT_BENCH_PROBE_TIMEOUT", "150"))
+PROBE_ATTEMPTS = int(os.environ.get("PT_BENCH_PROBE_ATTEMPTS", "3"))
+PROBE_BACKOFF = float(os.environ.get("PT_BENCH_PROBE_BACKOFF", "5"))
+WORKER_TIMEOUT = float(os.environ.get("PT_BENCH_TIMEOUT", "2700"))
+
+# The probe child: initialize the default jax backend (axon plugin when the
+# tunnel is up, else cpu) AND round-trip one tiny device computation —
+# backend init succeeding while the first computation wedges was round 2's
+# observed failure mode.  PT_BENCH_SIMULATE_TPU lets tests exercise the
+# hang/fail paths deterministically on a CPU-only image.
+_PROBE_CODE = r"""
+import os, sys, time
+sim = os.environ.get("PT_BENCH_SIMULATE_TPU", "")
+if sim == "hang":
+    time.sleep(100000)
+if sim == "fail":
+    sys.stderr.write("RuntimeError: simulated TPU backend failure (PT_BENCH_SIMULATE_TPU=fail)\n")
+    sys.exit(1)
+import jax
+if sim == "cpu":  # simulate an image with no TPU plugin attached
+    jax.config.update("jax_platforms", "cpu")
+import numpy as np
+dev = jax.devices()[0]
+x = jax.device_put(np.arange(8, dtype=np.int32))
+total = int(np.asarray(x + 1).sum())  # honest sync: small host transfer
+assert total == 36, total
+print("PROBE_OK", dev.platform)
+"""
 
 
 def _baseline_changes(num_ops: int = 4000, seed: int = 7):
@@ -309,6 +355,141 @@ def run_streaming(args) -> dict:
     }
 
 
+def _run_bounded(argv, timeout):
+    """Run argv in its own session under a hard timeout; SIGKILL the whole
+    process group on expiry (a plain terminate can leave tunnel threads
+    holding the pipe open).  Returns (rc, stdout, stderr); rc is None on
+    timeout."""
+    proc = subprocess.Popen(
+        argv,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        out, err = proc.communicate()
+        return None, out, err
+
+
+def probe_device(timeout=PROBE_TIMEOUT, attempts=PROBE_ATTEMPTS,
+                 backoff=PROBE_BACKOFF):
+    """Bounded-timeout TPU/default-backend probe with retries.
+
+    Returns (platform | None, error_tail).  platform is the default jax
+    backend's platform name when init + one device round-trip succeed within
+    the timeout; None means every attempt hung or failed (error_tail carries
+    the last stderr/stdout tail for the evidence record)."""
+    tail = ""
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(backoff)
+        rc, out, err = _run_bounded([sys.executable, "-c", _PROBE_CODE], timeout)
+        for line in out.splitlines():
+            if line.startswith("PROBE_OK"):
+                return line.split()[1], ""
+        status = "timed out" if rc is None else f"rc={rc}"
+        tail = f"probe attempt {attempt + 1}/{attempts} {status}: " + (
+            (err or out).strip()[-1500:]
+        )
+        print(f"bench: {tail}", file=sys.stderr)
+    return None, tail
+
+
+def _parse_json_tail(out):
+    """Last stdout line that parses as a JSON object (jax warnings precede it)."""
+    for line in reversed(out.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _worker_argv(extra):
+    return [sys.executable, os.path.abspath(__file__), "--_worker", *extra]
+
+
+def orchestrate(args, passthrough) -> int:
+    """Probe → run worker under timeout → always print one JSON line.
+
+    Exit 0 whenever a measurement (TPU or CPU-fallback) was recorded; exit 1
+    only if even the CPU path failed — and still print a structured JSON
+    line with the error tail so the driver's record stays parseable."""
+    extras = {}
+    if args.platform:
+        platform = args.platform  # explicit: trust the caller, no probe
+    else:
+        t0 = time.perf_counter()
+        platform, probe_tail = probe_device()
+        extras["probe_seconds"] = round(time.perf_counter() - t0, 1)
+        if platform is None:
+            extras["tpu_unavailable"] = True
+            extras["tpu_error"] = probe_tail
+            platform = "cpu"
+        elif platform == "cpu":
+            # default backend is already cpu: no TPU plugin in this env
+            extras["tpu_unavailable"] = True
+            extras["tpu_error"] = "default jax backend is cpu (no TPU plugin attached)"
+
+    attempts_left = 2 if platform not in (None, "cpu") else 1
+    while True:
+        # Pin the platform only for the cpu fallback (or an explicit user
+        # choice): the axon plugin registers backend name "axon" but reports
+        # device platform "tpu", so re-pinning the probed name could miss —
+        # the worker should init the default backend exactly as the probe did.
+        if platform == "cpu" or args.platform:
+            worker_args = [*passthrough, "--platform", platform]
+        else:
+            worker_args = list(passthrough)
+        if platform == "cpu" and extras.get("tpu_unavailable") and not args.smoke \
+                and args.docs is None and args.ops_per_doc is None:
+            # CPU fallback of a full-size TPU config would run for tens of
+            # minutes; record the smoke config instead, and say so.
+            worker_args.append("--smoke")
+            extras["fallback_config"] = "smoke"
+        rc, out, err = _run_bounded(_worker_argv(worker_args), WORKER_TIMEOUT)
+        result = _parse_json_tail(out)
+        if rc == 0 and result is not None:
+            result.update(extras)
+            print(json.dumps(result))
+            return 0
+        status = "timed out" if rc is None else f"rc={rc}"
+        tail = (err or out).strip()[-1500:]
+        print(f"bench: worker on {platform} {status}: {tail}", file=sys.stderr)
+        attempts_left -= 1
+        if attempts_left > 0:
+            continue
+        if platform != "cpu":
+            # TPU passed the probe but the measurement died: fall back
+            extras["tpu_unavailable"] = True
+            extras["tpu_error"] = f"worker on {platform} {status}: {tail}"
+            platform = "cpu"
+            attempts_left = 1
+            continue
+        # even CPU failed — structured failure record, nonzero exit
+        print(json.dumps({
+            "metric": "streaming_crdt_ops_per_sec_per_chip"
+            if args.mode == "streaming" else "crdt_ops_per_sec_per_chip",
+            "value": None,
+            "unit": "ops/s",
+            "vs_baseline": None,
+            "failed": True,
+            "error": f"worker on cpu {status}: {tail}",
+            **extras,
+        }))
+        return 1
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--smoke", action="store_true", help="small fast config")
@@ -336,7 +517,20 @@ def main() -> None:
         "--profile", default=None, metavar="DIR",
         help="capture a jax.profiler trace of the steady-state loop into DIR",
     )
+    parser.add_argument(
+        "--_worker", action="store_true", dest="worker", help=argparse.SUPPRESS
+    )
     args = parser.parse_args()
+
+    if not args.worker:
+        # argv minus the program name IS the passthrough (worker re-parses it);
+        # --platform is re-added per attempt by the orchestrator.
+        argv = sys.argv[1:]
+        passthrough = [a for i, a in enumerate(argv)
+                       if a != "--platform"
+                       and not a.startswith("--platform=")
+                       and not (i > 0 and argv[i - 1] == "--platform")]
+        sys.exit(orchestrate(args, passthrough))
 
     if args.mode == "streaming":
         defaults = (64, 96, 256, 64) if args.smoke else (2048, 192, 384, 96)

@@ -1,0 +1,146 @@
+"""Evidence-capture resilience of the bench orchestrator (bench.py).
+
+Round 2 lost its TPU perf record to a tunnel flake: backend init raised /
+hung and BENCH_r02.json recorded rc=1, parsed=null.  These tests pin the
+round-3 contract — whatever the tunnel does, ``python bench.py`` prints one
+parseable JSON line and exits 0 (nonzero only when even the CPU path is
+broken, and still with a JSON line).
+
+The dead-tunnel modes are simulated with PT_BENCH_SIMULATE_TPU=fail|hang,
+which the probe child honours before importing jax (there is no tunnel to
+kill in this CPU-only test image).  Reference analog: the reference CI's
+"every job always reports a signal" discipline (.github/workflows/ci.yml).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+def _run_bench(extra_args=(), env_extra=(), timeout=600):
+    env = dict(os.environ)
+    # the orchestrator's probe child must see the plain environment (tests
+    # pin JAX_PLATFORMS=cpu via conftest, which doubles as "no TPU plugin")
+    env.update(dict(env_extra))
+    return subprocess.run(
+        [sys.executable, BENCH, "--smoke", "--iters", "2", *extra_args],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=timeout,
+    )
+
+
+def _json_line(stdout):
+    lines = [ln for ln in stdout.splitlines() if ln.strip().startswith("{")]
+    assert lines, f"no JSON line in stdout: {stdout!r}"
+    return json.loads(lines[-1])
+
+
+@pytest.mark.slow
+def test_explicit_cpu_platform_still_one_json_line():
+    """--platform cpu skips the probe and behaves exactly as round 2 did."""
+    proc = _run_bench(["--platform", "cpu"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = _json_line(proc.stdout)
+    assert result["metric"] == "crdt_ops_per_sec_per_chip"
+    assert result["value"] > 0
+    assert "tpu_unavailable" not in result  # user chose cpu; not a fallback
+
+
+@pytest.mark.slow
+def test_probe_failure_falls_back_to_cpu_exit_zero():
+    """A TPU backend that errors at init → CPU fallback, rc 0, flagged JSON."""
+    proc = _run_bench(env_extra={"PT_BENCH_SIMULATE_TPU": "fail",
+                                 "PT_BENCH_PROBE_ATTEMPTS": "2",
+                                 "PT_BENCH_PROBE_BACKOFF": "0"}.items())
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = _json_line(proc.stdout)
+    assert result["tpu_unavailable"] is True
+    assert "simulated TPU backend failure" in result["tpu_error"]
+    assert result["value"] > 0
+    assert result["platform"] == "cpu"
+
+
+@pytest.mark.slow
+def test_probe_hang_is_bounded_and_falls_back():
+    """A TPU backend that hangs forever (round 2's observed mode) → the
+    probe is killed at the timeout, retried, then CPU fallback with rc 0."""
+    proc = _run_bench(
+        env_extra={"PT_BENCH_SIMULATE_TPU": "hang",
+                   "PT_BENCH_PROBE_TIMEOUT": "3",
+                   "PT_BENCH_PROBE_ATTEMPTS": "2",
+                   "PT_BENCH_PROBE_BACKOFF": "0"}.items(),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = _json_line(proc.stdout)
+    assert result["tpu_unavailable"] is True
+    assert "timed out" in result["tpu_error"]
+    assert result["value"] > 0
+    # the probe phase must have been bounded: 2 attempts x 3s + slack
+    assert result["probe_seconds"] < 60
+
+
+def test_probe_ok_on_cpu_only_env_flags_unavailability(monkeypatch):
+    """No TPU plugin (default backend = cpu) is recorded as tpu_unavailable
+    so a driver run on a chip-less host can't masquerade as a TPU number.
+    (This image does ship the axon plugin, so the plugin-less default is
+    simulated — PT_BENCH_SIMULATE_TPU=cpu pins the probe child to cpu.)"""
+    import bench
+
+    monkeypatch.setenv("PT_BENCH_SIMULATE_TPU", "cpu")
+    platform, tail = bench.probe_device(timeout=120, attempts=1)
+    assert platform == "cpu"
+    assert tail == ""
+
+
+def test_parse_json_tail_skips_warnings():
+    import bench
+
+    out = "WARNING: platform axon is experimental\nnot json {\n" + json.dumps(
+        {"metric": "m", "value": 1}
+    )
+    assert bench._parse_json_tail(out) == {"metric": "m", "value": 1}
+    assert bench._parse_json_tail("no json here") is None
+
+
+def test_worker_crash_yields_structured_failure_line():
+    """If even the CPU worker dies, the orchestrator still prints a JSON
+    line carrying the error tail (rc 1 is then honest)."""
+    import bench
+
+    class _Args:
+        platform = "cpu"
+        smoke = True
+        docs = None
+        ops_per_doc = None
+        mode = "batch"
+
+    real = bench._run_bounded
+    calls = []
+
+    def fake_run_bounded(argv, timeout):
+        calls.append(argv)
+        return 1, "", "boom: synthetic worker crash"
+
+    bench._run_bounded = fake_run_bounded
+    try:
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = bench.orchestrate(_Args(), ["--smoke"])
+    finally:
+        bench._run_bounded = real
+    assert rc == 1
+    result = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert result["failed"] is True
+    assert "synthetic worker crash" in result["error"]
+    assert result["value"] is None
+    assert len(calls) >= 1
