@@ -398,11 +398,63 @@ def parse_frames_bulk(
             if status[f] == FRAME_OK:
                 status[f] = FRAME_DEMOTE
 
+    # Session-level string interning (mark attrs, map keys, map string
+    # values).  Unique by byte CONTENT, not by global string id: every frame
+    # carries its own string table, so the same url / key reappears under
+    # thousands of distinct gids at pod scale.  Fully vectorized — group by
+    # length, gather an (N, len) byte matrix, np.unique rows, decode only
+    # the handful of distinct strings.
+    def intern_column(rows: np.ndarray, col: int, offset: int, table: Interner):
+        """Rewrite ``ops[rows, col]`` (global strid + offset) to interned
+        ids; flags frames of undecodable strings corrupt."""
+        gids = ops[rows, col] - offset
+        starts = str_start[gids]
+        lens = str_len[gids]
+        new_ids = np.zeros(len(rows), np.int32)
+        bad_mask = np.zeros(len(rows), bool)
+        for ln in np.unique(lens):
+            sel = np.nonzero(lens == ln)[0]
+            if ln == 0:
+                new_ids[sel] = table.intern("")
+                continue
+            content = buf[starts[sel][:, None] + np.arange(int(ln), dtype=np.int64)]
+            uniq_rows, inv = np.unique(content, axis=0, return_inverse=True)
+            ids = np.empty(len(uniq_rows), np.int32)
+            for j in range(len(uniq_rows)):
+                try:
+                    ids[j] = table.intern(uniq_rows[j].tobytes().decode("utf-8"))
+                except UnicodeDecodeError:
+                    ids[j] = -1  # decode failure: corrupt-frame semantics
+            mapped = ids[inv]
+            bad_mask[sel] = mapped < 0
+            new_ids[sel] = np.maximum(mapped, 0)
+        if bad_mask.any():
+            status[frames_of_ops(rows[bad_mask])] = FRAME_CORRUPT
+        ops[rows, col] = new_ids
+
+    attr_rows = np.nonzero((kinds == KIND_MARK) & (ops[:, 9] > 0))[0]
+    if len(attr_rows):
+        intern_column(attr_rows, col=9, offset=1, table=attrs)
+    # only rows the NATIVE parser emitted carry global string ids; rows the
+    # JSON loop below converts are interned as they are rewritten
+    if len(native_map_rows):
+        from .packed import VK_STR
+
+        intern_column(native_map_rows, col=3, offset=0, table=keys)
+        str_val_rows = native_map_rows[ops[native_map_rows, 4] == VK_STR]
+        if len(str_val_rows):
+            intern_column(str_val_rows, col=5, offset=1, table=keys)
+
     # JSON-spillover rows: only each doc's makeList is fast-path-able (same
     # contract as parse_frame).  Frames are processed in arrival order so a
     # makeList learned from an earlier frame governs later frames of the same
     # doc — but each frame's adoption commits only if the whole frame stays
-    # OK (a frame that fails mid-way must contribute nothing).
+    # OK (a frame that fails mid-way must contribute nothing).  This loop
+    # runs AFTER the string-interning passes above so a frame they flag
+    # FRAME_CORRUPT (undecodable mark-attr / map-key bytes) is skipped here
+    # and can never leak a makeList adoption into text_obj_by_doc
+    # (advisor finding r2: a crafted corrupt frame could otherwise poison a
+    # doc's text object and demote all its later valid text ops).
     json_rows = np.nonzero(kinds == KIND_JSON)[0]
     if len(json_rows):
         from .packed import OBJ_ROOT, VK_TEXT
@@ -471,53 +523,6 @@ def parse_frames_bulk(
                     ops[row, 4] = VK_TEXT
                     ops[row, 5] = packed
                     ops[row, 6:] = 0
-
-    # Session-level string interning (mark attrs, map keys, map string
-    # values).  Unique by byte CONTENT, not by global string id: every frame
-    # carries its own string table, so the same url / key reappears under
-    # thousands of distinct gids at pod scale.  Fully vectorized — group by
-    # length, gather an (N, len) byte matrix, np.unique rows, decode only
-    # the handful of distinct strings.
-    def intern_column(rows: np.ndarray, col: int, offset: int, table: Interner):
-        """Rewrite ``ops[rows, col]`` (global strid + offset) to interned
-        ids; flags frames of undecodable strings corrupt."""
-        gids = ops[rows, col] - offset
-        starts = str_start[gids]
-        lens = str_len[gids]
-        new_ids = np.zeros(len(rows), np.int32)
-        bad_mask = np.zeros(len(rows), bool)
-        for ln in np.unique(lens):
-            sel = np.nonzero(lens == ln)[0]
-            if ln == 0:
-                new_ids[sel] = table.intern("")
-                continue
-            content = buf[starts[sel][:, None] + np.arange(int(ln), dtype=np.int64)]
-            uniq_rows, inv = np.unique(content, axis=0, return_inverse=True)
-            ids = np.empty(len(uniq_rows), np.int32)
-            for j in range(len(uniq_rows)):
-                try:
-                    ids[j] = table.intern(uniq_rows[j].tobytes().decode("utf-8"))
-                except UnicodeDecodeError:
-                    ids[j] = -1  # decode failure: corrupt-frame semantics
-            mapped = ids[inv]
-            bad_mask[sel] = mapped < 0
-            new_ids[sel] = np.maximum(mapped, 0)
-        if bad_mask.any():
-            status[frames_of_ops(rows[bad_mask])] = FRAME_CORRUPT
-        ops[rows, col] = new_ids
-
-    attr_rows = np.nonzero((kinds == KIND_MARK) & (ops[:, 9] > 0))[0]
-    if len(attr_rows):
-        intern_column(attr_rows, col=9, offset=1, table=attrs)
-    # only rows the NATIVE parser emitted carry global string ids; rows the
-    # JSON loop converted above are already interned
-    if len(native_map_rows):
-        from .packed import VK_STR
-
-        intern_column(native_map_rows, col=3, offset=0, table=keys)
-        str_val_rows = native_map_rows[ops[native_map_rows, 4] == VK_STR]
-        if len(str_val_rows):
-            intern_column(str_val_rows, col=5, offset=1, table=keys)
 
     parsed = ParsedChanges(
         ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops,

@@ -53,6 +53,7 @@ from ..ops.encode import MAP_STREAM_COLS, MARK_COLS
 from ..ops.frames import (
     FRAME_CORRUPT,
     FRAME_DEMOTE,
+    FRAME_OK,
     KIND_MARK,
     FrameIngestError,
     ParsedChanges,
@@ -293,6 +294,14 @@ class StreamingMerge:
         if len(sel):
             ch_idx = np.searchsorted(parsed.ops_off, sel, side="right") - 1
             f_idx = np.searchsorted(f_ch_off, ch_idx, side="right") - 1
+            # Intern only rows of frames that passed every corrupt/demote
+            # check: rows of discarded frames never reach the device, and
+            # interning their ids would let an adversarial peer exhaust the
+            # doc's dense comment-id space (capacity C) with corrupt frames,
+            # permanently routing its reads to scalar replay (advisor r2).
+            ok = status[f_idx] == FRAME_OK
+            sel, ch_idx, f_idx = sel[ok], ch_idx[ok], f_idx[ok]
+        if len(sel):
             docs_of_rows = doc_ids[f_idx].astype(np.int64)
             keycode = (docs_of_rows << 32) | ops[sel, 9].astype(np.int64)
             uniq, inv = np.unique(keycode, return_inverse=True)
@@ -1090,15 +1099,27 @@ class StreamingMerge:
 def _doc_char_slots(doc: Doc):
     """(visible codepoints, their slot positions in full element order incl.
     tombstones) for a scalar replica's text list — the inputs the device
-    digest formula needs (mesh.doc_digest_host)."""
-    try:
-        list_id = doc.get_object_id_for_path(["text"])
-    except Exception:
+    digest formula needs (mesh.doc_digest_host).
+
+    The text list is located by OBJECT, not by the literal ``["text"]``
+    path: encode_doc/the frame parser accept a makeList under any key, and
+    the device path adopts whichever list the doc created — so a fallback
+    doc whose list key isn't "text" must still hash the same list a
+    device-resident peer adopted (advisor r2: the path-keyed lookup hashed
+    such docs as empty, silently breaking digest parity across demotion
+    sets).  With several lists (device peers demote such docs, but both
+    sides of the comparison must stay deterministic) the earliest-created
+    one — minimum (ctr, actor) opid, the same total order compareOpIds
+    defines — is hashed."""
+    list_ids = [
+        oid for oid, meta in doc._metadata.items()
+        if isinstance(meta, list) and oid in doc._objects
+    ]
+    if not list_ids:
         return [], []
-    meta = doc._metadata.get(list_id)
-    text = doc._objects.get(list_id)
-    if meta is None or text is None:
-        return [], []
+    list_id = min(list_ids)  # OpId tuples order exactly as compareOpIds
+    meta = doc._metadata[list_id]
+    text = doc._objects[list_id]
     cps, slots, vis = [], [], 0
     for i, el in enumerate(meta):
         if not el.deleted:

@@ -376,3 +376,79 @@ def test_bulk_corrupt_frame_does_not_adopt_makelist():
     assert s.docs[0].frames == [follow] and not s.docs[0].fallback
     s.drain()
     assert "".join(sp["text"] for sp in s.read(0)) == "The Peritext editor"
+
+
+@pytest.mark.skipif(not native.available(), reason="needs native core")
+def test_bulk_undecodable_attr_does_not_adopt_makelist():
+    """A frame flagged corrupt by STRING INTERNING (undecodable UTF-8 mark
+    attr) must not commit its makeList either: interning runs after value
+    validation but used to run after the adoption loop, letting a crafted
+    frame poison text_obj_by_doc and demote the doc's later valid frames
+    (advisor r2 medium finding)."""
+    import json as _json
+
+    make_list = _json.dumps(
+        {"action": "makeList", "obj": "_root", "key": "text", "opId": "5@doc2"}
+    )
+    # change header [actor=0 seq=1 startOp=1 ndeps=0 nops=2], then:
+    #   op1: JSON spillover makeList (strid 1)
+    #   op2: addMark comment over [startOfText, endOfText) with attr strid 2
+    #        (invalid UTF-8 bytes) -> attr_idx = 2 + 1
+    corrupt = _craft_frame(
+        ["doc1", make_list, b"\xff\xfe"],
+        [0, 1, 1, 0, 2,
+         4, 1,
+         2, 1, 5, 0, 6, 0, 2, 2, 0, 0, 3, 0, 0, 3],
+        1,
+    )
+    docs, _, origin = generate_docs()
+    follow = encode_frame([origin])  # valid ops (incl. makeList 1@doc1)
+    s = _session()
+    with pytest.raises(ValueError):
+        s.ingest_frames([(0, corrupt), (0, follow)])
+    from peritext_tpu.ops.packed import pack_id
+
+    # the corrupt frame contributed nothing: no poisoned adoption, no
+    # spurious demotion of the valid follow frame
+    assert s.docs[0].text_obj == pack_id(1, 1)
+    assert s.docs[0].frames == [follow] and not s.docs[0].fallback
+    s.drain()
+    assert "".join(sp["text"] for sp in s.read(0)) == "The Peritext editor"
+
+
+@pytest.mark.skipif(not native.available(), reason="needs native core")
+def test_bulk_corrupt_frames_do_not_intern_comment_ids():
+    """Comment ids reaching the per-doc dense remap must come only from
+    frames that passed every corrupt check: an adversarial peer spamming
+    corrupt frames with distinct comment ids could otherwise exhaust the
+    doc's comment capacity and force its reads to scalar replay forever
+    (advisor r2 finding)."""
+    import json as _json
+
+    docs, _, origin = generate_docs()
+    s = _session()
+    s.ingest_frames([(0, encode_frame([origin]))])
+
+    make_list = _json.dumps(
+        {"action": "makeList", "obj": "_root", "key": "text", "opId": "9@doc2"}
+    )
+    for i in range(6):
+        # each corrupt frame: a comment addMark with a FRESH id (strid 0)
+        # plus a second makeList (spurious) and an undecodable attr marker
+        # making the frame corrupt via interning
+        frame = _craft_frame(
+            [f"spam-{i}", "doc1", make_list, b"\xff"],
+            [1, 2 + i, 1, 0, 3,
+             2, 1, 1, 1, 10 + i, 1, 2, 2, 0, 0, 3, 0, 0, 1,
+             4, 2,
+             2, 1, 1, 1, 11 + i, 1, 2, 2, 0, 0, 3, 0, 0, 4],
+            1,
+        )
+        with pytest.raises(ValueError):
+            s.ingest_frames([(0, frame)])
+    # no corrupt frame interned anything into the doc's dense comment table
+    # (len 1 == only the Interner's reserved none slot)
+    table = s._doc_comment_ids.get(0)
+    assert table is None or len(table) == 1
+    s.drain()
+    assert "".join(sp["text"] for sp in s.read(0)) == "The Peritext editor"
