@@ -99,18 +99,41 @@ def _wait_settled(hosts, timeout: float = 10.0) -> None:
 
 
 def main() -> None:
-    import jax
-
     # Pick the platform BEFORE any backend initializes (a default_backend()
     # probe would itself initialize backends, making the update a no-op).
-    # The device path runs on TPU or CPU; honor an explicit JAX_PLATFORMS,
-    # default to CPU everywhere else.
-    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS") or "cpu")
+    # Default to CPU: the harness environment PRESETS JAX_PLATFORMS to the
+    # TPU plugin, so honoring it blindly would hang the demo whenever the
+    # tunnel is down — opt into a device platform explicitly with
+    # PT_DEMO_PLATFORM=tpu.  BOTH the env var and the config entry must be
+    # pinned (the TPU plugin re-asserts itself at config level).
+    platform = os.environ.get("PT_DEMO_PLATFORM") or "cpu"
+    os.environ["JAX_PLATFORMS"] = platform
+    import jax
+
+    jax.config.update("jax_platforms", platform)
 
     from peritext_tpu.api.batch import _oracle_doc
     from peritext_tpu.testing.fuzz import generate_workload
 
     workload = generate_workload(seed=33, num_docs=1, ops_per_doc=150)[0]
+
+    # Each actor additionally sets per-host MAP state (a metadata key under
+    # the root map): the convergence digest is full-state, so the gossip
+    # loop below provably synchronizes map registers, not just text+marks.
+    from peritext_tpu.core.opids import ROOT
+    from peritext_tpu.core.types import Change, Operation
+
+    for actor in ACTORS:
+        log = workload.setdefault(actor, [])
+        next_op = max(
+            [ch.start_op + len(ch.ops) for ch in log], default=1
+        )
+        log.append(Change(
+            actor=actor, seq=len(log) + 1, deps={}, start_op=next_op,
+            ops=[Operation(action="set", obj=ROOT, opid=(next_op, actor),
+                           key=f"edited-by-{actor}", value=True)],
+        ))
+
     total = sum(len(log) for log in workload.values())
     print(f"session: {total} changes by {len(ACTORS)} actors, one host each\n")
 
@@ -141,9 +164,15 @@ def main() -> None:
         assert len(digests) == 1, digests
         expected = _oracle_doc(workload).get_text_with_formatting(["text"])
         expected_text = "".join(s["text"] for s in expected)
+        meta_keys = {f"edited-by-{a}" for a in ACTORS}
         for h in hosts:
             assert h.text() == expected_text, h.name
-        print(f"\nall hosts converged after {round_no} gossip rounds")
+            # the full-state digest above already proves map convergence;
+            # read back the registers as direct evidence too
+            root = h.session.read_root(0)
+            assert meta_keys <= set(root), (h.name, root)
+        print(f"\nall hosts converged after {round_no} gossip rounds "
+              f"(digest covers text+marks+map; every host sees {sorted(meta_keys)})")
         print(f"shared digest: {hosts[0].digest():#010x}")
         print(f"document ({len(expected_text)} chars): {expected_text[:70]!r}...")
     finally:

@@ -28,9 +28,15 @@ def main() -> None:
     parser.add_argument("--seed", type=int, default=200)
     args = parser.parse_args()
 
+    # Default to CPU: the harness PRESETS JAX_PLATFORMS to the TPU plugin,
+    # so honoring it blindly hangs when the tunnel is down.  Opt into the
+    # device platform explicitly with PT_DEMO_PLATFORM=tpu.  Env var AND
+    # config must both be pinned (the plugin re-asserts at config level).
+    platform = os.environ.get("PT_DEMO_PLATFORM") or "cpu"
+    os.environ["JAX_PLATFORMS"] = platform
     import jax
 
-    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS") or "cpu")
+    jax.config.update("jax_platforms", platform)
 
     from peritext_tpu.api.batch import _oracle_doc
     from peritext_tpu.parallel.codec import encode_frame
@@ -54,18 +60,32 @@ def main() -> None:
         round_mark_capacity=96,
     )
     t_all = time.perf_counter()
+    pending = None
     for r, frame in enumerate(frames):
+        if pending is not None:
+            # fetch LAST round's digest BEFORE this round's ingest mutates
+            # any change history (digest_async's documented precondition for
+            # sessions that could hold fallback/overflow docs); the fetch is
+            # scalar + overflow only, and the device computed it behind the
+            # queue while round r-1's host work finished
+            pending.wait()
         t0 = time.perf_counter()
         sess.ingest_frames((doc, frame) for doc in range(d))
         t_ing = time.perf_counter() - t0
         t0 = time.perf_counter()
         sess.drain()
-        print(f"round {r}: ingest {t_ing:.1f}s, device rounds {time.perf_counter() - t0:.1f}s")
+        t_drain = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        pending = sess.digest_async()  # per-round convergence sync point
+        t_sched = time.perf_counter() - t0
+        print(f"round {r}: ingest {t_ing:.1f}s, device rounds {t_drain:.1f}s, "
+              f"digest scheduled in {t_sched * 1000:.0f}ms (async)")
     wall = time.perf_counter() - t_all
 
     t0 = time.perf_counter()
-    digest = sess.digest()
+    digest = pending.wait()
     t_digest = time.perf_counter() - t0
+    assert digest == sess.digest(), "async digest != sync digest"
     for doc in (0, d // 2, d - 1):
         assert sess.read(doc) == expected, f"doc {doc} diverged"
     assert not any(s.fallback for s in sess.docs), "docs demoted to scalar replay"
@@ -85,7 +105,8 @@ def main() -> None:
     n_patches = sum(len(p) for p in sess.read_patches_all())
     t_patches = time.perf_counter() - t0
 
-    print(f"\nconverged ON DEVICE: digest {digest:#010x} ({t_digest:.1f}s, block-resolved)")
+    print(f"\nconverged ON DEVICE: digest {digest:#010x} "
+          f"(final wait {t_digest:.2f}s; per-round sync is the async schedule above)")
     print(f"{total_ops / 1e6:.1f}M ops in {wall:.1f}s "
           f"({total_ops / wall / 1e3:.0f}K ops/s end-to-end incl. host ingest)")
     print(f"full span sweep {t_read:.1f}s, full patch sweep {t_patches:.1f}s "

@@ -57,9 +57,14 @@ def main() -> None:
     if args.backend == "tpu":
         import os
 
+        # Default to CPU: the harness PRESETS JAX_PLATFORMS to the TPU
+        # plugin, so honoring it blindly hangs when the tunnel is down.
+        # Opt into the device platform with PT_DEMO_PLATFORM=tpu.
+        platform = os.environ.get("PT_DEMO_PLATFORM") or "cpu"
+        os.environ["JAX_PLATFORMS"] = platform
         import jax
 
-        jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS") or "cpu")
+        jax.config.update("jax_platforms", platform)
 
     events = []
     publisher = Publisher()

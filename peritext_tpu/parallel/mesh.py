@@ -22,6 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.interning import content_hash32
+
 DOC_AXIS = "docs"
 
 
@@ -56,6 +58,72 @@ def shard_docs(tree, mesh: Mesh, axis_name: str = DOC_AXIS):
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
 
 
+# -- digest mixing constants (device and host mirrors share these) ---------
+# Distinct odd 32-bit multipliers; the final avalanche (*_KF; x ^= x >> 15)
+# matches across every part so host stand-ins are bit-identical.
+_KC1 = 2654435761  # char / register-object
+_KP = 40503  # slot position
+_KF = 2246822519  # final multiply before the xor-shift avalanche
+_KT = 374761393  # LWW mark-type salt
+_KL = 3266489917  # link url content hash salt
+_KCM = 461845907  # comment id content hash salt
+_KK = 668265263  # register key salt
+_KV = 2869860233  # register value salt
+_KKIND = 951274213  # register value-kind salt
+_PAD_SEED = 0x9E3779B9
+
+
+def _av_host(x: int) -> int:
+    """Host mirror of the device avalanche (uint32 wraparound)."""
+    x = (x * _KF) & 0xFFFFFFFF
+    return x ^ (x >> 15)
+
+
+def format_digest_host(
+    slot_positions, marks_per_char, mark_names, comment_type: int
+) -> int:
+    """Host mirror of :func:`per_doc_format_digest` for one scalar-replay
+    doc: per visible character (at element-order slot ``s``), the active LWW
+    mark types, the link url content hash, and the active comment-id content
+    hashes — bit-identical to the device sums, so fallback docs participate
+    in full-state digest comparison."""
+    acc = 0
+    for s, marks in zip(slot_positions, marks_per_char):
+        for t, name in enumerate(mark_names):
+            if t == comment_type:
+                continue
+            m = marks.get(name)
+            if m and m.get("active"):
+                acc = (acc + _av_host((((t + 1) * _KT) & 0xFFFFFFFF) ^ ((s * _KP) & 0xFFFFFFFF))) & 0xFFFFFFFF
+        link = marks.get("link")
+        # None-check, not truthiness: an EMPTY url string is interned on the
+        # device side (id >= 1, so link_attr > 0 includes it) and must hash
+        # here too or fallback/device peers diverge
+        if link and link.get("active") and link.get("url") is not None:
+            lh = content_hash32(link["url"])
+            acc = (acc + _av_host(((lh * _KL) & 0xFFFFFFFF) ^ ((s * _KP) & 0xFFFFFFFF))) & 0xFFFFFFFF
+        for c in marks.get("comment", []):
+            ch = content_hash32(c["id"])
+            acc = (acc + _av_host(((ch * _KCM) & 0xFFFFFFFF) ^ ((s * _KP) & 0xFFFFFFFF))) & 0xFFFFFFFF
+    return acc
+
+
+def register_digest_host(rows) -> int:
+    """Host mirror of :func:`per_doc_register_digest`.  ``rows`` iterates
+    ``(obj_u32, key_hash, kind, val_u32)`` for every LIVE register (deleted
+    keys are absent, as in the materialized doc)."""
+    acc = 0
+    for obj_u32, key_h, kind, val_u32 in rows:
+        x = (
+            ((obj_u32 * _KC1) & 0xFFFFFFFF)
+            ^ ((key_h * _KK) & 0xFFFFFFFF)
+            ^ ((kind * _KKIND) & 0xFFFFFFFF)
+            ^ ((val_u32 * _KV) & 0xFFFFFFFF)
+        )
+        acc = (acc + _av_host(x)) & 0xFFFFFFFF
+    return acc
+
+
 def doc_digest_host(codepoints, slot_positions, slot_capacity: int) -> int:
     """uint32 digest of ONE document, bit-identical to its contribution in
     :func:`convergence_digest` — computed host-side.
@@ -83,6 +151,21 @@ def doc_digest_host(codepoints, slot_positions, slot_capacity: int) -> int:
     return int(total & np.uint32(0xFFFFFFFF))
 
 
+def _avalanche(x: jnp.ndarray) -> jnp.ndarray:
+    x = x * jnp.uint32(_KF)
+    return x ^ (x >> 15)
+
+
+def per_doc_text_digest(chars: jnp.ndarray, visible: jnp.ndarray) -> jnp.ndarray:
+    """(D,) uint32 per-doc digest of visible text (char, position, pad)."""
+    d, s = chars.shape
+    pos = jnp.arange(s, dtype=jnp.uint32)[None, :]
+    x = chars.astype(jnp.uint32) * jnp.uint32(_KC1)
+    x = x ^ (pos * jnp.uint32(_KP))
+    x = jnp.where(visible, x, jnp.uint32(_PAD_SEED))
+    return jnp.sum(_avalanche(x), axis=1, dtype=jnp.uint32)
+
+
 def convergence_digest(
     chars: jnp.ndarray, visible: jnp.ndarray, doc_mask: jnp.ndarray | None = None
 ) -> jnp.ndarray:
@@ -97,15 +180,104 @@ def convergence_digest(
     an excluded doc must not add even the pad-slot constant, so its host-side
     stand-in (:func:`doc_digest_host`) can be summed in instead.
     """
-    d, s = chars.shape
-    # Per-slot mix of (char, visible, position) with distinct odd multipliers.
-    pos = jnp.arange(s, dtype=jnp.uint32)[None, :]
-    x = chars.astype(jnp.uint32) * jnp.uint32(2654435761)
-    x = x ^ (pos * jnp.uint32(40503))
-    x = jnp.where(visible, x, jnp.uint32(0x9E3779B9))
-    x = x * jnp.uint32(2246822519)
-    x = x ^ (x >> 15)
-    per_doc = jnp.sum(x, axis=1, dtype=jnp.uint32)
+    per_doc = per_doc_text_digest(chars, visible)
     if doc_mask is not None:
         per_doc = jnp.where(doc_mask, per_doc, jnp.uint32(0))
     return jnp.sum(per_doc, dtype=jnp.uint32)  # cross-shard all-reduce
+
+
+def per_doc_format_digest(
+    visible: jnp.ndarray,
+    lww_active: jnp.ndarray,
+    link_attr: jnp.ndarray,
+    comment_bits: jnp.ndarray,
+    attr_hash: jnp.ndarray,
+    comment_hash: jnp.ndarray,
+    comment_type: int,
+    link_type: int,
+) -> jnp.ndarray:
+    """(D,) uint32 digest of per-character FORMATTING state, gated by
+    visibility (the reference's convergence oracle compares formatted text,
+    test/fuzz.ts:245-278 — two docs with equal text but divergent marks must
+    digest apart).
+
+    Contributions are position-mixed sums, so they are independent of mark
+    TABLE row order (concurrent deliveries append in arrival order) and —
+    because interned ids enter only through the gathered content-hash tables
+    ``attr_hash`` (D, A) / ``comment_hash`` (D, C) — independent of each
+    session's intern order.  Comment sets fold as unordered sums over active
+    ids, matching ops_to_marks' id-set semantics."""
+    d, s = visible.shape
+    pos = jnp.arange(s, dtype=jnp.uint32)[None, :]
+    n_types = lww_active.shape[1]
+    acc = jnp.zeros((d,), jnp.uint32)
+
+    # LWW active bits per type (strong/em/link; comments handled as sets)
+    for t in range(n_types):
+        if t == comment_type:
+            continue
+        x = _avalanche(jnp.uint32((t + 1) * _KT) ^ (pos * jnp.uint32(_KP)))
+        active = visible & lww_active[:, t, :]
+        acc = acc + jnp.sum(jnp.where(active, x, 0), axis=1, dtype=jnp.uint32)
+
+    # link winner url (content hash gathered through the session table)
+    a_cap = attr_hash.shape[1]
+    lh = jnp.take_along_axis(
+        attr_hash, jnp.clip(link_attr, 0, a_cap - 1), axis=1
+    )
+    x = _avalanche((lh * jnp.uint32(_KL)) ^ (pos * jnp.uint32(_KP)))
+    link_on = visible & lww_active[:, link_type, :] & (link_attr > 0)
+    acc = acc + jnp.sum(jnp.where(link_on, x, 0), axis=1, dtype=jnp.uint32)
+
+    # comment id sets: unordered sum over active dense ids of the id's
+    # content hash mixed with position.  Static loop over capacity (W*32,
+    # typically 32) — each term is a (D, S) masked sum, nothing (D, C, S)
+    # sized is ever materialized.
+    w = comment_bits.shape[1]
+    for word in range(w):
+        bits = comment_bits[:, word, :]  # (D, S) uint32
+        for k in range(32):
+            c = word * 32 + k
+            if c >= comment_hash.shape[1]:
+                break
+            ch = comment_hash[:, c][:, None]  # (D, 1)
+            x = _avalanche((ch * jnp.uint32(_KCM)) ^ (pos * jnp.uint32(_KP)))
+            on = visible & (((bits >> k) & 1) == 1)
+            acc = acc + jnp.sum(jnp.where(on, x, 0), axis=1, dtype=jnp.uint32)
+    return acc
+
+
+def per_doc_register_digest(
+    r_obj: jnp.ndarray,
+    r_key: jnp.ndarray,
+    r_op: jnp.ndarray,
+    r_kind: jnp.ndarray,
+    r_val: jnp.ndarray,
+    key_hash: jnp.ndarray,
+    vk_deleted: int,
+    vk_str: int,
+) -> jnp.ndarray:
+    """(D,) uint32 digest of the map-register table (LWW winner per
+    (object, key) across root and nested maps — reference map state,
+    src/micromerge.ts:1151-1175).
+
+    A row contributes iff it holds a live winner (r_op != 0 and not a
+    deletion — a deleted key equals a never-set key, as in the materialized
+    doc).  The sum is row-order independent (arrival order differs across
+    peers) and intern-order independent: keys and string values enter
+    through the gathered content-hash table ``key_hash`` (D, K); object ids
+    and child-object values are packed (ctr, actor) ids, already canonical
+    across sessions that declare the same actor set."""
+    k_cap = key_hash.shape[1]
+    kh = jnp.take_along_axis(key_hash, jnp.clip(r_key, 0, k_cap - 1), axis=1)
+    vh_str = jnp.take_along_axis(key_hash, jnp.clip(r_val, 0, k_cap - 1), axis=1)
+    vh = jnp.where(r_kind == vk_str, vh_str, r_val.astype(jnp.uint32))
+    x = (
+        (r_obj.astype(jnp.uint32) * jnp.uint32(_KC1))
+        ^ (kh * jnp.uint32(_KK))
+        ^ (r_kind.astype(jnp.uint32) * jnp.uint32(_KKIND))
+        ^ (vh * jnp.uint32(_KV))
+    )
+    x = _avalanche(x)
+    live = (r_op != 0) & (r_kind != vk_deleted)
+    return jnp.sum(jnp.where(live, x, 0), axis=1, dtype=jnp.uint32)

@@ -71,10 +71,10 @@ from .mesh import convergence_digest, shard_docs
 
 @partial(jax.jit, static_argnums=1)
 def _resolve_digest_jit(state: PackedDocs, comment_capacity: int, row_mask):
-    """Fused span resolution + convergence digest in ONE program: resolution
-    runs with the comment planes compiled away (the digest never reads them —
-    resolve.py ``with_comments``), and only the scalar digest plus the
-    overflow vector ever reach the host."""
+    """Fused span resolution + TEXT-ONLY convergence digest in ONE program:
+    resolution runs with the comment planes compiled away (this digest never
+    reads them — resolve.py ``with_comments``), and only the scalar digest
+    plus the overflow vector ever reach the host."""
     resolved = resolve(state, comment_capacity, with_comments=False)
     mask = row_mask & ~resolved.overflow
     # masked docs contribute ZERO (not the pad constant): their host-side
@@ -83,6 +83,91 @@ def _resolve_digest_jit(state: PackedDocs, comment_capacity: int, row_mask):
         convergence_digest(resolved.char, resolved.visible, doc_mask=mask),
         resolved.overflow,
     )
+
+
+@partial(jax.jit, static_argnums=1)
+def _resolve_block_digest_jit(
+    state: PackedDocs, comment_capacity: int, row_mask,
+    attr_hash, comment_hash, key_hash,
+):
+    """ONE program per block and round: span resolution (what every read
+    path needs) PLUS the fused FULL-STATE convergence digest — visible text,
+    resolved formatting (LWW winner bits, link url, comment-id sets) and the
+    map-register table.  The reference's convergence oracles compare full
+    formatted text (test/fuzz.ts:245-278), and cross-replica map state is
+    part of the document too.  Interned identities enter only through the
+    per-session content-hash tables (``attr_hash``/``comment_hash``/
+    ``key_hash``, (D, ·) uint32), so digests are comparable across sessions
+    with different intern orders.
+
+    Returning both from one program means digest() and the read paths share
+    the per-round resolution work (the block cache), and a digest-only sync
+    point fetches just the scalar + overflow vector — not the (D, S)
+    planes."""
+    from ..ops.packed import VK_DELETED, VK_STR
+    from ..ops.resolve import COMMENT_TYPE, LINK_TYPE
+    from .mesh import per_doc_format_digest, per_doc_register_digest, per_doc_text_digest
+
+    resolved = resolve(state, comment_capacity, with_comments=True)
+    mask = row_mask & ~resolved.overflow
+    per_doc = per_doc_text_digest(resolved.char, resolved.visible)
+    per_doc = per_doc + per_doc_format_digest(
+        resolved.visible, resolved.lww_active, resolved.link_attr,
+        resolved.comment_bits, attr_hash, comment_hash,
+        COMMENT_TYPE, LINK_TYPE,
+    )
+    per_doc = per_doc + per_doc_register_digest(
+        state.r_obj, state.r_key, state.r_op, state.r_kind, state.r_val,
+        key_hash, VK_DELETED, VK_STR,
+    )
+    per_doc = jnp.where(mask, per_doc, jnp.uint32(0))
+    return resolved, jnp.sum(per_doc, dtype=jnp.uint32)
+
+
+class _BlockResolution:
+    """Per-(round, block) resolution artifacts: the device-side resolved
+    planes, the fused full-state digest scalar, and a LAZY numpy conversion.
+    Digest-only sync points fetch the scalar + overflow (a few bytes + D
+    bools); only actual span/patch reads pay the (D, S) plane transfer —
+    through a narrow device link that asymmetry is the difference between a
+    ~ms and a ~second sync."""
+
+    __slots__ = ("device", "digest_dev", "on_device", "_np", "_overflow",
+                 "_digest_int")
+
+    def __init__(self, device, digest_dev, on_device):
+        self.device = device
+        self.digest_dev = digest_dev
+        self.on_device = on_device  # fallback mask the digest was fused with
+        self._np = None
+        self._overflow = None
+        self._digest_int = None
+
+    @property
+    def digest(self) -> int:
+        if self._digest_int is None:
+            self._digest_int = int(np.asarray(self.digest_dev))
+        return self._digest_int
+
+    @property
+    def overflow(self) -> np.ndarray:
+        if self._overflow is None:
+            self._overflow = np.asarray(self.device.overflow)
+        return self._overflow
+
+    def to_np(self):
+        if self._np is None:
+            self._np = type(self.device)(*(np.asarray(x) for x in self.device))
+            self._overflow = self._np.overflow
+        return self._np
+
+
+def _width_bucket(n: int) -> int:
+    """Power-of-two table width so growing interners reuse compiled digests."""
+    w = 8
+    while w < n:
+        w *= 2
+    return w
 
 
 @dataclass
@@ -760,23 +845,61 @@ class StreamingMerge:
             return self.state
         return PackedDocs(*(x[lo:hi] for x in self.state))
 
-    def _resolved_block(self, block_index: int):
-        """Numpy-converted span resolution of one doc block, cached per
-        round so per-doc reads between steps share device work."""
+    def _block_fallback_mask(self, block_index: int) -> np.ndarray:
+        """(block,) bool: docs currently served by the device (not fallback)."""
+        lo, hi = self._block_bounds(block_index)
+        on_device = np.zeros(hi - lo, bool)
+        upper = min(hi, self.num_docs)
+        if upper > lo:
+            on_device[: upper - lo] = [
+                not self.docs[d].fallback for d in range(lo, upper)
+            ]
+        return on_device
+
+    def _resolution(self, block_index: int) -> _BlockResolution:
+        """Per-round cached resolution + fused digest of one doc block (ONE
+        device program for both — digest() and the read paths share it).
+
+        Cache hits are O(1): the fused digest's doc mask is validated only
+        by the digest consumers (:meth:`digest` / :meth:`digest_async`, via
+        ``fresh_mask=True``) — the read paths route each doc on its CURRENT
+        ``fallback`` flag before consulting the cache, so a stale mask can
+        only ever affect the digest scalar, never a read."""
         stamp, cache = self._resolved_cache
         if stamp != self.rounds:
             cache = {}
             self._resolved_cache = (self.rounds, cache)
         if block_index in cache:
-            resolved = cache.pop(block_index)  # re-insert: LRU, not FIFO
-            cache[block_index] = resolved
-            return resolved
-        resolved = resolve_jit(self._state_block(block_index), self.comment_capacity)
-        resolved = type(resolved)(*(np.asarray(x) for x in resolved))
-        if len(cache) >= 2:  # bound host memory at large scale
+            entry = cache.pop(block_index)  # re-insert: LRU, not FIFO
+            cache[block_index] = entry
+            return entry
+        lo, hi = self._block_bounds(block_index)
+        on_device = self._block_fallback_mask(block_index)
+        resolved, digest_dev = _resolve_block_digest_jit(
+            self._state_block(block_index), self.comment_capacity,
+            jnp.asarray(on_device), *self._digest_tables(lo, hi),
+        )
+        entry = _BlockResolution(resolved, digest_dev, on_device)
+        if len(cache) >= 2:  # bound host/device memory at large scale
             cache.pop(next(iter(cache)))  # least-recently-used
-        cache[block_index] = resolved
-        return resolved
+        cache[block_index] = entry
+        return entry
+
+    def _digest_resolution(self, block_index: int) -> _BlockResolution:
+        """_resolution plus doc-mask freshness: a fallback transition without
+        a round bump (demotion at read time, or a test flipping the flag)
+        invalidates the fused digest's mask — recompute the block then."""
+        entry = self._resolution(block_index)
+        current = self._block_fallback_mask(block_index)
+        if not np.array_equal(entry.on_device, current):
+            stamp, cache = self._resolved_cache
+            cache.pop(block_index, None)
+            entry = self._resolution(block_index)
+        return entry
+
+    def _resolved_block(self, block_index: int):
+        """Numpy-converted span resolution of one doc block (read paths)."""
+        return self._resolution(block_index).to_np()
 
     def _resolved_doc(self, doc_index: int):
         """(resolved block, index of the doc within it)."""
@@ -852,8 +975,9 @@ class StreamingMerge:
             if self.docs[d].fallback:
                 replay_docs.append(d)
                 continue
-            resolved, local = self._resolved_doc(d)
-            if bool(resolved.overflow[local]):
+            bi = d // self._read_chunk
+            # overflow routing needs only the (D,) vector, not the planes
+            if bool(self._resolution(bi).overflow[d - bi * self._read_chunk]):
                 replay_docs.append(d)
             else:
                 device_map[d] = cursors
@@ -868,10 +992,10 @@ class StreamingMerge:
             cursor_elem = pack_cursor_rows(
                 local_map, hi - lo, lambda d: self._actor_table
             )
-            resolved = self._resolved_block(bi)
+            visible_dev = self._resolution(bi).device.visible  # stays on device
             positions = np.asarray(
                 resolve_cursors_jit(
-                    self._state_block(bi), jnp.asarray(resolved.visible), cursor_elem
+                    self._state_block(bi), visible_dev, cursor_elem
                 )
             )
             for d, cursors in block_map.items():
@@ -986,18 +1110,28 @@ class StreamingMerge:
 
     # -- cross-shard reductions (the ICI/DCN collectives) ------------------
 
-    def digest(self) -> int:
-        """Global convergence digest over every doc's visible text: with a
-        mesh, XLA lowers the cross-doc reduction to an all-reduce over ICI.
-        Two sessions that converged hold equal digests.
+    def digest(self, full: bool = True) -> int:
+        """Global convergence digest: with a mesh, XLA lowers the cross-doc
+        reduction to an all-reduce over ICI.  Two sessions that converged
+        hold equal digests.
+
+        ``full=True`` (default) digests the COMPLETE document state — visible
+        text, resolved formatting (LWW winner bits, link urls, comment-id
+        sets) and map registers — matching the scope of the reference's
+        convergence oracles (test/fuzz.ts:245-278 compare formatted text, not
+        characters).  Interned identities are folded as content hashes, so
+        two sessions that interned attrs/keys/values in different orders
+        still agree.  ``full=False`` is the cheaper text-only digest (the
+        comment planes compile away entirely — resolve.py ``with_comments``).
 
         Device-resident docs hash on device; fallback and overflowed docs —
         the ones the read paths route to scalar replay — are masked out of
         the device sum and hashed HOST-SIDE with the bit-identical per-doc
-        formula (mesh.doc_digest_host), so two converged peers agree even
-        when their demotion histories differ.  (The equivalence needs the
-        replayed doc to fit the device capacities; a doc too large for any
-        device row hashes consistently between fallback peers only.)
+        formula (mesh.doc_digest_host and the format/register mirrors), so
+        two converged peers agree even when their demotion histories differ.
+        (The equivalence needs the replayed doc to fit the device capacities;
+        a doc too large for any device row hashes consistently between
+        fallback peers only.)
 
         The digest is a doc-sum of per-doc hashes, so it is computed per
         read-block and summed mod 2^32 — identical to the whole-batch value
@@ -1014,13 +1148,18 @@ class StreamingMerge:
         n_blocks = -(-self._padded_docs // self._read_chunk)
         for bi in range(n_blocks):
             lo, hi = self._block_bounds(bi)
-            digest, overflow = _resolve_digest_jit(
-                self._state_block(bi),
-                self.comment_capacity,
-                jnp.asarray(on_device_all[lo:hi]),
-            )
-            total = (total + int(digest)) & 0xFFFFFFFF
-            ov = np.asarray(overflow)
+            if full:
+                # shares the per-round block resolution with the read paths
+                # (one fused program); fetches scalar + overflow only
+                entry = self._digest_resolution(bi)
+                digest, ov = entry.digest, entry.overflow
+            else:
+                digest, overflow = _resolve_digest_jit(
+                    self._state_block(bi), self.comment_capacity,
+                    jnp.asarray(on_device_all[lo:hi]),
+                )
+                digest, ov = int(digest), np.asarray(overflow)
+            total = (total + digest) & 0xFFFFFFFF
             replay_docs.extend(
                 int(d) + lo
                 for d in np.nonzero(ov & on_device_all[lo:hi])[0]
@@ -1030,8 +1169,84 @@ class StreamingMerge:
         for i in replay_docs:
             doc = _replay_doc(self._replay_changes(self.docs[i]))
             cps, slots = _doc_char_slots(doc)
-            total = (total + doc_digest_host(cps, slots, s_cap)) & 0xFFFFFFFF
+            part = doc_digest_host(cps, slots, s_cap)
+            if full:
+                part = (part + _doc_full_extras_host(doc, slots, self._actor_table)) & 0xFFFFFFFF
+            total = (total + part) & 0xFFFFFFFF
         return total
+
+    def digest_async(self) -> "_PendingDigest":
+        """Schedule the full-state convergence digest WITHOUT synchronizing:
+        the fused resolve+digest programs are enqueued (device work proceeds
+        behind the queue) and the returned handle's ``wait()`` fetches only
+        the per-block scalars + overflow vectors.  A per-round sync point
+        then costs one enqueue (~ms) instead of a blocking device
+        round-trip, and the digest overlaps the next round's host-side
+        ingest parsing (VERDICT r2 weak #7).
+
+        Semantics: the device scalars snapshot the state AT SCHEDULING time
+        (the per-round block cache).  Docs that were already fallback — or
+        that the overflow vector routes to scalar replay — are hashed at
+        ``wait()`` time from their CURRENT change history, so call ``wait()``
+        before further ingestion whenever such docs exist (sessions with
+        zero fallbacks/overflows may wait at any time)."""
+        parts = []
+        for bi in range(-(-self._padded_docs // self._read_chunk)):
+            entry = self._digest_resolution(bi)
+            # keep ONLY the scalar + overflow device refs and the mask — not
+            # the _BlockResolution itself, whose resolved (D, S) planes would
+            # otherwise stay pinned on device across the handle's lifetime,
+            # defeating the size-2 block-cache memory bound at 100K docs
+            parts.append((
+                self._block_bounds(bi)[0], entry.digest_dev,
+                entry.device.overflow, entry.on_device,
+            ))
+        return _PendingDigest(self, parts)
+
+    def _digest_tables(self, lo: int, hi: int):
+        """Per-block (D, ·) uint32 content-hash tables for the full digest:
+        interned-id -> FNV-1a hash for link/mark attrs, per-doc dense comment
+        ids, and map keys/string-values.  Frame-mode docs share the session
+        tables (one row broadcast); object-path docs carry their per-doc
+        encoder tables; fallback rows are masked out device-side so their
+        contents are irrelevant."""
+        d_block = hi - lo
+        sess_attr = self._frame_attrs.content_hashes()
+        sess_keys = self._map_keys.content_hashes()
+        enc = {
+            d: self.docs[d].encoder
+            for d in range(lo, min(hi, self.num_docs))
+            if not self.docs[d].frame_mode and self.docs[d].encoder is not None
+        }
+        a_w = _width_bucket(max(
+            [len(sess_attr)] + [len(e.attrs.content_hashes()) for e in enc.values()]
+        ))
+        k_w = _width_bucket(max(
+            [len(sess_keys)] + [len(e.keys.content_hashes()) for e in enc.values()]
+        ))
+        c_w = self.comment_capacity
+        attr_hash = np.zeros((d_block, a_w), np.uint32)
+        key_hash = np.zeros((d_block, k_w), np.uint32)
+        comment_hash = np.zeros((d_block, c_w), np.uint32)
+        attr_hash[:, : len(sess_attr)] = sess_attr[None, :]
+        key_hash[:, : len(sess_keys)] = sess_keys[None, :]
+        for d, e in enc.items():
+            ah = e.attrs.content_hashes()
+            kh = e.keys.content_hashes()
+            attr_hash[d - lo] = 0
+            attr_hash[d - lo, : len(ah)] = ah
+            key_hash[d - lo] = 0
+            key_hash[d - lo, : len(kh)] = kh
+            # object-path comment marks index the same per-doc attr interner
+            comment_hash[d - lo, : min(c_w, len(ah))] = ah[:min(c_w, len(ah))]
+        for d, table in self._doc_comment_ids.items():
+            if lo <= d < min(hi, self.num_docs) and self.docs[d].frame_mode:
+                ch = table.content_hashes()
+                comment_hash[d - lo, : min(c_w, len(ch))] = ch[:min(c_w, len(ch))]
+        tables = (jnp.asarray(attr_hash), jnp.asarray(comment_hash), jnp.asarray(key_hash))
+        if self.mesh is not None:
+            tables = shard_docs(tables, self.mesh)
+        return tables
 
     # -- checkpoint support (peritext_tpu.checkpoint.save_session) ----------
 
@@ -1087,8 +1302,7 @@ class StreamingMerge:
         preserved via replay either way)."""
         n_blocks = -(-self._padded_docs // self._read_chunk)
         return sum(
-            int(np.asarray(self._resolved_block(bi).overflow).sum())
-            for bi in range(n_blocks)
+            int(self._resolution(bi).overflow.sum()) for bi in range(n_blocks)
         )
 
     def pending_count(self) -> int:
@@ -1111,13 +1325,9 @@ def _doc_char_slots(doc: Doc):
     sides of the comparison must stay deterministic) the earliest-created
     one — minimum (ctr, actor) opid, the same total order compareOpIds
     defines — is hashed."""
-    list_ids = [
-        oid for oid, meta in doc._metadata.items()
-        if isinstance(meta, list) and oid in doc._objects
-    ]
-    if not list_ids:
+    list_id = _doc_text_list_id(doc)
+    if list_id is None:
         return [], []
-    list_id = min(list_ids)  # OpId tuples order exactly as compareOpIds
     meta = doc._metadata[list_id]
     text = doc._objects[list_id]
     cps, slots, vis = [], [], 0
@@ -1127,6 +1337,163 @@ def _doc_char_slots(doc: Doc):
             slots.append(i)
             vis += 1
     return cps, slots
+
+
+class _PendingDigest:
+    """Deferred digest handle from :meth:`StreamingMerge.digest_async`.
+
+    Holds references to the per-block device SCALARS and overflow vectors
+    only (safe across cache eviction, and a few bytes each — never the
+    resolved planes) plus the scheduling-time fallback masks; ``wait`` folds
+    them with host-side replay hashes exactly as ``digest()`` does, then
+    releases the device refs."""
+
+    __slots__ = ("_session", "_parts", "_value")
+
+    def __init__(self, session: "StreamingMerge", parts) -> None:
+        self._session = session
+        self._parts = parts
+        self._value: Optional[int] = None
+
+    def wait(self) -> int:
+        if self._value is not None:
+            return self._value
+        s = self._session
+        total = 0
+        replay_docs = []
+        for lo, digest_dev, overflow_dev, on_device in self._parts:
+            total = (total + int(np.asarray(digest_dev))) & 0xFFFFFFFF
+            upper = min(lo + len(on_device), s.num_docs)
+            ov = np.asarray(overflow_dev)
+            for local in range(upper - lo):
+                if not on_device[local] or ov[local]:
+                    replay_docs.append(lo + local)
+        from .mesh import doc_digest_host
+
+        s_cap = s.state.slot_capacity
+        for i in replay_docs:
+            doc = _replay_doc(s._replay_changes(s.docs[i]))
+            cps, slots = _doc_char_slots(doc)
+            part = doc_digest_host(cps, slots, s_cap)
+            part = (part + _doc_full_extras_host(doc, slots, s._actor_table)) & 0xFFFFFFFF
+            total = (total + part) & 0xFFFFFFFF
+        self._value = total
+        self._parts = ()  # release the device refs once folded
+        return total
+
+
+def _doc_text_list_id(doc: Doc):
+    """The doc's text list object id, or None (see _doc_char_slots)."""
+    list_ids = [
+        oid for oid, meta in doc._metadata.items()
+        if isinstance(meta, list) and oid in doc._objects
+    ]
+    if not list_ids:
+        return None
+    return min(list_ids)  # OpId tuples order exactly as compareOpIds
+
+
+def _doc_path_of_object(doc: Doc, target) -> Optional[list]:
+    """Key path from the root map to ``target`` (BFS over map children)."""
+    from ..core.doc import MapMeta
+    from ..core.opids import ROOT
+
+    queue = [(ROOT, [])]
+    seen = set()
+    while queue:
+        oid, path = queue.pop(0)
+        if oid in seen:
+            continue
+        seen.add(oid)
+        meta = doc._metadata.get(oid)
+        if not isinstance(meta, MapMeta):
+            continue
+        for key, child in meta.children.items():
+            if child == target:
+                return path + [key]
+            queue.append((child, path + [key]))
+    return None
+
+
+def _doc_full_extras_host(doc: Doc, slot_positions, actor_table) -> int:
+    """Formatting + map-register digest contribution of ONE scalar-replay
+    doc, bit-identical to the device sums in _resolve_full_digest_jit (the
+    mirrors live in mesh.format_digest_host / register_digest_host).
+    ``slot_positions`` are the visible characters' element-order slots from
+    :func:`_doc_char_slots`."""
+    import json as _json
+
+    from ..core.doc import MapMeta
+    from ..core.opids import ROOT
+    from ..ops.packed import (
+        MAX_CTR,
+        OBJ_ROOT,
+        VK_FALSE,
+        VK_INT,
+        VK_NULL,
+        VK_OBJ,
+        VK_STR,
+        VK_TEXT,
+        VK_TRUE,
+        pack_id,
+    )
+    from ..ops.resolve import COMMENT_TYPE
+    from ..schema import ALL_MARKS
+    from ..utils.interning import content_hash32
+    from .mesh import format_digest_host, register_digest_host
+
+    # -- formatting: expand spans to per-visible-char mark maps -------------
+    marks_per_char: list = []
+    list_id = _doc_text_list_id(doc)
+    if list_id is not None and slot_positions:
+        path = _doc_path_of_object(doc, list_id)
+        if path is not None:
+            for span in doc.get_text_with_formatting(path):
+                marks_per_char.extend([span["marks"]] * len(span["text"]))
+    if len(marks_per_char) != len(slot_positions):
+        # degenerate doc (unreachable list) — formatting contributes nothing,
+        # deterministically on every peer applying the same rule
+        marks_per_char = [{}] * len(slot_positions)
+    total = format_digest_host(
+        slot_positions, marks_per_char, ALL_MARKS, COMMENT_TYPE
+    )
+
+    # -- map registers: LWW winner per (object, key), live keys only --------
+    def packed_u32(opid) -> int:
+        ctr, actor = opid
+        idx = actor_table.get(actor)
+        if idx is None or ctr > MAX_CTR:
+            # undeclared actor / over-wide counter: no device peer can hold
+            # this doc; a deterministic stand-in keeps fallback peers equal
+            return content_hash32(f"{ctr}@{actor}")
+        return pack_id(ctr, idx) & 0xFFFFFFFF
+
+    rows = []
+    for oid, meta in doc._metadata.items():
+        if not isinstance(meta, MapMeta):
+            continue
+        obj_u32 = (OBJ_ROOT & 0xFFFFFFFF) if oid is ROOT else packed_u32(oid)
+        obj = doc._objects.get(oid, {})
+        for key, value in obj.items():
+            if isinstance(value, bool):
+                kind, val = (VK_TRUE, 0) if value else (VK_FALSE, 0)
+            elif isinstance(value, int):
+                kind, val = VK_INT, value & 0xFFFFFFFF
+            elif isinstance(value, str):
+                kind, val = VK_STR, content_hash32(value)
+            elif value is None:
+                kind, val = VK_NULL, 0
+            elif isinstance(value, dict):
+                kind, val = VK_OBJ, packed_u32(meta.children[key])
+            elif isinstance(value, list):
+                kind, val = VK_TEXT, packed_u32(meta.children[key])
+            else:
+                # device-inexpressible value (float/containers): the doc is
+                # in fallback on every peer; hash a canonical JSON form
+                kind = 255
+                val = content_hash32(_json.dumps(value, sort_keys=True))
+            rows.append((obj_u32, content_hash32(key), kind, val))
+    return (total + register_digest_host(rows)) & 0xFFFFFFFF
 
 
 def _replay_doc(changes: List[Change]) -> Doc:

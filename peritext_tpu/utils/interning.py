@@ -13,6 +13,20 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, List, Optional
 
+import numpy as np
+
+
+def content_hash32(s: str) -> int:
+    """FNV-1a 32-bit over UTF-8 bytes — the cross-session identity of an
+    interned string.  Interned IDS are session-local (they depend on arrival
+    order); digests and other cross-session comparisons gather these content
+    hashes through per-session id->hash tables instead, so two sessions that
+    interned the same strings in different orders still agree."""
+    h = 2166136261
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
 
 class Interner:
     """Bidirectional string <-> int32 table; index 0 is reserved for 'none'."""
@@ -20,8 +34,21 @@ class Interner:
     def __init__(self, strings: Iterable[str] = ()) -> None:
         self._to_int: Dict[str, int] = {}
         self._to_str: List[Optional[str]] = [None]
+        self._hashes: Optional[np.ndarray] = None
         for s in strings:
             self.intern(s)
+
+    def content_hashes(self) -> np.ndarray:
+        """uint32 array mapping every interned id to its content hash (id 0,
+        the reserved none slot, maps to 0).  Cached; rebuilt only after the
+        table has grown."""
+        n = len(self._to_str)
+        if self._hashes is None or len(self._hashes) != n:
+            self._hashes = np.asarray(
+                [0 if s is None else content_hash32(s) for s in self._to_str],
+                np.uint32,
+            )
+        return self._hashes
 
     def intern(self, s: str) -> int:
         idx = self._to_int.get(s)

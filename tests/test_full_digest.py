@@ -1,0 +1,205 @@
+"""Full-state convergence digest (VERDICT r2 weak #3).
+
+The digest must cover the COMPLETE document state — visible text, resolved
+formatting (LWW winner bits, link urls, comment-id sets) and map registers —
+matching the reference's convergence oracles, which compare full formatted
+text (reference test/fuzz.ts:245-278), and be comparable across sessions
+that interned strings in different orders (content-hash tables, not
+session-local ids).
+"""
+
+import pytest
+
+from peritext_tpu.core.doc import Doc
+from peritext_tpu.parallel.codec import encode_frame
+from peritext_tpu.parallel.streaming import StreamingMerge
+
+
+def mk(n=2, **kw):
+    defaults = dict(
+        num_docs=n, actors=("a1", "a2"), slot_capacity=128, mark_capacity=64,
+        tomb_capacity=64, round_insert_capacity=64, round_delete_capacity=32,
+        round_mark_capacity=32,
+    )
+    defaults.update(kw)
+    return StreamingMerge(**defaults)
+
+
+def rich_changes(urls=("https://one", "https://two")):
+    """A doc with text, strong/em/link/comment marks and nested map state."""
+    d = Doc("a1")
+    chs = []
+    ch, _ = d.change(
+        [{"path": [], "action": "makeList", "key": "text"},
+         {"path": ["text"], "action": "insert", "index": 0,
+          "values": list("hello world")}]
+    )
+    chs.append(ch)
+    for i, u in enumerate(urls):
+        ch, _ = d.change(
+            [{"path": ["text"], "action": "addMark", "startIndex": i,
+              "endIndex": i + 4, "markType": "link", "attrs": {"url": u}},
+             {"path": ["text"], "action": "addMark", "startIndex": i + 1,
+              "endIndex": i + 5, "markType": "comment",
+              "attrs": {"id": f"cm-{u}"}}]
+        )
+        chs.append(ch)
+    ch, _ = d.change(
+        [{"path": ["text"], "action": "addMark", "startIndex": 0,
+          "endIndex": 5, "markType": "strong"},
+         {"path": [], "action": "makeMap", "key": "meta"},
+         {"path": ["meta"], "action": "set", "key": "title", "value": "T"},
+         {"path": ["meta"], "action": "set", "key": "n", "value": -7},
+         {"path": [], "action": "set", "key": "flag", "value": True}]
+    )
+    chs.append(ch)
+    return chs, d
+
+
+def extend(base_changes, actor, ops):
+    d = Doc(actor)
+    for ch in base_changes:
+        d.apply_change(ch)
+    ch, _ = d.change(ops)
+    return ch
+
+
+def test_intern_order_independence_across_sessions():
+    """Two sessions ingesting the same changes in different orders intern
+    attrs/keys/values under different ids, yet their digests match: interned
+    identities are folded as content hashes, never raw ids."""
+    a, _ = rich_changes(("https://one", "https://two"))
+    b, _ = rich_changes(("https://two", "https://one"))
+    sx = mk()
+    sx.ingest_frames([(0, encode_frame(a)), (1, encode_frame(b))])
+    sx.drain()
+    sy = mk()
+    sy.ingest_frames([(1, encode_frame(b))])  # opposite arrival order
+    sy.ingest_frames([(0, encode_frame(a))])
+    sy.drain()
+    assert sx.digest() == sy.digest()
+
+
+def test_object_path_matches_frame_path():
+    """Per-doc encoder interners (object ingest) and session interners
+    (frame ingest) produce the same digest for the same state."""
+    a, _ = rich_changes()
+    b, _ = rich_changes(("https://x",))
+    sf = mk()
+    sf.ingest_frames([(0, encode_frame(a)), (1, encode_frame(b))])
+    sf.drain()
+    so = mk()
+    so.ingest(0, a)
+    so.ingest(1, b)
+    so.drain()
+    assert sf.digest() == so.digest()
+
+
+def test_fallback_doc_full_digest_parity():
+    """A demoted doc (host scalar replay) hashes formatting + map registers
+    bit-identically to a device-resident peer holding the same state."""
+    chs, _ = rich_changes()
+    on_device = mk(1)
+    on_device.ingest_frames([(0, encode_frame(chs))])
+    on_device.drain()
+    assert not on_device.docs[0].fallback
+    replayed = mk(1)
+    replayed.ingest_frames([(0, encode_frame(chs))])
+    replayed.drain()
+    replayed.docs[0].fallback = True
+    assert on_device.digest() == replayed.digest()
+
+
+@pytest.mark.parametrize(
+    "ops",
+    [
+        # formatting-only: one extra em mark, text unchanged
+        [{"path": ["text"], "action": "addMark", "startIndex": 6,
+          "endIndex": 9, "markType": "em"}],
+        # link attr only: same span, different url
+        [{"path": ["text"], "action": "addMark", "startIndex": 0,
+          "endIndex": 4, "markType": "link",
+          "attrs": {"url": "https://other"}}],
+        # comment set only
+        [{"path": ["text"], "action": "addMark", "startIndex": 2,
+          "endIndex": 6, "markType": "comment", "attrs": {"id": "cm-new"}}],
+        # map register only: overwrite one value
+        [{"path": ["meta"], "action": "set", "key": "n", "value": -8}],
+        # map register only: delete a key
+        [{"path": ["meta"], "action": "del", "key": "title"}],
+        # nested map creation only
+        [{"path": [], "action": "makeMap", "key": "sub"}],
+    ],
+    ids=["em-mark", "link-url", "comment-id", "map-set", "map-del", "make-map"],
+)
+def test_single_non_text_divergence_flips_digest(ops):
+    """Each formatting-/map-only divergence (text identical) flips the full
+    digest; the text-only digest stays blind to it — the r2 gap."""
+    chs, _ = rich_changes()
+    base = mk(1)
+    base.ingest_frames([(0, encode_frame(chs))])
+    base.drain()
+    diverged = mk(1)
+    diverged.ingest_frames([(0, encode_frame(chs))])
+    extra = extend(chs, "a2", ops)
+    diverged.ingest_frames([(0, encode_frame([extra]))])
+    diverged.drain()
+    assert base.digest(full=False) == diverged.digest(full=False)
+    assert base.digest() != diverged.digest()
+
+
+def test_fallback_parity_with_empty_link_url():
+    """An EMPTY link url is interned device-side (link_attr > 0) and must be
+    hashed by the host mirror too — a truthiness check there made converged
+    fallback/device peers diverge (review finding r3)."""
+    chs, _ = rich_changes()
+    extra = extend(chs, "a2", [
+        {"path": ["text"], "action": "addMark", "startIndex": 7,
+         "endIndex": 10, "markType": "link", "attrs": {"url": ""}},
+    ])
+    on_device = mk(1)
+    on_device.ingest_frames([(0, encode_frame([*chs, extra]))])
+    on_device.drain()
+    assert not on_device.docs[0].fallback
+    replayed = mk(1)
+    replayed.ingest_frames([(0, encode_frame([*chs, extra]))])
+    replayed.drain()
+    replayed.docs[0].fallback = True
+    assert on_device.digest() == replayed.digest()
+
+
+def test_digest_async_matches_sync():
+    """digest_async schedules the fused program without synchronizing;
+    wait() must return exactly digest(), including host-replay fallbacks."""
+    a, _ = rich_changes()
+    b, _ = rich_changes(("https://x",))
+    s = mk()
+    s.ingest_frames([(0, encode_frame(a)), (1, encode_frame(b))])
+    s.drain()
+    pending = s.digest_async()
+    assert pending.wait() == s.digest()
+    assert pending.wait() == pending.wait()  # idempotent fetch
+
+    # with a fallback doc: wait() folds the host-replay hash
+    sf = mk()
+    sf.ingest_frames([(0, encode_frame(a)), (1, encode_frame(b))])
+    sf.drain()
+    sf.docs[1].fallback = True
+    assert sf.digest_async().wait() == s.digest()
+
+
+def test_full_digest_mesh_invariance():
+    """The full digest is a doc-sum, so mesh size must not change it."""
+    import jax
+    from peritext_tpu.parallel.mesh import make_mesh
+
+    a, _ = rich_changes()
+    b, _ = rich_changes(("https://x",))
+    digests = {}
+    for n in (1, 2, 4):
+        mesh = make_mesh(n) if n > 1 else None
+        s = mk(mesh=mesh)
+        s.ingest_frames([(0, encode_frame(a)), (1, encode_frame(b))])
+        s.drain()
+        digests[n] = s.digest()
+    assert len(set(digests.values())) == 1, digests

@@ -279,6 +279,15 @@ def test_digest_equal_across_different_demotion_sets():
     on_device.drain()
     assert not on_device.docs[0].fallback
 
+    # demotion WITHOUT state divergence (capacity-style): full digests agree —
+    # the fallback doc's host-side formatting/register hashes are
+    # bit-identical to the device sums
+    same_state = mk()
+    same_state.ingest_frame(0, encode_frame([initial, c1]))
+    same_state.drain()
+    same_state.docs[0].fallback = True
+    assert on_device.digest() == same_state.digest()
+
     demoted = mk()
     demoted.ingest_frame(0, encode_frame([initial, c1]))
     demoted.drain()
@@ -287,5 +296,7 @@ def test_digest_equal_across_different_demotion_sets():
     demoted.ingest_frame(0, encode_frame([fl]))
     demoted.drain()
     assert demoted.docs[0].fallback
-    # the float map entry does not touch the text, so the text digests agree
-    assert on_device.digest() == demoted.digest()
+    # the float map entry does not touch the text, so the TEXT digests agree…
+    assert on_device.digest(full=False) == demoted.digest(full=False)
+    # …but the full-state digest correctly sees the extra map register
+    assert on_device.digest() != demoted.digest()
