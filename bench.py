@@ -265,13 +265,22 @@ def run_streaming(args) -> dict:
 
     rng = random.Random(args.seed)
     arrival = []
+    wire_bytes = 0
     for w in workloads:
         changes = [ch for log in w.values() for ch in log]
         rng.shuffle(changes)
         size = -(-len(changes) // rounds)
         batches = [changes[i : i + size] for i in range(0, len(changes), size)]
         if not args.object_ingest:
-            batches = [encode_frame(b) for b in batches]
+            # senders flush their queues in order (changeQueue semantics);
+            # the shuffle above models cross-round arrival skew, the
+            # within-frame order is per-sender sequential like a real flush
+            # (also what the wire codec's delta context expects)
+            batches = [
+                encode_frame(sorted(b, key=lambda c: (c.actor, c.seq)))
+                for b in batches
+            ]
+            wire_bytes += sum(len(b) for b in batches)
         arrival.append(batches)
 
     def session():
@@ -347,6 +356,7 @@ def run_streaming(args) -> dict:
         "rounds": rounds,
         "ops_per_doc": args.ops_per_doc,
         "ingest": "objects" if args.object_ingest else "frames",
+        "wire_bytes_per_op": round(wire_bytes / total_ops, 2) if wire_bytes else None,
         "fallback_docs": fallbacks,
         "workload_gen_seconds": round(gen_time, 1),
         "wall_seconds": round(elapsed, 3),

@@ -87,7 +87,7 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pt_parse_changes.argtypes = [
             i32p, ctypes.c_int64, ctypes.c_int32,  # vals, n_vals, n_changes
             i32p, ctypes.c_int32,  # str2actor, n_strings
-            ctypes.c_int32, ctypes.c_int32,  # actor_bits, max_ctr
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,  # actor_bits, max_ctr, version
             i32p, i32p,  # ch_actor, ch_seq
             i32p, i32p, i32p, ctypes.c_int64,  # dep_off, dep_actor, dep_seq, dep_cap
             i32p, i32p, ctypes.c_int64,  # ops_off, ops, op_cap
@@ -162,12 +162,35 @@ def causal_schedule_indices(
     return out[:count]
 
 
+def _grow_capacities(call, dep_cap: int, op_cap: int, attempts: int = 12) -> int:
+    """Run ``call(dep_cap, op_cap)`` (which allocates its outputs and returns
+    the native rc), doubling whichever capacity the parser reports exhausted
+    (-2 deps, -3 ops).  Wire-v2 elided headers emit dep entries from ZERO
+    payload ints, so output sizes are no longer payload-bounded and a fixed
+    cap can legitimately fall short.  Raises on exhaustion — a capacity
+    condition, distinct from frame corruption."""
+    rc = None
+    for _ in range(attempts):
+        rc = call(dep_cap, op_cap)
+        if rc == -2:
+            dep_cap *= 2
+        elif rc == -3:
+            op_cap *= 2
+        else:
+            return rc
+    raise RuntimeError(
+        f"native parse output capacity exhausted after {attempts} growth "
+        f"attempts (rc={rc})"
+    )
+
+
 def parse_changes(
     values: np.ndarray,
     n_changes: int,
     str2actor: np.ndarray,
     actor_bits: int,
     max_ctr: int,
+    version: int = 1,
 ):
     """Native frame-payload parse (see pt_parse_changes in native.cpp).
 
@@ -182,28 +205,36 @@ def parse_changes(
     values = np.ascontiguousarray(values, np.int32)
     str2actor = np.ascontiguousarray(str2actor, np.int32)
     n = int(n_changes)
-    dep_cap = int(values.size) // 2 + 1
+    # v2 elided headers can emit dep entries from zero wire ints (see
+    # parse_frames): start from an estimate and grow on capacity returns
+    dep_cap = int(values.size) // 2 + 1 + 4 * (n + 1)
     op_cap = int(values.size) // 2 + 1
     ch_actor = np.empty(n, np.int32)
     ch_seq = np.empty(n, np.int32)
     dep_off = np.empty(n + 1, np.int32)
-    dep_actor = np.empty(dep_cap, np.int32)
-    dep_seq = np.empty(dep_cap, np.int32)
     ops_off = np.empty(n + 1, np.int32)
-    ops = np.empty((op_cap, 10), np.int32)
     cnt_ins = np.empty(n, np.int32)
     cnt_del = np.empty(n, np.int32)
     cnt_mark = np.empty(n, np.int32)
     cnt_map = np.empty(n, np.int32)
-    rc = lib.pt_parse_changes(
-        values, int(values.size), n,
-        str2actor, int(str2actor.size),
-        int(actor_bits), int(max_ctr),
-        ch_actor, ch_seq,
-        dep_off, dep_actor, dep_seq, dep_cap,
-        ops_off, ops.reshape(-1), op_cap,
-        cnt_ins, cnt_del, cnt_mark, cnt_map,
-    )
+    out = {}
+
+    def call(dc, oc):
+        out["dep_actor"] = np.empty(dc, np.int32)
+        out["dep_seq"] = np.empty(dc, np.int32)
+        out["ops"] = np.empty((oc, 10), np.int32)
+        return lib.pt_parse_changes(
+            values, int(values.size), n,
+            str2actor, int(str2actor.size),
+            int(actor_bits), int(max_ctr), int(version),
+            ch_actor, ch_seq,
+            dep_off, out["dep_actor"], out["dep_seq"], dc,
+            ops_off, out["ops"].reshape(-1), oc,
+            cnt_ins, cnt_del, cnt_mark, cnt_map,
+        )
+
+    rc = _grow_capacities(call, dep_cap, op_cap)
+    dep_actor, dep_seq, ops = out["dep_actor"], out["dep_seq"], out["ops"]
     if rc != 0:
         raise ValueError(f"malformed change frame payload (native rc={rc})")
     n_deps = int(dep_off[n])
@@ -244,7 +275,10 @@ def parse_frames(
         [[0], np.cumsum([len(r) for r in raw], dtype=np.int64)]
     ).astype(np.int64)
 
-    dep_cap = ints_total // 2 + 2
+    # v2 DEPS_SAME / elided-own-dep headers emit dep entries from ZERO wire
+    # ints, so dep output is no longer bounded by the payload size — start
+    # from a realistic estimate and grow on a capacity return.
+    dep_cap = ints_total // 2 + 2 + 4 * (ch_total + 1)
     op_cap = ints_total // 2 + 2
     str_cap = str_total + 1
     f_status = np.empty(n_frames, np.int32)
@@ -255,28 +289,34 @@ def parse_frames(
     ch_actor = np.empty(ch_total + 1, np.int32)
     ch_seq = np.empty(ch_total + 1, np.int32)
     dep_off = np.empty(ch_total + 2, np.int32)
-    dep_actor = np.empty(dep_cap, np.int32)
-    dep_seq = np.empty(dep_cap, np.int32)
     ops_off = np.empty(ch_total + 2, np.int32)
-    ops = np.empty((op_cap, 10), np.int32)
     cnt_ins = np.empty(ch_total + 1, np.int32)
     cnt_del = np.empty(ch_total + 1, np.int32)
     cnt_mark = np.empty(ch_total + 1, np.int32)
     cnt_map = np.empty(ch_total + 1, np.int32)
 
-    rc = lib.pt_parse_frames(
-        np.ascontiguousarray(data), np.ascontiguousarray(frame_off, np.int64),
-        n_frames,
-        np.ascontiguousarray(actor_bytes), actor_off, len(raw),
-        int(actor_bits), int(max_ctr),
-        f_status, f_ch_off, f_str_off,
-        str_start, str_len, str_cap,
-        ch_actor, ch_seq, ch_total + 1,
-        dep_off, dep_actor, dep_seq, dep_cap,
-        ops_off, ops.reshape(-1), op_cap,
-        cnt_ins, cnt_del, cnt_mark, cnt_map,
-    )
-    if rc != 0:  # capacity sizing bug — surface loudly, don't mis-parse
+    out = {}
+
+    def call(dc, oc):
+        out["dep_actor"] = np.empty(dc, np.int32)
+        out["dep_seq"] = np.empty(dc, np.int32)
+        out["ops"] = np.empty((oc, 10), np.int32)
+        return lib.pt_parse_frames(
+            np.ascontiguousarray(data), np.ascontiguousarray(frame_off, np.int64),
+            n_frames,
+            np.ascontiguousarray(actor_bytes), actor_off, len(raw),
+            int(actor_bits), int(max_ctr),
+            f_status, f_ch_off, f_str_off,
+            str_start, str_len, str_cap,
+            ch_actor, ch_seq, ch_total + 1,
+            dep_off, out["dep_actor"], out["dep_seq"], dc,
+            ops_off, out["ops"].reshape(-1), oc,
+            cnt_ins, cnt_del, cnt_mark, cnt_map,
+        )
+
+    rc = _grow_capacities(call, dep_cap, op_cap)
+    dep_actor, dep_seq, ops = out["dep_actor"], out["dep_seq"], out["ops"]
+    if rc != 0:  # non-capacity rc: sizing bug — surface loudly, don't mis-parse
         raise RuntimeError(f"pt_parse_frames capacity error rc={rc}")
     nc = int(f_ch_off[n_frames])
     ns = int(f_str_off[n_frames])

@@ -28,6 +28,351 @@ namespace {
 inline int64_t key_of(int32_t actor, int32_t seq) {
     return (static_cast<int64_t>(actor) << 32) | static_cast<uint32_t>(seq);
 }
+
+// ---- wire v2 change/op walk (codec.py is the format's reference) ---------
+//
+// v2 delta-encodes against frame-scoped context so the hot shapes cost a
+// few bytes/op: change headers carry a combo int (actor strid << 4 | flags
+// eliding dseq/dstart/deps/nops), dep sets transmit only changed vector
+// clock entries, op ids/objects/insert-refs elide behind per-op flags, and
+// explicit element counters are deltas against the op's own counter.
+// This struct is the decoder's running context (one per frame).
+struct WireV2Ctx {
+    // change-header state, indexed by frame string id
+    std::vector<int32_t> last_seq, prev_end, dep_base;
+    std::vector<uint8_t> own_elided, has_dep_set;
+    std::vector<std::vector<std::pair<int32_t, int32_t>>> dep_set;  // (strid, seq)
+    // op state
+    bool has_prev_op = false;
+    int32_t prev_obj = 0;      // packed (-1 ROOT)
+    bool prev_obj_bad = false;
+    int32_t prev_opid = 0;     // packed
+    bool prev_opid_bad = false;
+    explicit WireV2Ctx(int32_t n_strings)
+        : last_seq(n_strings, 0), prev_end(n_strings, 0), dep_base(n_strings, 0),
+          own_elided(n_strings, 0), has_dep_set(n_strings, 0),
+          dep_set(n_strings) {}
+};
+
+// v2 per-op flags (codec.py _F_*)
+constexpr int32_t kFOpidSeq = 1, kFObjPrev = 2, kFRefPrev = 4, kFRefHead = 8;
+// v2 change-header flags (codec.py _H_*)
+constexpr int32_t kHDseqZero = 1, kHDstartZero = 2, kHDepsSame = 4, kHNopsOne = 8;
+// internal op-row kind for a native-decoded makeList (codec v2 encodes the
+// doc's makeList as map-op kind 5 with flag kFRefHead instead of a JSON
+// spillover; the Python ingest layer adopts it exactly like the JSON form)
+constexpr int32_t kKindMakeList = 7;
+
+// Output sinks + cursors shared by the two entry points (single-frame
+// parse writes from 0; bulk parse appends at its global cursors).
+struct WireOut {
+    int32_t* ch_actor; int32_t* ch_seq;
+    int32_t* dep_off; int32_t* dep_actor; int32_t* dep_seq; int64_t dep_cap;
+    int32_t* ops_off; int32_t* ops; int64_t op_cap;
+    int32_t* cnt_ins; int32_t* cnt_del; int32_t* cnt_mark; int32_t* cnt_map;
+};
+
+// Decode a v2 payload (codec.py encode_frame v2 is the format reference).
+// s2a maps frame string ids to declared actor interner ids (>=1) or -1.
+// str_base globalizes string ids stored into op rows (0 for single-frame).
+// Returns 0 ok, 1 corrupt/malformed, -2 dep capacity, -3 op capacity;
+// cursors nc/nd/no advance only as records are written (caller rolls back
+// on nonzero).
+int32_t walk_v2(const int32_t* vals, int64_t n_vals, int32_t n_changes,
+                const int32_t* s2a, int32_t n_strings,
+                int32_t actor_bits, int32_t max_ctr, int32_t str_base,
+                WireOut& o, int64_t& nc, int64_t& nd, int64_t& no) {
+    WireV2Ctx ctx(n_strings);
+    int64_t p = 0;
+    auto take = [&](int64_t k) -> const int32_t* {
+        if (p + k > n_vals) return nullptr;
+        const int32_t* ptr = vals + p;
+        p += k;
+        return ptr;
+    };
+    auto actor_of = [&](int32_t strid) -> int32_t {
+        if (strid < 0 || strid >= n_strings) return -2;
+        return s2a[strid];
+    };
+    auto pack = [&](int64_t ctr, int32_t strid, bool* bad) -> int32_t {
+        const int32_t a = actor_of(strid);
+        if (a == -2) { *bad = true; return 0; }
+        if (a < 0 || ctr < 0 || ctr > max_ctr) { *bad = true; return 0; }
+        return (static_cast<int32_t>(ctr) << actor_bits) | a;
+    };
+
+    for (int32_t c = 0; c < n_changes; ++c) {
+        const int32_t* cb = take(1);
+        if (!cb) return 1;
+        const int32_t strid = *cb >> 4, hflags = *cb & 15;
+        if (*cb < 0 || strid >= n_strings) return 1;
+        int32_t dseq = 0, dstart = 0;
+        if (!(hflags & kHDseqZero)) {
+            const int32_t* v = take(1); if (!v) return 1; dseq = *v;
+        }
+        if (!(hflags & kHDstartZero)) {
+            const int32_t* v = take(1); if (!v) return 1; dstart = *v;
+        }
+        // wire deltas are attacker-controlled: do the reconstruction in
+        // int64 and reject anything leaving int32 range as corrupt (signed
+        // int32 overflow would be UB, and a wrapped value would propagate
+        // downstream instead of flagging the frame)
+        const int64_t seq64 =
+            static_cast<int64_t>(ctx.last_seq[strid]) + 1 + dseq;
+        const int64_t start64 =
+            static_cast<int64_t>(ctx.prev_end[strid]) + dstart;
+        if (seq64 < 0 || seq64 > INT32_MAX || start64 < 0 ||
+            start64 > INT32_MAX) {
+            return 1;
+        }
+        const int32_t seq = static_cast<int32_t>(seq64);
+        const int32_t start_op = static_cast<int32_t>(start64);
+        const int32_t a = actor_of(strid);
+        o.ch_actor[nc] = a;  // may be -1: undeclared actor, caller demotes
+        o.ch_seq[nc] = seq;
+
+        int32_t own;
+        if (hflags & kHDepsSame) {
+            if (!ctx.has_dep_set[strid]) return 1;
+            own = ctx.own_elided[strid];
+        } else {
+            const int32_t* v = take(1);
+            if (!v || *v < 0) return 1;
+            own = *v & 1;
+            const bool delta = (*v >> 1) & 1;
+            const int32_t count = *v >> 2;
+            auto& entries = ctx.dep_set[strid];
+            if (delta) {
+                if (!ctx.has_dep_set[strid]) return 1;
+                for (int32_t i = 0; i < count; ++i) {
+                    const int32_t* dp = take(2);
+                    if (!dp) return 1;
+                    const int32_t da = dp[0];
+                    if (da < 0 || da >= n_strings) return 1;
+                    bool found = false;
+                    for (auto& e : entries) {
+                        if (e.first == da) {
+                            const int64_t ds64 =
+                                static_cast<int64_t>(e.second) + dp[1];
+                            if (ds64 < 0 || ds64 > INT32_MAX) return 1;
+                            e.second = static_cast<int32_t>(ds64);
+                            ctx.dep_base[da] = e.second;
+                            found = true;
+                            break;
+                        }
+                    }
+                    if (!found) return 1;
+                }
+            } else {
+                entries.clear();
+                for (int32_t i = 0; i < count; ++i) {
+                    const int32_t* dp = take(2);
+                    if (!dp) return 1;
+                    const int32_t da = dp[0];
+                    if (da < 0 || da >= n_strings) return 1;
+                    const int64_t ds64 =
+                        static_cast<int64_t>(
+                            std::max(ctx.dep_base[da], ctx.last_seq[da])) +
+                        dp[1];
+                    if (ds64 < 0 || ds64 > INT32_MAX) return 1;
+                    entries.push_back({da, static_cast<int32_t>(ds64)});
+                    ctx.dep_base[da] = static_cast<int32_t>(ds64);
+                }
+            }
+            ctx.own_elided[strid] = static_cast<uint8_t>(own);
+            ctx.has_dep_set[strid] = 1;
+        }
+        if (own) {
+            if (a < 0) {
+                o.ch_actor[nc] = -1;  // dep on undeclared (own) actor
+            } else {
+                if (nd >= o.dep_cap) return -2;
+                o.dep_actor[nd] = a;
+                o.dep_seq[nd] = seq - 1;
+                ++nd;
+            }
+        }
+        for (const auto& e : ctx.dep_set[strid]) {
+            const int32_t da = actor_of(e.first);
+            if (da == -2) return 1;
+            if (da < 0) { o.ch_actor[nc] = -1; continue; }
+            if (nd >= o.dep_cap) return -2;
+            o.dep_actor[nd] = da;
+            o.dep_seq[nd] = e.second;
+            ++nd;
+        }
+        o.dep_off[nc + 1] = static_cast<int32_t>(nd);
+
+        int32_t nops = 1;
+        if (!(hflags & kHNopsOne)) {
+            const int32_t* v = take(1);
+            if (!v || *v < 0) return 1;
+            nops = *v;
+        }
+        const int64_t end64 = static_cast<int64_t>(start_op) + nops;
+        if (end64 > INT32_MAX) return 1;
+        ctx.last_seq[strid] = seq;
+        ctx.prev_end[strid] = static_cast<int32_t>(end64);
+
+        int32_t ci = 0, cd = 0, cm = 0, cp = 0;
+        for (int32_t k = 0; k < nops; ++k) {
+            if (no >= o.op_cap) return -3;
+            int32_t* row = o.ops + no * 10;
+            for (int i = 0; i < 10; ++i) row[i] = 0;
+            const int32_t* fp = take(1);
+            if (!fp || *fp < 0) return 1;
+            const int32_t kind = *fp & 7, of = *fp >> 3;
+            bool bad = (o.ch_actor[nc] < 0);
+            if (kind == 4) {  // JSON spillover (no flags, no ctx update)
+                if (of) return 1;
+                const int32_t* b = take(1);
+                if (!b) return 1;
+                if (b[0] < 0 || b[0] >= n_strings) return 1;
+                row[0] = 3;
+                row[3] = str_base + b[0];
+            } else {
+                if (of >> 4) return 1;
+                if ((of & kFRefPrev) && kind != 0) return 1;
+                if ((of & kFRefHead) && kind != 0 && kind != 5) return 1;
+                if ((of & kFRefPrev) && (of & kFRefHead)) return 1;
+                int32_t obj;
+                bool obj_bad = false;
+                if (of & kFObjPrev) {
+                    if (!ctx.has_prev_op) return 1;
+                    obj = ctx.prev_obj;
+                    obj_bad = ctx.prev_obj_bad;
+                } else {
+                    const int32_t* b = take(3);
+                    if (!b) return 1;
+                    obj = (b[0] == 0) ? -1 : pack(b[1], b[2], &obj_bad);
+                }
+                if (obj_bad) bad = true;
+                int64_t op_ctr;
+                int32_t op_strid;
+                if (of & kFOpidSeq) {
+                    op_ctr = static_cast<int64_t>(start_op) + k;
+                    op_strid = strid;
+                } else {
+                    const int32_t* b = take(2);
+                    if (!b) return 1;
+                    op_ctr = b[0];
+                    op_strid = b[1];
+                }
+                bool opid_bad = false;
+                const int32_t opid = pack(op_ctr, op_strid, &opid_bad);
+                if (opid_bad) bad = true;
+                const int32_t prev_opid = ctx.prev_opid;
+                const bool prev_opid_bad = ctx.prev_opid_bad;
+                const bool had_prev = ctx.has_prev_op;
+                ctx.prev_obj = obj;
+                ctx.prev_obj_bad = obj_bad;
+                ctx.prev_opid = opid;
+                ctx.prev_opid_bad = opid_bad;
+                ctx.has_prev_op = true;
+
+                if (kind == 0) {  // insert
+                    int32_t ref = 0;
+                    if (of & kFRefPrev) {
+                        if (!had_prev) return 1;
+                        ref = prev_opid;
+                        if (prev_opid_bad) bad = true;
+                    } else if (!(of & kFRefHead)) {
+                        const int32_t* b = take(2);
+                        if (!b) return 1;
+                        bool rb = false;
+                        ref = pack(op_ctr + b[0], b[1], &rb);
+                        if (rb) bad = true;
+                    }
+                    const int32_t* cch = take(1);
+                    if (!cch) return 1;
+                    const int64_t cp = static_cast<int64_t>(cch[0]) + 110;
+                    if (cp < INT32_MIN || cp > INT32_MAX) return 1;
+                    row[0] = 0; row[1] = obj; row[2] = opid; row[3] = ref;
+                    row[4] = static_cast<int32_t>(cp);  // codec char bias
+                    ++ci;
+                } else if (kind == 1) {  // delete
+                    const int32_t* b = take(2);
+                    if (!b) return 1;
+                    bool eb = false;
+                    row[0] = 1; row[1] = obj; row[2] = opid;
+                    row[3] = pack(op_ctr + b[0], b[1], &eb);
+                    if (eb) bad = true;
+                    ++cd;
+                } else if (kind == 2 || kind == 3) {  // marks
+                    const int32_t* pk = take(1);
+                    if (!pk || pk[0] < 0 || (pk[0] >> 6)) return 1;
+                    row[0] = 2; row[1] = obj; row[2] = opid;
+                    row[3] = (kind == 2) ? 1 : 2;
+                    row[4] = pk[0] & 3;       // mark type
+                    row[5] = (pk[0] >> 2) & 3;  // start kind
+                    row[7] = (pk[0] >> 4) & 3;  // end kind
+                    int64_t base_ctr = op_ctr;
+                    if (row[5] <= 1) {
+                        const int32_t* b = take(2);
+                        if (!b) return 1;
+                        bool sb = false;
+                        base_ctr += b[0];
+                        row[6] = pack(base_ctr, b[1], &sb);
+                        if (sb) bad = true;
+                    }
+                    if (row[7] <= 1) {
+                        const int32_t* b = take(2);
+                        if (!b) return 1;
+                        bool ebb = false;
+                        row[8] = pack(base_ctr + b[0], b[1], &ebb);
+                        if (ebb) bad = true;
+                    }
+                    const int32_t* at = take(1);
+                    if (!at) return 1;
+                    if (at[0] < 0 || at[0] > n_strings) return 1;
+                    row[9] = (at[0] == 0) ? 0 : str_base + at[0];
+                    ++cm;
+                } else if (kind == 5 && (of & kFRefHead)) {  // makeList
+                    const int32_t* b = take(1);
+                    if (!b) return 1;
+                    if (b[0] < 0 || b[0] >= n_strings) return 1;
+                    row[0] = kKindMakeList;
+                    row[1] = obj; row[2] = opid;
+                    row[3] = str_base + b[0];
+                    // adopted (and counted) by the Python ingest layer,
+                    // exactly like v1's JSON-spillover makeList
+                } else if (kind == 5 || kind == 7) {  // makeMap / map del
+                    const int32_t* b = take(1);
+                    if (!b) return 1;
+                    if (b[0] < 0 || b[0] >= n_strings) return 1;
+                    row[0] = 6; row[1] = obj; row[2] = opid;
+                    row[3] = str_base + b[0];
+                    row[4] = (kind == 5) ? 6 : 0;  // VK_OBJ / VK_DELETED
+                    row[5] = (kind == 5) ? row[2] : 0;
+                    ++cp;
+                } else if (kind == 6) {  // map set
+                    const int32_t* b = take(3);
+                    if (!b) return 1;
+                    if (b[0] < 0 || b[0] >= n_strings) return 1;
+                    if (b[1] < 1 || b[1] > 5) return 1;
+                    if (b[1] == 1 && (b[2] < 0 || b[2] >= n_strings)) return 1;
+                    row[0] = 6; row[1] = obj; row[2] = opid;
+                    row[3] = str_base + b[0];
+                    row[4] = b[1];
+                    row[5] = (b[1] == 1) ? str_base + b[2] + 1 : b[2];
+                    ++cp;
+                } else {
+                    return 1;  // unknown op kind
+                }
+            }
+            if (bad) row[0] = 4;
+            ++no;
+        }
+        o.ops_off[nc + 1] = static_cast<int32_t>(no);
+        o.cnt_ins[nc] = ci;
+        o.cnt_del[nc] = cd;
+        o.cnt_mark[nc] = cm;
+        o.cnt_map[nc] = cp;
+        ++nc;
+    }
+    if (p != n_vals) return 1;
+    return 0;
+}
 }  // namespace
 
 extern "C" {
@@ -190,7 +535,7 @@ int64_t pt_varint_decode(const uint8_t* in, int64_t nbytes, int32_t* out,
 int32_t pt_parse_changes(
     const int32_t* vals, int64_t n_vals, int32_t n_changes,
     const int32_t* str2actor, int32_t n_strings,
-    int32_t actor_bits, int32_t max_ctr,
+    int32_t actor_bits, int32_t max_ctr, int32_t version,
     int32_t* ch_actor, int32_t* ch_seq,
     int32_t* dep_off, int32_t* dep_actor, int32_t* dep_seq, int64_t dep_cap,
     int32_t* ops_off, int32_t* ops, int64_t op_cap,
@@ -200,6 +545,15 @@ int32_t pt_parse_changes(
     int64_t no = 0;      // op rows written
     dep_off[0] = 0;
     ops_off[0] = 0;
+    if (version >= 2) {
+        WireOut o{ch_actor, ch_seq, dep_off, dep_actor, dep_seq, dep_cap,
+                  ops_off, ops, op_cap, cnt_ins, cnt_del, cnt_mark, cnt_map};
+        int64_t nc = 0;
+        const int32_t rc = walk_v2(vals, n_vals, n_changes, str2actor,
+                                   n_strings, actor_bits, max_ctr, 0,
+                                   o, nc, nd, no);
+        return (rc == 1) ? -1 : rc;
+    }
 
     auto take = [&](int64_t k) -> const int32_t* {
         if (p + k > n_vals) return nullptr;
@@ -557,7 +911,9 @@ int32_t pt_parse_frames(
             if (hi - lo < 29 || hi > frame_off[n_frames]) { corrupt = true; break; }
             // header: magic(4) ver(1) n_changes(u32) n_strings(u32)
             //         n_ints(u64) payload_len(u64)  — little-endian packed
-            if (std::memcmp(data + lo, "PTXF", 4) != 0 || data[lo + 4] != 1) {
+            const int32_t version = data[lo + 4];
+            if (std::memcmp(data + lo, "PTXF", 4) != 0 ||
+                (version != 1 && version != 2)) {
                 corrupt = true; break;
             }
             uint32_t h_changes, h_strings;
@@ -567,8 +923,10 @@ int32_t pt_parse_frames(
             std::memcpy(&h_ints, data + lo + 13, 8);
             std::memcpy(&h_payload, data + lo + 21, 8);
             const uint64_t body = static_cast<uint64_t>(hi - lo - 29);
+            // min ints/change: 5 for v1 headers, 2 for v2's delta-elided form
+            const uint64_t min_change_ints = (version == 1) ? 5 : 2;
             if (h_payload > body || h_ints > h_payload || h_strings > body ||
-                static_cast<uint64_t>(h_changes) * 5 > h_ints) {
+                static_cast<uint64_t>(h_changes) * min_change_ints > h_ints) {
                 corrupt = true; break;
             }
             if (nc + h_changes > ch_cap) return -2;
@@ -624,7 +982,23 @@ int32_t pt_parse_frames(
             }
             if (corrupt) break;
 
-            // change walk (the pt_parse_changes logic, offsets globalized)
+            if (version == 2) {
+                WireOut o{ch_actor, ch_seq, dep_off, dep_actor, dep_seq,
+                          dep_cap, ops_off, ops, op_cap,
+                          cnt_ins, cnt_del, cnt_mark, cnt_map};
+                const int32_t rc = walk_v2(
+                    vals.data(), static_cast<int64_t>(h_ints),
+                    static_cast<int32_t>(h_changes), s2a.data(),
+                    static_cast<int32_t>(h_strings), actor_bits, max_ctr,
+                    static_cast<int32_t>(ns), o, nc, nd, no);
+                if (rc == -2) return -2;
+                if (rc == -3) return -3;
+                if (rc != 0) { corrupt = true; break; }
+                ns += h_strings;
+                break;  // frame done (the do-while(false) exits)
+            }
+
+            // v1 change walk (the pt_parse_changes logic, offsets globalized)
             const int32_t n_strings_f = static_cast<int32_t>(h_strings);
             int64_t p = 0;
             const int64_t n_vals = static_cast<int64_t>(h_ints);

@@ -36,6 +36,7 @@ KIND_JSON = 3
 KIND_BAD = 4
 KIND_SKIP = 5  # resolved makeList: consumed at parse time, no device op
 KIND_MAP = 6  # map-register op (makeMap / map set / map del)
+KIND_MAKELIST = 7  # wire-v2 native makeList row: adopted like the JSON form
 
 #: op-matrix columns (see native.cpp): the mark row in device MARK_COLS order
 #: is cols [3, 4, 5, 6, 7, 8, 2, 9].
@@ -163,13 +164,14 @@ def parse_frame(
         # packed ids collide beyond ACTOR_BITS; the object path demotes the
         # same way (encode.DocEncoder.ok)
         raise FrameIngestError("actor table exceeds packed-id capacity")
-    strings, values, n_changes = frame_parts(data)
+    strings, values, n_changes, version = frame_parts(data)
     parsed_raw = native.parse_changes(
         np.asarray(values, np.int32),
         n_changes,
         np.asarray([actors.get(s) if actors.get(s) is not None else -1 for s in strings], np.int32),
         ACTOR_BITS,
         MAX_CTR,
+        version=version,
     )
     if parsed_raw is None:  # pragma: no cover - guarded by available() above
         raise FrameIngestError("native core unavailable")
@@ -186,27 +188,35 @@ def parse_frame(
     # conversion as parse_frames_bulk, so text placement competes in register
     # LWW).  A re-delivered copy of the same makeList is idempotent:
     # duplicate frames are a routine anti-entropy condition.
-    for row in np.nonzero(kinds == KIND_JSON)[0]:
+    for row in np.nonzero((kinds == KIND_JSON) | (kinds == KIND_MAKELIST))[0]:
         from .packed import OBJ_ROOT, VK_TEXT
 
-        try:
-            op = Operation.from_json(json.loads(strings[int(ops[row, 3])]))
-        except (ValueError, TypeError, KeyError, AttributeError) as exc:
-            # same normalized contract as codec.decode_frame
-            raise ValueError(f"corrupt frame: {exc!r}") from exc
-        if op.action != "makeList" or op.key is None:
-            raise FrameIngestError(f"non-text op on fast path: {op.action}")
-        actor_idx = actors.get(op.opid[1])
-        if actor_idx is None or op.opid[0] > MAX_CTR:
-            raise FrameIngestError("makeList opid outside packed range")
-        if not isinstance(op.obj, tuple):
-            pobj = OBJ_ROOT
+        if kinds[row] == KIND_MAKELIST:
+            # wire-v2 native makeList: ids already packed/validated by the
+            # native walk (bad ids became KIND_BAD rows, handled below)
+            pobj = int(ops[row, 1])
+            packed = int(ops[row, 2])
+            key = strings[int(ops[row, 3])]
         else:
-            obj_actor = actors.get(op.obj[1])
-            if obj_actor is None or op.obj[0] > MAX_CTR:
-                raise FrameIngestError("makeList container outside packed range")
-            pobj = pack_id(op.obj[0], obj_actor)
-        packed = pack_id(op.opid[0], actor_idx)
+            try:
+                op = Operation.from_json(json.loads(strings[int(ops[row, 3])]))
+            except (ValueError, TypeError, KeyError, AttributeError) as exc:
+                # same normalized contract as codec.decode_frame
+                raise ValueError(f"corrupt frame: {exc!r}") from exc
+            if op.action != "makeList" or op.key is None:
+                raise FrameIngestError(f"non-text op on fast path: {op.action}")
+            actor_idx = actors.get(op.opid[1])
+            if actor_idx is None or op.opid[0] > MAX_CTR:
+                raise FrameIngestError("makeList opid outside packed range")
+            if not isinstance(op.obj, tuple):
+                pobj = OBJ_ROOT
+            else:
+                obj_actor = actors.get(op.obj[1])
+                if obj_actor is None or op.obj[0] > MAX_CTR:
+                    raise FrameIngestError("makeList container outside packed range")
+                pobj = pack_id(op.obj[0], obj_actor)
+            packed = pack_id(op.opid[0], actor_idx)
+            key = op.key
         if text_obj == 0:
             text_obj = packed
         elif packed != text_obj:
@@ -216,7 +226,7 @@ def parse_frame(
         ops[row, 0] = KIND_MAP
         ops[row, 1] = pobj
         ops[row, 2] = packed
-        ops[row, 3] = keys.intern(op.key)
+        ops[row, 3] = keys.intern(key)
         ops[row, 4] = VK_TEXT
         ops[row, 5] = packed
         ops[row, 6:] = 0
@@ -282,16 +292,19 @@ def frame_header_counts(buf: np.ndarray, frame_off: np.ndarray):
     idx = np.nonzero(ok)[0]
     hdr = buf[np.add.outer(frame_off[:-1][idx], np.arange(29, dtype=np.int64))]
     magic_ok = np.all(hdr[:, :4] == np.frombuffer(b"PTXF", np.uint8), axis=1)
-    ver_ok = hdr[:, 4] == 1
+    ver = hdr[:, 4].astype(np.int64)
+    ver_ok = (ver == 1) | (ver == 2)
     h_changes = hdr[:, 5:9].copy().view("<u4").ravel().astype(np.int64)
     h_strings = hdr[:, 9:13].copy().view("<u4").ravel().astype(np.int64)
     h_ints = hdr[:, 13:21].copy().view("<u8").ravel().astype(np.int64)
     h_payload = hdr[:, 21:29].copy().view("<u8").ravel().astype(np.int64)
     body = (lens[idx] - 29).astype(np.int64)
+    # min ints/change: 5 for v1 headers, 2 for v2's delta-elided form
+    min_change_ints = np.where(ver == 1, 5, 2)
     sane = (
         magic_ok & ver_ok
         & (h_payload <= body) & (h_ints <= h_payload) & (h_strings <= body)
-        & (h_changes * 5 <= h_ints)
+        & (h_changes * min_change_ints <= h_ints)
     )
     ok[idx] = sane
     keep = idx[sane]
@@ -455,7 +468,7 @@ def parse_frames_bulk(
     # and can never leak a makeList adoption into text_obj_by_doc
     # (advisor finding r2: a crafted corrupt frame could otherwise poison a
     # doc's text object and demote all its later valid text ops).
-    json_rows = np.nonzero(kinds == KIND_JSON)[0]
+    json_rows = np.nonzero((kinds == KIND_JSON) | (kinds == KIND_MAKELIST))[0]
     if len(json_rows):
         from .packed import OBJ_ROOT, VK_TEXT
 
@@ -480,34 +493,46 @@ def parse_frames_bulk(
             local_text = text_obj_by_doc.get(doc, 0)
             staged: list = []
             for row in json_rows[order[gs:ge]]:
-                try:
-                    op = Operation.from_json(json.loads(string_at(int(ops[row, 3]))))
-                except (ValueError, TypeError, KeyError, AttributeError,
-                        UnicodeDecodeError):
-                    status[f] = FRAME_CORRUPT
-                    break
-                if op.action != "makeList" or op.key is None:
-                    status[f] = FRAME_DEMOTE
-                    break
-                actor_idx = actors.get(op.opid[1])
-                if actor_idx is None or op.opid[0] > MAX_CTR:
-                    status[f] = FRAME_DEMOTE
-                    break
-                if not isinstance(op.obj, tuple):
-                    pobj = OBJ_ROOT  # the ROOT sentinel (or absent) = root map
+                if kinds[row] == KIND_MAKELIST:
+                    # wire-v2 native makeList: ids already packed/validated
+                    # (bad ids became KIND_BAD rows, which demote the frame
+                    # before this loop runs)
+                    pobj, packed = int(ops[row, 1]), int(ops[row, 2])
+                    try:
+                        key = string_at(int(ops[row, 3]))
+                    except UnicodeDecodeError:
+                        status[f] = FRAME_CORRUPT
+                        break
                 else:
-                    obj_actor = actors.get(op.obj[1])
-                    if obj_actor is None or op.obj[0] > MAX_CTR:
+                    try:
+                        op = Operation.from_json(json.loads(string_at(int(ops[row, 3]))))
+                    except (ValueError, TypeError, KeyError, AttributeError,
+                            UnicodeDecodeError):
+                        status[f] = FRAME_CORRUPT
+                        break
+                    if op.action != "makeList" or op.key is None:
                         status[f] = FRAME_DEMOTE
                         break
-                    pobj = pack_id(op.obj[0], obj_actor)
-                packed = pack_id(op.opid[0], actor_idx)
+                    actor_idx = actors.get(op.opid[1])
+                    if actor_idx is None or op.opid[0] > MAX_CTR:
+                        status[f] = FRAME_DEMOTE
+                        break
+                    if not isinstance(op.obj, tuple):
+                        pobj = OBJ_ROOT  # the ROOT sentinel (or absent) = root map
+                    else:
+                        obj_actor = actors.get(op.obj[1])
+                        if obj_actor is None or op.obj[0] > MAX_CTR:
+                            status[f] = FRAME_DEMOTE
+                            break
+                        pobj = pack_id(op.obj[0], obj_actor)
+                    packed = pack_id(op.opid[0], actor_idx)
+                    key = op.key
                 if local_text == 0:
                     local_text = packed
                 elif packed != local_text:
                     status[f] = FRAME_DEMOTE
                     break
-                staged.append((row, pobj, packed, op.key))
+                staged.append((row, pobj, packed, key))
             if status[f] == FRAME_OK and staged:
                 text_obj_by_doc[doc] = local_text
                 # Rewrite the spillover row into a VK_TEXT map-register row:

@@ -287,20 +287,24 @@ def test_wrong_shape_spillover_json_raises_valueerror():
 def test_out_of_range_codepoint_rejected_at_ingest(workloads):
     """A frame whose insert codepoint exceeds chr() range must raise
     ValueError at the door, not poison device state (object path parity)."""
+    import struct
+
     from peritext_tpu.ops.frames import parse_frame
+    from peritext_tpu.parallel.codec import _CHAR_BIAS, _py_varint_encode
     from peritext_tpu.utils.interning import Interner, OrderedActorTable
 
     docs, _, initial = generate_docs("a", 1)
     frame = bytearray(encode_frame([initial]))
-    # 'a' (0x61) zigzags to 0xC2 0x01 (2-byte varint); swap in a decodable
-    # varint for zigzag(0x200000) — a codepoint beyond chr() range
-    idx = bytes(frame).rindex(b"\xc2\x01")
-    patched = bytes(frame[:idx]) + b"\x80\x80\x80\x02" + bytes(frame[idx + 2:])
-    # fix header payload length (+2 bytes)
-    import struct
+    # the single insert 'a' is the frame's LAST varint (wire v2 stores the
+    # biased codepoint); swap in the biased encoding of a codepoint beyond
+    # chr() range and fix the header payload length
+    old = _py_varint_encode([ord("a") - _CHAR_BIAS])
+    new = _py_varint_encode([0x200000 - _CHAR_BIAS])
+    assert bytes(frame[-len(old):]) == old, "frame layout changed"
+    patched = bytes(frame[: -len(old)]) + new
     hdr = struct.Struct("<4sBIIQQ")
     magic, ver, nc, ns, ni, pl = hdr.unpack_from(patched)
-    patched = hdr.pack(magic, ver, nc, ns, ni, pl + 2) + patched[hdr.size:]
+    patched = hdr.pack(magic, ver, nc, ns, ni, pl + len(new) - len(old)) + patched[hdr.size:]
     with pytest.raises(ValueError, match="codepoint"):
         parse_frame(patched, OrderedActorTable(["doc1"]), Interner(), 0, Interner())
 
