@@ -39,6 +39,9 @@ bench-smoke:
 bench-streaming:
 	$(PY) bench.py --mode streaming
 
+bench-engine:  # device-only streaming replay: the engine limit vs the link
+	$(PY) bench.py --mode engine
+
 entry:
 	$(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); \
 	import __graft_entry__ as g; fn, a = g.entry(); \

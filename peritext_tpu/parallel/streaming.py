@@ -275,6 +275,9 @@ class StreamingMerge:
         self._doc_comment_ids: Dict[int, Interner] = {}
         # object-path docs with pending changes (so step() never scans all D)
         self._object_pending: set = set()
+        # when a list, _apply_compact records each round's device-ready
+        # inputs (engine-limit bench replay; see bench.py run_engine)
+        self._capture_rounds: Optional[list] = None
         state = empty_docs(self._padded_docs, slot_capacity, mark_capacity,
                            tomb_capacity, map_capacity=map_capacity)
         self.state: PackedDocs = shard_docs(state, mesh) if mesh is not None else state
@@ -594,15 +597,19 @@ class StreamingMerge:
             # next round (the jit call would otherwise block on each input)
             return jax.device_put(out)
 
-        return apply_batch_compact_jit(
-            self.state,
+        round_inputs = (
             (enc.ins_count, enc.del_count, enc.mark_count, enc.map_count),
             (pad(enc.ins_ref[mi]), pad(enc.ins_op[mi]), pad(enc.ins_char[mi])),
             pad(enc.del_target[md]),
             {col: pad(enc.marks[col][mm]) for col in MARK_COLS},
             {col: pad(enc.map_ops[col][mp]) for col in MAP_STREAM_COLS},
-            widths=widths,
         )
+        if self._capture_rounds is not None:
+            # engine-limit benchmarking (bench.py --mode engine): record the
+            # round's device-ready inputs so a replay can time the pure
+            # device engine with zero host parse/schedule/transfer per round
+            self._capture_rounds.append((round_inputs, widths))
+        return apply_batch_compact_jit(self.state, *round_inputs, widths=widths)
 
     def _round_widths(self, pool, obj_streams, ki: int, kd: int, km: int, kp: int):
         """Shrink this round's stream widths by a shared power-of-two shift

@@ -86,6 +86,20 @@ def test_probe_hang_is_bounded_and_falls_back():
     assert result["probe_seconds"] < 60
 
 
+@pytest.mark.slow
+def test_engine_mode_reports_engine_and_end_to_end():
+    """--mode engine replays captured device-ready rounds (digest-verified
+    against the real session) and reports both the engine-limit rate and the
+    end-to-end reference it is decoupled from."""
+    proc = _run_bench(["--mode", "engine", "--platform", "cpu"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = _json_line(proc.stdout)
+    assert result["metric"] == "engine_limit_streaming_ops_per_sec_per_chip"
+    assert result["value"] > 0 and result["end_to_end_ops_per_sec"] > 0
+    # the replay syncs once; it can never be slower than end-to-end by much
+    assert result["vs_baseline"] > 0.8
+
+
 def test_probe_ok_on_cpu_only_env_flags_unavailability(monkeypatch):
     """No TPU plugin (default backend = cpu) is recorded as tpu_unavailable
     so a driver run on a chip-less host can't masquerade as a TPU number.
