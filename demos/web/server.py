@@ -31,12 +31,30 @@ from peritext_tpu.parallel.pubsub import Publisher
 _HERE = Path(__file__).parent
 
 
+def describe_op(editor: str, op: dict) -> str:
+    """One-line op description for the debug log panel (the reference
+    renders the same log into the demo DOM — ``describeOp``,
+    src/bridge.ts:96-110, ``outputDebugForChange`` :235-242)."""
+    action = op.get("action")
+    if action == "insert":
+        return f'{editor}: insert {"".join(op.get("values", []))!r} at {op.get("index")}'
+    if action == "delete":
+        return f'{editor}: delete {op.get("count")} at {op.get("index")}'
+    if action in ("addMark", "removeMark"):
+        attrs = op.get("attrs")
+        extra = f" {attrs}" if attrs else ""
+        return (f'{editor}: {action} {op.get("markType")} '
+                f'[{op.get("startIndex")}, {op.get("endIndex")}){extra}')
+    return f"{editor}: {action}"
+
+
 class Session:
     """The two editors plus a lock (bridge editors are single-threaded)."""
 
     def __init__(self, backend: str = "tpu") -> None:
         self.lock = threading.Lock()
         self.pub = Publisher()
+        self.oplog: list = []
         actors = ("alice", "bob", "init")
         self.editors = {
             "alice": create_editor("alice", self.pub, backend=backend, actors=actors),
@@ -49,19 +67,31 @@ class Session:
 
     def state(self) -> dict:
         return {
-            name: {
-                "spans": ed.view.spans(),
-                "pending": len(ed.queue) if hasattr(ed, "queue") else 0,
-            }
-            for name, ed in self.editors.items()
+            **{
+                name: {
+                    "spans": ed.view.spans(),
+                    "pending": len(ed.queue) if hasattr(ed, "queue") else 0,
+                }
+                for name, ed in self.editors.items()
+            },
+            "oplog": list(self.oplog),
         }
+
+    def _log(self, line: str) -> None:
+        self.oplog.append(line)
+        del self.oplog[:-12]
 
     def dispatch(self, editor: str, ops) -> None:
         self.editors[editor].dispatch_input_ops(ops)
+        for op in ops:
+            self._log(describe_op(editor, op))
 
     def sync(self) -> None:
+        had_pending = any(len(ed.queue) for ed in self.editors.values())
         for ed in self.editors.values():
             ed.sync()
+        if had_pending:  # auto-sync no-ops must not flush real ops out of the log
+            self._log("-- sync: queues flushed both ways --")
 
 
 SESSION: Session = None  # set in main()
