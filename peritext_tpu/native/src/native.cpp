@@ -42,6 +42,9 @@ struct WireV2Ctx {
     std::vector<int32_t> last_seq, prev_end, dep_base;
     std::vector<uint8_t> own_elided, has_dep_set;
     std::vector<std::vector<std::pair<int32_t, int32_t>>> dep_set;  // (strid, seq)
+    // duplicate-dep detection scratch (epoch-stamped, O(1) reset per change)
+    std::vector<int32_t> dep_seen;
+    int32_t dep_epoch = 0;
     // op state
     bool has_prev_op = false;
     int32_t prev_obj = 0;      // packed (-1 ROOT)
@@ -51,7 +54,7 @@ struct WireV2Ctx {
     explicit WireV2Ctx(int32_t n_strings)
         : last_seq(n_strings, 0), prev_end(n_strings, 0), dep_base(n_strings, 0),
           own_elided(n_strings, 0), has_dep_set(n_strings, 0),
-          dep_set(n_strings) {}
+          dep_set(n_strings), dep_seen(n_strings, -1) {}
 };
 
 // v2 per-op flags (codec.py _F_*)
@@ -79,7 +82,7 @@ struct WireOut {
 // cursors nc/nd/no advance only as records are written (caller rolls back
 // on nonzero).
 int32_t walk_v2(const int32_t* vals, int64_t n_vals, int32_t n_changes,
-                const int32_t* s2a, int32_t n_strings,
+                const int32_t* s2a, int32_t n_strings, int32_t n_declared,
                 int32_t actor_bits, int32_t max_ctr, int32_t str_base,
                 WireOut& o, int64_t& nc, int64_t& nd, int64_t& no) {
     WireV2Ctx ctx(n_strings);
@@ -141,6 +144,14 @@ int32_t walk_v2(const int32_t* vals, int64_t n_vals, int32_t n_changes,
             own = *v & 1;
             const bool delta = (*v >> 1) & 1;
             const int32_t count = *v >> 2;
+            // Dep sets referencing far more actors than the session declares
+            // leave the fast path by DEMOTION (the object path's Python
+            // decoder accepts them — same route as undeclared-actor deps),
+            // but their storage is bounded here: without a cap, a small
+            // DEPS_SAME-spamming frame forces multi-GB dep output and
+            // quadratic re-emission (review finding r3).  Entries beyond the
+            // cap are consumed from the stream (alignment) but not stored.
+            const int32_t dep_store_cap = n_declared + 64;
             auto& entries = ctx.dep_set[strid];
             if (delta) {
                 if (!ctx.has_dep_set[strid]) return 1;
@@ -165,17 +176,29 @@ int32_t walk_v2(const int32_t* vals, int64_t n_vals, int32_t n_changes,
                 }
             } else {
                 entries.clear();
+                ++ctx.dep_epoch;
                 for (int32_t i = 0; i < count; ++i) {
                     const int32_t* dp = take(2);
                     if (!dp) return 1;
                     const int32_t da = dp[0];
                     if (da < 0 || da >= n_strings) return 1;
+                    // duplicate dep actors never occur in a legit encoding
+                    // (deps are a per-actor map, and codec.py rejects dups
+                    // identically): corrupt
+                    if (ctx.dep_seen[da] == ctx.dep_epoch) return 1;
+                    ctx.dep_seen[da] = ctx.dep_epoch;
                     const int64_t ds64 =
                         static_cast<int64_t>(
                             std::max(ctx.dep_base[da], ctx.last_seq[da])) +
                         dp[1];
                     if (ds64 < 0 || ds64 > INT32_MAX) return 1;
-                    entries.push_back({da, static_cast<int32_t>(ds64)});
+                    if (static_cast<int32_t>(entries.size()) < dep_store_cap) {
+                        entries.push_back({da, static_cast<int32_t>(ds64)});
+                    } else {
+                        // over the storage cap: demote this doc off the
+                        // fast path (decode_frame handles the full set)
+                        o.ch_actor[nc] = -1;
+                    }
                     ctx.dep_base[da] = static_cast<int32_t>(ds64);
                 }
             }
@@ -546,12 +569,17 @@ int32_t pt_parse_changes(
     dep_off[0] = 0;
     ops_off[0] = 0;
     if (version >= 2) {
+        // declared-actor count: distinct positive ids in str2actor
+        int32_t n_declared = 0;
+        for (int32_t i = 0; i < n_strings; ++i) {
+            if (str2actor[i] > 0) ++n_declared;
+        }
         WireOut o{ch_actor, ch_seq, dep_off, dep_actor, dep_seq, dep_cap,
                   ops_off, ops, op_cap, cnt_ins, cnt_del, cnt_mark, cnt_map};
         int64_t nc = 0;
         const int32_t rc = walk_v2(vals, n_vals, n_changes, str2actor,
-                                   n_strings, actor_bits, max_ctr, 0,
-                                   o, nc, nd, no);
+                                   n_strings, n_declared, actor_bits, max_ctr,
+                                   0, o, nc, nd, no);
         return (rc == 1) ? -1 : rc;
     }
 
@@ -989,8 +1017,8 @@ int32_t pt_parse_frames(
                 const int32_t rc = walk_v2(
                     vals.data(), static_cast<int64_t>(h_ints),
                     static_cast<int32_t>(h_changes), s2a.data(),
-                    static_cast<int32_t>(h_strings), actor_bits, max_ctr,
-                    static_cast<int32_t>(ns), o, nc, nd, no);
+                    static_cast<int32_t>(h_strings), n_actors, actor_bits,
+                    max_ctr, static_cast<int32_t>(ns), o, nc, nd, no);
                 if (rc == -2) return -2;
                 if (rc == -3) return -3;
                 if (rc != 0) { corrupt = true; break; }

@@ -106,7 +106,7 @@ def _block_flat(resolved: ResolvedDocs, doc_mask=None):
         vis = vis & np.asarray(doc_mask)[:, None]
     rows, cols = np.nonzero(vis)
     if len(rows) == 0:
-        return rows, cols, rows, rows, "", None, None, None
+        return rows, cols, rows, rows, "", None, None, None, None
     chars = np.asarray(resolved.char)[rows, cols]
     lww = np.asarray(resolved.lww_active)[rows, :, cols]  # (N, T)
     link = np.asarray(resolved.link_attr)[rows, cols]
@@ -121,7 +121,7 @@ def _block_flat(resolved: ResolvedDocs, doc_mask=None):
     seg_starts = np.nonzero(boundary)[0]
     seg_ends = np.append(seg_starts[1:], len(rows))
     text = "".join(map(chr, chars.tolist()))
-    return rows, cols, seg_starts, seg_ends, text, lww, link, bits
+    return rows, cols, seg_starts, seg_ends, text, lww, link, bits, feat
 
 
 def _segment_marks(s: int, lww, link, bits, attrs: Interner,
@@ -152,14 +152,25 @@ def decode_block_spans(resolved: ResolvedDocs, attr_of, comment_of, doc_mask=Non
 
     ``attr_of(d)`` / ``comment_of(d)`` return the attr / comment-id interner
     for block-local doc d; ``doc_mask`` excludes (fallback/overflow) docs.
-    Returns a span list per doc (empty for docs with no visible text)."""
+    Returns a span list per doc (empty for docs with no visible text).
+
+    Marks dicts are MEMOIZED by (interner identities, feature bytes) and
+    SHARED between spans with identical formatting — a 100K-doc sweep has
+    millions of segments but only dozens of distinct mark combinations, so
+    the per-segment Python work collapses to a dict hit (treat the returned
+    spans as read-only, as block_char_states already documents)."""
     out = [[] for _ in range(np.asarray(resolved.visible).shape[0])]
-    rows, _, seg_starts, seg_ends, text, lww, link, bits = _block_flat(
+    rows, _, seg_starts, seg_ends, text, lww, link, bits, feat = _block_flat(
         resolved, doc_mask
     )
+    cache: dict = {}
     for s, e in zip(seg_starts.tolist(), seg_ends.tolist()):
         d = int(rows[s])
-        marks = _segment_marks(s, lww, link, bits, attr_of(d), comment_of(d))
+        attrs, comments = attr_of(d), comment_of(d)
+        key = (id(attrs), id(comments), feat[s].tobytes())
+        marks = cache.get(key)
+        if marks is None:
+            marks = cache[key] = _segment_marks(s, lww, link, bits, attrs, comments)
         out[d].append({"marks": marks, "text": text[s:e]})
     return out
 
@@ -174,7 +185,7 @@ def block_char_states(resolved: ResolvedDocs, elem_id_block, actor_table,
 
     vis = np.asarray(resolved.visible)
     out = [[] for _ in range(vis.shape[0])]
-    rows, cols, seg_starts, seg_ends, text, lww, link, bits = _block_flat(
+    rows, cols, seg_starts, seg_ends, text, lww, link, bits, feat = _block_flat(
         resolved, doc_mask
     )
     if len(rows) == 0:
@@ -183,9 +194,14 @@ def block_char_states(resolved: ResolvedDocs, elem_id_block, actor_table,
     ctrs = (packed >> ACTOR_BITS).tolist()
     actor_idx = (packed & MAX_ACTORS).tolist()
     actor_names = [actor_table.lookup(i) for i in range(len(actor_table))]
+    cache: dict = {}
     for s, e in zip(seg_starts.tolist(), seg_ends.tolist()):
         d = int(rows[s])
-        marks = _segment_marks(s, lww, link, bits, attr_of(d), comment_of(d))
+        attrs, comments = attr_of(d), comment_of(d)
+        key = (id(attrs), id(comments), feat[s].tobytes())
+        marks = cache.get(key)
+        if marks is None:
+            marks = cache[key] = _segment_marks(s, lww, link, bits, attrs, comments)
         bucket = out[d]
         for j in range(s, e):
             bucket.append(((ctrs[j], actor_names[actor_idx[j]]), text[j], marks))

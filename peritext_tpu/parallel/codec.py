@@ -625,6 +625,12 @@ def _decode_frame(data: bytes) -> List[Change]:
     r = _IntReader(values)
     changes: List[Change] = []
     ctx = _FrameCtx()
+    # Decode-size budget: DEPS_SAME/elided headers materialize dep entries
+    # from ZERO wire ints, so a sub-MB crafted frame could otherwise expand
+    # to multi-GB dep dicts.  Real sessions sit far below the budget (their
+    # dep sets are the collaboration's actor set).
+    dep_budget = max(10_000, 64 * n_changes + 4 * len(values))
+    deps_decoded = 0
     for _ in range(n_changes):
         if version >= 2:
             (combo,) = r.take()
@@ -666,8 +672,12 @@ def _decode_frame(data: bytes) -> List[Change]:
                     explicit = tuple(entries)
                 else:
                     explicit = []
+                    seen = set()
                     for _ in range(count):
                         da, dds = r.take(2)
+                        if da in seen:  # deps are a per-actor map: dups are crafted
+                            raise ValueError("duplicate dep actor in change header")
+                        seen.add(da)
                         base = max(ctx.dep_base.get(da, 0), ctx.last_seq.get(da, 0))
                         ds = base + dds
                         explicit.append((da, ds))
@@ -676,6 +686,9 @@ def _decode_frame(data: bytes) -> List[Change]:
                 ctx.dep_set[actor_idx] = (own_elided, explicit)
             if own_elided:
                 deps[actor] = seq - 1
+            deps_decoded += own_elided + len(explicit)
+            if deps_decoded > dep_budget:
+                raise ValueError("frame dep expansion exceeds decode budget")
             for da, ds in explicit:
                 deps[_string(strings, da)] = ds
             n_ops = 1 if hflags & _H_NOPS_ONE else r.take()[0]

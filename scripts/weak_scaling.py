@@ -95,9 +95,49 @@ def main() -> None:
         t0 = time.perf_counter()
         s = mk()
         s.ingest_frames(list(enumerate(frames)))
+        t_ingest = time.perf_counter() - t0
         s.drain()
+        # drain() only ENQUEUES the jitted apply programs (step() documents
+        # the async dispatch); without an explicit sync the apply compute
+        # would be mis-attributed to the digest stage below
+        np.asarray(s.state.num_slots)
+        t_drain = time.perf_counter() - t0 - t_ingest
         s.digest()
-        stream_s = time.perf_counter() - t0
+        t_digest = time.perf_counter() - t0 - t_ingest - t_drain
+        stream_s = t_ingest + t_drain + t_digest
+
+        # ---- sharding-overhead probe: SAME total work on every mesh size —
+        # with docs fixed, any slowdown vs mesh=1 is genuine sharding/
+        # collective overhead, while the weak-scaling totals above also
+        # absorb shared-CPU contention (all virtual devices share one chip)
+        fixed_docs = args.docs_per_device
+        fixed_w = generate_workload(args.seed ^ 0xF1, num_docs=fixed_docs,
+                                    ops_per_doc=args.ops_per_doc)
+        fixed_frames = [
+            encode_frame([ch for log in w.values() for ch in log])
+            for w in fixed_w
+        ]
+        fixed_ops = sum(
+            len(ch.ops) for w in fixed_w for log in w.values() for ch in log
+        )
+
+        def fixed_run():
+            fs = StreamingMerge(
+                num_docs=fixed_docs, actors=("doc1", "doc2", "doc3"), mesh=mesh,
+                slot_capacity=4 * args.ops_per_doc,
+                mark_capacity=2 * args.ops_per_doc,
+                tomb_capacity=2 * args.ops_per_doc,
+                round_insert_capacity=128, round_delete_capacity=64,
+                round_mark_capacity=64,
+            )
+            fs.ingest_frames(list(enumerate(fixed_frames)))
+            fs.drain()
+            fs.digest()
+
+        fixed_run()  # warm
+        t0 = time.perf_counter()
+        fixed_run()
+        fixed_s = time.perf_counter() - t0
 
         # shard-count sanity: the doc axis really spans all n devices
         n_shards = len(s.state.elem_id.sharding.device_set)
@@ -123,6 +163,13 @@ def main() -> None:
             "streaming_seconds": round(stream_s, 3),
             "streaming_ops_per_sec_total": round(total_ops / stream_s, 1),
             "streaming_ops_per_sec_per_device": round(total_ops / stream_s / n, 1),
+            "streaming_stage_seconds": {
+                "ingest_host": round(t_ingest, 3),
+                "schedule_apply": round(t_drain, 3),
+                "digest": round(t_digest, 3),
+            },
+            "fixed_work_seconds": round(fixed_s, 3),
+            "fixed_work_ops_per_sec": round(fixed_ops / fixed_s, 1),
             "probe_digest": digests[n],
         }))
 

@@ -341,3 +341,46 @@ class TestWireV2Efficiency:
         s.drain()
         assert "".join(sp["text"] for sp in s.read(0)) == "hello world"
         assert not s.docs[0].fallback
+
+    def test_dep_expansion_budget_rejects_crafted_blowup(self):
+        """A sub-MB crafted frame must not expand to unbounded dep dicts:
+        DEPS_SAME headers re-materialize the stored dep set from zero wire
+        ints, so both decoders bound the expansion (native demotes the doc
+        off the fast path at n_declared+64; the Python decoder enforces a
+        total decode budget)."""
+        import struct
+
+        import pytest
+
+        from peritext_tpu.parallel.codec import (
+            _HEADER, _MAGIC, _py_varint_encode, decode_frame,
+        )
+
+        n_actors = 200
+        strings = [f"actor-{i:03d}" for i in range(n_actors)]
+        ints = []
+        # change 1 (combo: actor 0, no flags): dseq=0, dstart=0, then a FULL
+        # dep set naming every actor (establishes the stored dep_set), one
+        # makeList op (kind 5 + REF_HEAD, opid/obj elided): [first, key=0]
+        ints += [0 << 4, 0, 0, (n_actors << 2) | 0]
+        for i in range(n_actors):
+            ints += [i, 1]
+        # first op carries an explicit ROOT obj (no previous op to elide to)
+        ints += [1, 5 | ((1 | 8) << 3), 0, 0, 0, 0]
+        # thousands of fully-elided single-op changes with DEPS_SAME: 3 ints
+        # each, each re-materializing the 200-entry dep set at decode time
+        n_spam = 5000
+        for _ in range(n_spam):
+            ints += [(0 << 4) | (1 | 2 | 4 | 8), 5 | ((1 | 2 | 8) << 3), 0]
+        payload = _py_varint_encode(ints)
+        parts = [_HEADER.pack(_MAGIC, 2, 1 + n_spam, len(strings), len(ints),
+                              len(payload))]
+        for s in strings:
+            raw = s.encode()
+            parts.append(_py_varint_encode([len(raw)]))
+            parts.append(raw)
+        parts.append(payload)
+        frame = b"".join(parts)
+        assert len(frame) < 100_000  # small wire...
+        with pytest.raises(ValueError, match="decode budget"):
+            decode_frame(frame)  # ...must NOT decode to ~1M dep entries
