@@ -538,19 +538,24 @@ def main(argv: Optional[List[str]] = None) -> None:
         parser.error("--crash-restore is its own campaign; combine with "
                      "--mesh/--docs/--ops-per-doc only")
 
+    # Honor JAX_PLATFORMS at config level for EVERY campaign (not just
+    # --mesh): a TPU plugin registered at interpreter start pins
+    # jax_platforms at config level, overriding the env var — with the
+    # tunnel down, the differential campaigns would otherwise die (or hang)
+    # initializing a backend the caller explicitly routed away from.
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     mesh = None
     if args.mesh:
-        import os
-
         import jax
 
         from ..parallel.mesh import make_mesh
 
-        # honor JAX_PLATFORMS at config level too: a TPU plugin that pins
-        # jax_platforms would otherwise override the env var and hand back
-        # its single real chip instead of the N virtual CPU devices
-        if os.environ.get("JAX_PLATFORMS"):
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
         if len(jax.devices()) < args.mesh:
             raise SystemExit(
                 f"--mesh {args.mesh} needs {args.mesh} devices but only "

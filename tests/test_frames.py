@@ -313,17 +313,10 @@ def test_out_of_range_codepoint_rejected_at_ingest(workloads):
 
 
 def _craft_frame(strings, ints, n_changes):
-    """Hand-build a wire frame (codec layout) from a raw int payload."""
-    from peritext_tpu.parallel.codec import _HEADER, _MAGIC, _py_varint_encode
+    """Hand-build a v1 wire frame (shared framing lives in tests/wire.py)."""
+    from wire import craft_frame
 
-    payload = _py_varint_encode(ints)
-    parts = [_HEADER.pack(_MAGIC, 1, n_changes, len(strings), len(ints), len(payload))]
-    for s in strings:
-        raw = s if isinstance(s, bytes) else s.encode("utf-8")
-        parts.append(_py_varint_encode([len(raw)]))
-        parts.append(raw)
-    parts.append(payload)
-    return b"".join(parts)
+    return craft_frame(strings, ints, n_changes, version=1)
 
 
 @pytest.mark.skipif(not native.available(), reason="needs native core")

@@ -348,13 +348,10 @@ class TestWireV2Efficiency:
         ints, so both decoders bound the expansion (native demotes the doc
         off the fast path at n_declared+64; the Python decoder enforces a
         total decode budget)."""
-        import struct
-
         import pytest
+        from wire import craft_frame
 
-        from peritext_tpu.parallel.codec import (
-            _HEADER, _MAGIC, _py_varint_encode, decode_frame,
-        )
+        from peritext_tpu.parallel.codec import decode_frame
 
         n_actors = 200
         strings = [f"actor-{i:03d}" for i in range(n_actors)]
@@ -372,15 +369,58 @@ class TestWireV2Efficiency:
         n_spam = 5000
         for _ in range(n_spam):
             ints += [(0 << 4) | (1 | 2 | 4 | 8), 5 | ((1 | 2 | 8) << 3), 0]
-        payload = _py_varint_encode(ints)
-        parts = [_HEADER.pack(_MAGIC, 2, 1 + n_spam, len(strings), len(ints),
-                              len(payload))]
-        for s in strings:
-            raw = s.encode()
-            parts.append(_py_varint_encode([len(raw)]))
-            parts.append(raw)
-        parts.append(payload)
-        frame = b"".join(parts)
+        frame = craft_frame(strings, ints, 1 + n_spam, version=2)
         assert len(frame) < 100_000  # small wire...
         with pytest.raises(ValueError, match="decode budget"):
             decode_frame(frame)  # ...must NOT decode to ~1M dep entries
+
+    def test_wire_v1_frames_still_ingest(self):
+        """v1 frames (old checkpoints, old peers) must keep decoding and
+        taking the native fast path: the reader negotiates the version per
+        frame.  The inline v1 writer below emits every op as a JSON-spill
+        row — the simplest valid v1 layout (kind _OP_JSON + string id)."""
+        from wire import craft_frame
+
+        from peritext_tpu.api.batch import _oracle_doc
+        from peritext_tpu.parallel.codec import _OP_JSON, decode_frame, encode_frame
+        from peritext_tpu.parallel.streaming import StreamingMerge
+        from peritext_tpu.testing.fuzz import generate_workload
+
+        (wl,) = generate_workload(seed=31, num_docs=1, ops_per_doc=80)
+        chs = [ch for log in wl.values() for ch in log]
+
+        # v1 writer: the pre-delta layout (explicit obj/opid/ref per op)
+        def v1_encode(changes):
+            table = {}
+            strings = []
+
+            def intern(s):
+                if s not in table:
+                    table[s] = len(strings)
+                    strings.append(s)
+                return table[s]
+
+            ints = []
+            for c in changes:
+                ints += [intern(c.actor), c.seq, c.start_op]
+                deps = sorted((c.deps or {}).items())
+                ints.append(len(deps))
+                for a, s in deps:
+                    ints += [intern(a), s]
+                ints.append(len(c.ops))
+                for op in c.ops:
+                    ints += [_OP_JSON, intern(json.dumps(op.to_json()))]
+            return craft_frame(strings, ints, len(changes), version=1)
+
+        v1_frame = v1_encode(chs)
+        assert decode_frame(v1_frame) == chs  # reader accepts v1
+
+        expected = _oracle_doc(wl).get_text_with_formatting(["text"])
+        for frame in (v1_frame, encode_frame(chs)):
+            s = StreamingMerge(num_docs=1, actors=("doc1", "doc2", "doc3"),
+                               slot_capacity=512, mark_capacity=128,
+                               tomb_capacity=256, round_insert_capacity=128,
+                               round_delete_capacity=64, round_mark_capacity=64)
+            s.ingest_frames([(0, frame)])
+            s.drain()
+            assert s.read(0) == expected

@@ -1,0 +1,22 @@
+"""Shared test helper: hand-assemble wire frames from raw parts.
+
+One copy of the frame framing (header struct + varint-length string table +
+zigzag-varint payload) for every crafted-frame test; the per-test int
+payloads stay inline where the scenario lives."""
+
+from peritext_tpu.parallel.codec import _HEADER, _MAGIC, _py_varint_encode
+
+
+def craft_frame(strings, ints, n_changes, version=1) -> bytes:
+    """Build a wire frame (codec layout) from raw strings + int payload."""
+    payload = _py_varint_encode(ints)
+    parts = [
+        _HEADER.pack(_MAGIC, version, n_changes, len(strings), len(ints),
+                     len(payload))
+    ]
+    for s in strings:
+        raw = s if isinstance(s, bytes) else s.encode("utf-8")
+        parts.append(_py_varint_encode([len(raw)]))
+        parts.append(raw)
+    parts.append(payload)
+    return b"".join(parts)
