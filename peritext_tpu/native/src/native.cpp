@@ -86,6 +86,8 @@ int32_t walk_v2(const int32_t* vals, int64_t n_vals, int32_t n_changes,
                 int32_t actor_bits, int32_t max_ctr, int32_t str_base,
                 WireOut& o, int64_t& nc, int64_t& nd, int64_t& no) {
     WireV2Ctx ctx(n_strings);
+    const int64_t nd0 = nd;  // bulk parses share nd across frames: budget
+                             // must meter THIS frame's emission only
     int64_t p = 0;
     auto take = [&](int64_t k) -> const int32_t* {
         if (p + k > n_vals) return nullptr;
@@ -205,24 +207,40 @@ int32_t walk_v2(const int32_t* vals, int64_t n_vals, int32_t n_changes,
             ctx.own_elided[strid] = static_cast<uint8_t>(own);
             ctx.has_dep_set[strid] = 1;
         }
-        if (own) {
-            if (a < 0) {
-                o.ch_actor[nc] = -1;  // dep on undeclared (own) actor
-            } else {
+        // Total-emission budget (review finding r3 medium): every change
+        // re-emits its stored dep set, so a frame of tiny DEPS_SAME headers
+        // otherwise forces ~(n_declared+64) output entries per ~1 payload
+        // int, which the host's capacity doubling obligingly allocates.
+        // Over-budget changes are DEMOTED (ch_actor = -1), not rejected —
+        // huge-actor sessions are valid data and the object path decodes
+        // them in shared O(1)-per-change memory.
+        const int64_t dep_emit_budget =
+            std::min<int64_t>(64 * n_vals + 4096, 16000000);
+        const auto& emit_set = ctx.dep_set[strid];
+        const int64_t need =
+            (own ? 1 : 0) + static_cast<int64_t>(emit_set.size());
+        if ((nd - nd0) + need > dep_emit_budget) {
+            o.ch_actor[nc] = -1;
+        } else {
+            if (own) {
+                if (a < 0) {
+                    o.ch_actor[nc] = -1;  // dep on undeclared (own) actor
+                } else {
+                    if (nd >= o.dep_cap) return -2;
+                    o.dep_actor[nd] = a;
+                    o.dep_seq[nd] = seq - 1;
+                    ++nd;
+                }
+            }
+            for (const auto& e : emit_set) {
+                const int32_t da = actor_of(e.first);
+                if (da == -2) return 1;
+                if (da < 0) { o.ch_actor[nc] = -1; continue; }
                 if (nd >= o.dep_cap) return -2;
-                o.dep_actor[nd] = a;
-                o.dep_seq[nd] = seq - 1;
+                o.dep_actor[nd] = da;
+                o.dep_seq[nd] = e.second;
                 ++nd;
             }
-        }
-        for (const auto& e : ctx.dep_set[strid]) {
-            const int32_t da = actor_of(e.first);
-            if (da == -2) return 1;
-            if (da < 0) { o.ch_actor[nc] = -1; continue; }
-            if (nd >= o.dep_cap) return -2;
-            o.dep_actor[nd] = da;
-            o.dep_seq[nd] = e.second;
-            ++nd;
         }
         o.dep_off[nc + 1] = static_cast<int32_t>(nd);
 

@@ -140,6 +140,17 @@ def _segment_marks(s: int, lww, link, bits, attrs: Interner,
     return marks
 
 
+def _copy_marks(marks: dict) -> dict:
+    """Copy a memoized marks dict ALL the way down (values are tiny:
+    ``{"active": True}``, a link dict, a comment-id list) so a caller
+    mutating one span's marks — including nested values — cannot reformat
+    unrelated spans sharing the memo entry (ADVICE r3)."""
+    return {
+        k: [dict(e) for e in v] if isinstance(v, list) else dict(v)
+        for k, v in marks.items()
+    }
+
+
 def decode_block_spans(resolved: ResolvedDocs, attr_of, comment_of, doc_mask=None):
     """Vectorized span decode of a WHOLE resolved block in one pass.
 
@@ -154,11 +165,11 @@ def decode_block_spans(resolved: ResolvedDocs, attr_of, comment_of, doc_mask=Non
     for block-local doc d; ``doc_mask`` excludes (fallback/overflow) docs.
     Returns a span list per doc (empty for docs with no visible text).
 
-    Marks dicts are MEMOIZED by (interner identities, feature bytes) and
-    SHARED between spans with identical formatting — a 100K-doc sweep has
-    millions of segments but only dozens of distinct mark combinations, so
-    the per-segment Python work collapses to a dict hit (treat the returned
-    spans as read-only, as block_char_states already documents)."""
+    Marks dicts are MEMOIZED by (interner identities, feature bytes) — a
+    100K-doc sweep has millions of segments but only dozens of distinct mark
+    combinations, so the per-segment ``_segment_marks`` work collapses to a
+    dict hit.  Each span still gets its OWN tiny copy: a caller mutating one
+    span's marks must not silently reformat unrelated spans (ADVICE r3)."""
     out = [[] for _ in range(np.asarray(resolved.visible).shape[0])]
     rows, _, seg_starts, seg_ends, text, lww, link, bits, feat = _block_flat(
         resolved, doc_mask
@@ -171,7 +182,7 @@ def decode_block_spans(resolved: ResolvedDocs, attr_of, comment_of, doc_mask=Non
         marks = cache.get(key)
         if marks is None:
             marks = cache[key] = _segment_marks(s, lww, link, bits, attrs, comments)
-        out[d].append({"marks": marks, "text": text[s:e]})
+        out[d].append({"marks": _copy_marks(marks), "text": text[s:e]})
     return out
 
 
@@ -202,9 +213,13 @@ def block_char_states(resolved: ResolvedDocs, elem_id_block, actor_table,
         marks = cache.get(key)
         if marks is None:
             marks = cache[key] = _segment_marks(s, lww, link, bits, attrs, comments)
+        # chars within a segment share ONE per-segment copy (adjacent
+        # equality stays O(1) identity); the memoized master never escapes,
+        # so mutating one segment's marks can't reformat another (ADVICE r3)
+        seg_marks = _copy_marks(marks)
         bucket = out[d]
         for j in range(s, e):
-            bucket.append(((ctrs[j], actor_names[actor_idx[j]]), text[j], marks))
+            bucket.append(((ctrs[j], actor_names[actor_idx[j]]), text[j], seg_marks))
     return out
 
 

@@ -43,13 +43,26 @@ from typing import Callable, List, Optional, Tuple
 from ..core.errors import PeritextError
 from ..core.types import Change, Clock
 from .anti_entropy import ChangeStore
-from .codec import decode_frame, encode_frame
+from .codec import (
+    WireSession,
+    decode_frame,
+    encode_frame,
+    encode_frame_chunks,
+    iter_frames,
+)
 
 _LEN = struct.Struct(">I")
 _MAX_MESSAGE = 1 << 28  # 256 MiB: far above any sane frame, guards corrupt peers
 
 MSG_FRONTIER = b"F"
 MSG_CHANGES = b"C"
+#: multi-frame change payload (concatenated encode_frame_chunks output).
+#: A DISTINCT kind, not MSG_CHANGES with trailing frames: a pre-chunking
+#: peer's decoder read one frame and silently IGNORED trailing bytes, so
+#: reusing "C" would truncate large backlogs against old peers without any
+#: error.  Old peers reject the unknown kind loudly (sync aborts, store
+#: untouched); small backlogs still ride "C" for full compatibility.
+MSG_CHANGES_MULTI = b"M"
 
 
 # -- framing ----------------------------------------------------------------
@@ -102,6 +115,47 @@ def _expect(sock: socket.socket, expected: bytes) -> bytes:
     if kind != expected:
         raise ConnectionError(f"expected message {expected!r}, got {kind!r}")
     return body
+
+
+def _send_changes(sock: socket.socket, changes: List[Change]) -> None:
+    """One MSG_CHANGES frame when the backlog fits a single frame's decode
+    budget (the overwhelmingly common case, wire-identical to old peers),
+    else MSG_CHANGES_MULTI: session-scoped (v4) chunks sharing one string
+    dictionary + deflate — the string table and repeated attrs are paid once
+    per backlog, not once per chunk."""
+    from .codec import _ENCODE_CHUNK_CHARGE
+
+    if sum(1 + len(c.deps or {}) for c in changes) <= _ENCODE_CHUNK_CHARGE:
+        _send_message(sock, MSG_CHANGES, encode_frame(changes))
+        return
+    chunks = encode_frame_chunks(changes, session=WireSession(compress=True))
+    _send_message(sock, MSG_CHANGES_MULTI, b"".join(chunks))
+
+
+def _recv_changes(
+    sock: socket.socket, want_frames: bool = True,
+) -> Tuple[List[Change], List[bytes]]:
+    """Receive either changes kind; returns (changes, self-contained frames
+    for ``on_frame`` consumers — MULTI chunks are normalized to v2 so a
+    consumer can store or re-ingest each frame independently).  Pass
+    ``want_frames=False`` when no on_frame consumer exists: normalization
+    is a full re-encode of the backlog, wasted on discarded output."""
+    kind, body = _recv_message(sock)
+    if kind == MSG_CHANGES:
+        return decode_frame(body), [body] if want_frames else []
+    if kind == MSG_CHANGES_MULTI:
+        sess = WireSession()
+        changes: List[Change] = []
+        frames: List[bytes] = []
+        for raw in iter_frames(body):
+            if want_frames:
+                part, v2 = sess.decode_frame_normalized(raw)
+                frames.append(v2)
+            else:
+                part = sess.decode_frame(raw)
+            changes.extend(part)
+        return changes, frames
+    raise ConnectionError(f"expected changes message, got {kind!r}")
 
 
 # -- store merge ------------------------------------------------------------
@@ -204,10 +258,13 @@ class ReplicaServer:
                 with self._lock:
                     my_clock = self.store.clock()
                     outbound = self.store.missing_changes(my_clock, peer_clock)
-                _send_message(conn, MSG_CHANGES, encode_frame(outbound))
+                # chunked: a large backlog splits into multiple frames so no
+                # single frame approaches the peer's decode dep budget
+                _send_changes(conn, outbound)
                 _send_frontier(conn, my_clock)
-                frame = _expect(conn, MSG_CHANGES)
-                inbound = decode_frame(frame)
+                inbound, frames = _recv_changes(
+                    conn, want_frames=self.on_frame is not None
+                )
                 with self._lock:
                     fresh = merge_changes(self.store, inbound)
                 if fresh:
@@ -215,7 +272,8 @@ class ReplicaServer:
                     # account via on_changes must never observe the count
                     # ahead of the ingestion
                     if self.on_frame is not None:
-                        self.on_frame(frame)
+                        for one in frames:
+                            self.on_frame(one)
                     if self.on_changes is not None:
                         self.on_changes(fresh)
         except (ConnectionError, ValueError, OSError, PeritextError):
@@ -249,17 +307,17 @@ def sync_with(
         with lock:
             my_clock = store.clock()
         _send_frontier(sock, my_clock)
-        frame = _expect(sock, MSG_CHANGES)
-        inbound = decode_frame(frame)
+        inbound, frames = _recv_changes(sock, want_frames=on_frame is not None)
         peer_clock = _parse_frontier(_expect(sock, MSG_FRONTIER))
         with lock:
             outbound = store.missing_changes(store.clock(), peer_clock)
-        _send_message(sock, MSG_CHANGES, encode_frame(outbound))
+        _send_changes(sock, outbound)
     with lock:
         fresh = merge_changes(store, inbound)
     if fresh:
         if on_frame is not None:  # before on_changes; see ReplicaServer
-            on_frame(frame)
+            for one in frames:
+                on_frame(one)
         if on_changes is not None:
             on_changes(fresh)
     return len(fresh), len(outbound)

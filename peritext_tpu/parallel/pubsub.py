@@ -19,12 +19,12 @@ class Publisher(Generic[T]):
 
     def subscribe(self, key: str, callback: Callable[[T], None]) -> None:
         if key in self._subscribers:
-            raise ValueError(f"Subscriber already exists: {key}")
+            raise ValueError(f"duplicate subscription key {key!r}")
         self._subscribers[key] = callback
 
     def unsubscribe(self, key: str) -> None:
         if key not in self._subscribers:
-            raise ValueError(f"Subscriber not found: {key}")
+            raise ValueError(f"no subscription under key {key!r}")
         del self._subscribers[key]
 
     def publish(self, sender: str, update: T) -> None:

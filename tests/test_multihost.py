@@ -177,3 +177,43 @@ def test_on_frame_hook_feeds_device_session(workload):
         server.stop()
     assert dev.docs[0].frame_mode and not dev.docs[0].fallback
     assert dev.read(0) == _oracle_doc(workload).get_text_with_formatting(["text"])
+
+
+def test_large_backlog_syncs_chunked_via_multi_frame_message(monkeypatch):
+    """A many-actor backlog whose dep charge would approach the decode
+    ceiling ships as MSG_CHANGES_MULTI (multiple concatenated frames), each
+    chunk an independently valid frame — never one giant frame the peer's
+    own decoder must reject (review r4).  Small backlogs keep the
+    wire-identical single MSG_CHANGES."""
+    from peritext_tpu.core.opids import ROOT
+    from peritext_tpu.core.types import Change, Operation
+    from peritext_tpu.parallel import codec
+
+    monkeypatch.setattr(codec, "_ENCODE_CHUNK_CHARGE", 500)
+    actors = [f"peer-{i:03d}" for i in range(60)]
+    clock = {a: 1 for a in actors}
+    a_store = ChangeStore()
+    for k in range(1, 301):
+        clock = dict(clock)
+        clock[f"peer-{k % 60:03d}"] = k  # drifting clock: no DEPS_SAME runs
+        deps = dict(clock)
+        deps["writer"] = k - 1
+        a_store.append(Change(
+            actor="writer", seq=k, deps=deps, start_op=k,
+            ops=[Operation(action="set", obj=ROOT, opid=(k, "writer"),
+                           key="m", value=k)],
+        ))
+    b_store = ChangeStore()
+    frames = []
+    server = ReplicaServer(a_store)
+    host, port = server.start()
+    try:
+        pulled, _ = sync_with(b_store, host, port, on_frame=frames.append)
+    finally:
+        server.stop()
+    assert pulled == 300
+    assert b_store.clock() == a_store.clock()
+    assert b_store.log("writer")[-1].deps == a_store.log("writer")[-1].deps
+    assert len(frames) > 1  # chunked delivery, fanned out per frame
+    for f in frames:
+        codec.decode_frame(f)
