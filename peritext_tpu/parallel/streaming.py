@@ -259,6 +259,31 @@ class StreamingMerge:
         self._patch_base: Dict[int, list] = {}
         # per-round cache of numpy-resolved doc blocks: (rounds, {bi: resolved})
         self._resolved_cache = (-1, {})
+        # Incremental convergence digest (VERDICT r3 task 2): per-block
+        # digest scalars CARRIED across rounds.  A round marks dirty only
+        # the blocks whose docs it actually applied ops to, so a per-round
+        # digest sync re-resolves work proportional to TOUCHED docs — not
+        # the whole session (the r3 weak-scaling tables showed the digest
+        # stage growing linearly with total docs at fixed round size).
+        # Safety: carried entries are keyed to the block's fallback mask
+        # (any demotion invalidates on comparison) and per-doc digests are
+        # invariant under interner growth (digest tables are gathered by
+        # ids present in the doc's own rows).  digest(refresh=True) is the
+        # full-recompute verification path.
+        self._carried_digest: Dict[int, tuple] = {}
+        self._digest_dirty: set = set()
+        # Physical placement indirection (SURVEY §5.8(c) re-sharding):
+        # logical doc d lives in device row _row_of[d]; _doc_at is the
+        # inverse (-1 = empty/pad row).  Identity until reshard() moves
+        # rows; every device-facing site maps through it, every host
+        # structure stays logical-doc-indexed.
+        self._row_of = np.arange(num_docs, dtype=np.int64)
+        self._doc_at = np.full(self._padded_docs, -1, np.int64)
+        self._doc_at[:num_docs] = np.arange(num_docs)
+        #: bumped by reshard(): in-flight async digests must neither write
+        #: their pre-reshard scalars back into the carry nor map their
+        #: schedule-time rows through the new placement
+        self._placement_epoch = 0
         self._actor_table = OrderedActorTable(self.actors)
         # frame-native session state (bulk path, ops/frames.parse_frames_bulk):
         # parsed-but-unscheduled changes pool as (doc_of_change, ParsedChanges)
@@ -529,26 +554,27 @@ class StreamingMerge:
 
         enc = _RoundBuffers(self._padded_docs, ki, kd, km, kp)
         for i, streams in obj_streams.items():
+            r = int(self._row_of[i])  # device staging rows are PHYSICAL
             if streams.ins:
                 arr = np.asarray(streams.ins, np.int32)
-                enc.ins_ref[i, : len(arr)] = arr[:, 0]
-                enc.ins_op[i, : len(arr)] = arr[:, 1]
-                enc.ins_char[i, : len(arr)] = arr[:, 2]
+                enc.ins_ref[r, : len(arr)] = arr[:, 0]
+                enc.ins_op[r, : len(arr)] = arr[:, 1]
+                enc.ins_char[r, : len(arr)] = arr[:, 2]
             if streams.dels:
-                enc.del_target[i, : len(streams.dels)] = streams.dels
+                enc.del_target[r, : len(streams.dels)] = streams.dels
             if streams.marks:
                 arr = np.asarray(streams.marks, np.int32)
                 for c, col in enumerate(MARK_COLS):
-                    enc.marks[col][i, : len(arr)] = arr[:, c]
-                enc.mark_count[i] = len(arr)
+                    enc.marks[col][r, : len(arr)] = arr[:, c]
+                enc.mark_count[r] = len(arr)
             if streams.maps:
                 arr = np.asarray(streams.maps, np.int32)
                 for c, col in enumerate(MAP_STREAM_COLS):
-                    enc.map_ops[col][i, : len(arr)] = arr[:, c]
-                enc.map_count[i] = len(arr)
-            enc.ins_count[i] = len(streams.ins)
-            enc.del_count[i] = len(streams.dels)
-            enc.num_ops[i] = (
+                    enc.map_ops[col][r, : len(arr)] = arr[:, c]
+                enc.map_count[r] = len(arr)
+            enc.ins_count[r] = len(streams.ins)
+            enc.del_count[r] = len(streams.dels)
+            enc.num_ops[r] = (
                 len(streams.ins) + len(streams.dels)
                 + len(streams.marks) + len(streams.maps)
             )
@@ -570,6 +596,11 @@ class StreamingMerge:
             # single-device path: ship flat streams proportional to real ops
             # and rebuild the padded layout on device (kernel._pad_from_flat)
             self.state = self._apply_compact(enc, (ki, kd, km, kp))
+        # incremental digest bookkeeping: only blocks holding rows this
+        # round wrote need their carried digest recomputed
+        self._digest_dirty.update(
+            int(b) for b in np.unique(np.nonzero(enc.num_ops)[0] // self._read_chunk)
+        )
         self.rounds += 1
         GLOBAL_COUNTERS.add("streaming.rounds")
         GLOBAL_COUNTERS.add("streaming.scheduled_changes", scheduled)
@@ -669,6 +700,7 @@ class StreamingMerge:
             return self._step_frame_docs_python(pool, enc, caps)
 
         frame_docs = np.unique(doc_of)
+        frame_rows = self._row_of[frame_docs]  # device staging is physical
         ch_off = np.concatenate(
             [np.searchsorted(doc_of, frame_docs), [len(doc_of)]]
         ).astype(np.int32)
@@ -680,7 +712,7 @@ class StreamingMerge:
         batch = native.schedule_split_batch(
             len(self._actor_table),
             ch_off,
-            frame_docs.astype(np.int32),
+            frame_rows.astype(np.int32),
             text_obj,
             (parsed.ch_actor, parsed.ch_seq, parsed.dep_off,
              parsed.dep_actor, parsed.dep_seq, parsed.ops_off, parsed.ops),
@@ -696,23 +728,24 @@ class StreamingMerge:
 
         _, n_ins, n_del, n_mark, n_map, n_admitted, admitted, status = batch
         self._clock_mat[frame_docs] = clock
-        enc.mark_count[frame_docs] = n_mark
-        enc.map_count[frame_docs] = n_map
-        enc.num_ops[frame_docs] = n_ins + n_del + n_mark + n_map
+        enc.mark_count[frame_rows] = n_mark
+        enc.map_count[frame_rows] = n_map
+        enc.num_ops[frame_rows] = n_ins + n_del + n_mark + n_map
         scheduled = int(n_admitted.sum())
 
-        enc.ins_count[frame_docs] = n_ins
-        enc.del_count[frame_docs] = n_del
+        enc.ins_count[frame_rows] = n_ins
+        enc.del_count[frame_rows] = n_del
 
         demoted_docs = frame_docs[status != 0] if status.any() else None
         if demoted_docs is not None:
             for i in demoted_docs:  # rare: demote (rows zeroed natively)
                 i = int(i)
-                enc.ins_count[i] = 0
-                enc.del_count[i] = 0
-                enc.mark_count[i] = 0
-                enc.map_count[i] = 0
-                enc.num_ops[i] = 0
+                r = int(self._row_of[i])
+                enc.ins_count[r] = 0
+                enc.del_count[r] = 0
+                enc.mark_count[r] = 0
+                enc.map_count[r] = 0
+                enc.num_ops[r] = 0
                 self._demote_frame_doc(i)  # folds + zeroes the doc's clock row
 
         defer = admitted == 0
@@ -734,6 +767,7 @@ class StreamingMerge:
         )
         for j, i in enumerate(frame_docs):
             i = int(i)
+            r = int(self._row_of[i])  # device staging rows are PHYSICAL
             sess = self.docs[i]
             doc_parsed = parsed.select(
                 np.arange(bounds[j], bounds[j + 1], dtype=np.int64)
@@ -744,32 +778,32 @@ class StreamingMerge:
                     self._clock_mat[i],  # row view: advanced in place
                     sess.text_obj,
                     (ki, kd, km, kp),
-                    (enc.ins_ref[i], enc.ins_op[i], enc.ins_char[i]),
-                    enc.del_target[i],
-                    {col: enc.marks[col][i] for col in enc.marks},
-                    {col: enc.map_ops[col][i] for col in enc.map_ops},
+                    (enc.ins_ref[r], enc.ins_op[r], enc.ins_char[r]),
+                    enc.del_target[r],
+                    {col: enc.marks[col][r] for col in enc.marks},
+                    {col: enc.map_ops[col][r] for col in enc.map_ops},
                     len(self._actor_table),
                 )
             except FrameIngestError:
                 for col in enc.marks:  # discard any partial row writes
-                    enc.marks[col][i] = 0
+                    enc.marks[col][r] = 0
                 for col in enc.map_ops:
-                    enc.map_ops[col][i] = 0
-                enc.ins_ref[i] = 0
-                enc.ins_op[i] = 0
-                enc.ins_char[i] = 0
-                enc.del_target[i] = 0
+                    enc.map_ops[col][r] = 0
+                enc.ins_ref[r] = 0
+                enc.ins_op[r] = 0
+                enc.ins_char[r] = 0
+                enc.del_target[r] = 0
                 self._demote_frame_doc(i)
                 continue
             if deferred.num_changes:
                 self._pool.append(
                     (np.full(deferred.num_changes, i, np.int64), deferred)
                 )
-            enc.ins_count[i] = ni
-            enc.del_count[i] = nd
-            enc.mark_count[i] = nm
-            enc.map_count[i] = np_
-            enc.num_ops[i] = ni + nd + nm + np_
+            enc.ins_count[r] = ni
+            enc.del_count[r] = nd
+            enc.mark_count[r] = nm
+            enc.map_count[r] = np_
+            enc.num_ops[r] = ni + nd + nm + np_
             scheduled += nch
         return scheduled
 
@@ -853,14 +887,13 @@ class StreamingMerge:
         return PackedDocs(*(x[lo:hi] for x in self.state))
 
     def _block_fallback_mask(self, block_index: int) -> np.ndarray:
-        """(block,) bool: docs currently served by the device (not fallback)."""
+        """(block,) bool: rows currently served by the device (a real doc's
+        row, and that doc not fallback)."""
         lo, hi = self._block_bounds(block_index)
         on_device = np.zeros(hi - lo, bool)
-        upper = min(hi, self.num_docs)
-        if upper > lo:
-            on_device[: upper - lo] = [
-                not self.docs[d].fallback for d in range(lo, upper)
-            ]
+        for local, d in enumerate(self._doc_at[lo:hi]):
+            if d >= 0:
+                on_device[local] = not self.docs[d].fallback
         return on_device
 
     def _resolution(self, block_index: int) -> _BlockResolution:
@@ -910,8 +943,9 @@ class StreamingMerge:
 
     def _resolved_doc(self, doc_index: int):
         """(resolved block, index of the doc within it)."""
-        bi = doc_index // self._read_chunk
-        return self._resolved_block(bi), doc_index - bi * self._read_chunk
+        row = int(self._row_of[doc_index])
+        bi = row // self._read_chunk
+        return self._resolved_block(bi), row - bi * self._read_chunk
 
     def read(self, doc_index: int) -> List[FormatSpan]:
         sess = self.docs[doc_index]
@@ -952,7 +986,7 @@ class StreamingMerge:
             resolved,
             local,
             attrs,
-            np.asarray(self.state.elem_id[doc_index]),
+            np.asarray(self.state.elem_id[int(self._row_of[doc_index])]),
             self._actor_table,
             comments,
         )
@@ -982,9 +1016,10 @@ class StreamingMerge:
             if self.docs[d].fallback:
                 replay_docs.append(d)
                 continue
-            bi = d // self._read_chunk
+            row = int(self._row_of[d])
+            bi = row // self._read_chunk
             # overflow routing needs only the (D,) vector, not the planes
-            if bool(self._resolution(bi).overflow[d - bi * self._read_chunk]):
+            if bool(self._resolution(bi).overflow[row - bi * self._read_chunk]):
                 replay_docs.append(d)
             else:
                 device_map[d] = cursors
@@ -992,10 +1027,12 @@ class StreamingMerge:
         out: Dict[int, List[int]] = {}
         by_block: Dict[int, Dict[int, list]] = {}
         for d, cursors in device_map.items():
-            by_block.setdefault(d // self._read_chunk, {})[d] = cursors
+            by_block.setdefault(int(self._row_of[d]) // self._read_chunk, {})[d] = cursors
         for bi, block_map in by_block.items():
             lo, hi = self._block_bounds(bi)
-            local_map = {d - lo: c for d, c in block_map.items()}
+            local_map = {
+                int(self._row_of[d]) - lo: c for d, c in block_map.items()
+            }
             cursor_elem = pack_cursor_rows(
                 local_map, hi - lo, lambda d: self._actor_table
             )
@@ -1006,7 +1043,8 @@ class StreamingMerge:
                 )
             )
             for d, cursors in block_map.items():
-                out[d] = [int(p) for p in positions[d - lo, : len(cursors)]]
+                row = int(self._row_of[d])
+                out[d] = [int(p) for p in positions[row - lo, : len(cursors)]]
         for d in replay_docs:
             doc = _replay_doc(self._replay_changes(self.docs[d]))
             out[d] = oracle_cursor_positions(doc, cursor_map[d])
@@ -1038,23 +1076,23 @@ class StreamingMerge:
         return decode_doc_root(block_state, resolved, doc_index - lo, keys)
 
     def _block_tables(self, lo: int):
-        """(attr_of, comment_of) accessors for block-local doc indices."""
+        """(attr_of, comment_of) accessors for block-local ROW indices."""
         def attr_of(local: int):
-            return self._attr_tables(self.docs[lo + local], lo + local)[0]
+            d = int(self._doc_at[lo + local])
+            return self._attr_tables(self.docs[d], d)[0]
 
         def comment_of(local: int):
-            table = self._attr_tables(self.docs[lo + local], lo + local)[1]
+            d = int(self._doc_at[lo + local])
+            table = self._attr_tables(self.docs[d], d)[1]
             return table if table is not None else Interner()
 
         return attr_of, comment_of
 
     def _block_device_mask(self, resolved, lo: int, hi: int) -> np.ndarray:
-        """Docs of a block served from device state (not fallback/overflow)."""
-        mask = np.zeros(hi - lo, bool)
-        top = min(hi, self.num_docs)
-        if top > lo:
-            mask[: top - lo] = [not s.fallback for s in self.docs[lo:top]]
-        return mask & ~np.asarray(resolved.overflow)[: hi - lo]
+        """Rows of a block served from device state (not fallback/overflow)."""
+        return self._block_fallback_mask(
+            lo // self._read_chunk
+        ) & ~np.asarray(resolved.overflow)[: hi - lo]
 
     def read_all(self) -> List[List[FormatSpan]]:
         """Span sweep over every doc: device docs decode in ONE vectorized
@@ -1066,18 +1104,20 @@ class StreamingMerge:
         n_blocks = -(-self._padded_docs // self._read_chunk)
         for bi in range(n_blocks):
             lo, hi = self._block_bounds(bi)
-            if lo >= self.num_docs:
-                break
+            docs_here = self._doc_at[lo:hi]
+            if not (docs_here >= 0).any():
+                continue  # pad-only block: nothing to resolve
             resolved = self._resolved_block(bi)
             mask = self._block_device_mask(resolved, lo, hi)
             attr_of, comment_of = self._block_tables(lo)
             spans = decode_block_spans(resolved, attr_of, comment_of, doc_mask=mask)
-            for local in range(min(hi, self.num_docs) - lo):
-                i = lo + local
+            for local, d in enumerate(docs_here):
+                if d < 0:
+                    continue
                 if mask[local]:
-                    out[i] = spans[local]
+                    out[d] = spans[local]
                 else:
-                    out[i] = _replay_spans(self._replay_changes(self.docs[i]))
+                    out[d] = _replay_spans(self._replay_changes(self.docs[d]))
         return out
 
     def read_patches_all(self) -> List[List]:
@@ -1092,8 +1132,9 @@ class StreamingMerge:
         n_blocks = -(-self._padded_docs // self._read_chunk)
         for bi in range(n_blocks):
             lo, hi = self._block_bounds(bi)
-            if lo >= self.num_docs:
-                break
+            docs_here = self._doc_at[lo:hi]
+            if not (docs_here >= 0).any():
+                continue  # pad-only block
             resolved = self._resolved_block(bi)
             mask = self._block_device_mask(resolved, lo, hi)
             attr_of, comment_of = self._block_tables(lo)
@@ -1102,22 +1143,125 @@ class StreamingMerge:
                 resolved, elem_block, self._actor_table, attr_of, comment_of,
                 doc_mask=mask,
             )
-            for local in range(min(hi, self.num_docs) - lo):
-                i = lo + local
+            for local, d in enumerate(docs_here):
+                if d < 0:
+                    continue
                 if mask[local]:
                     chars = chars_block[local]
                 else:
                     chars = doc_chars_scalar(
-                        _replay_doc(self._replay_changes(self.docs[i]))
+                        _replay_doc(self._replay_changes(self.docs[d]))
                     )
-                base = self._patch_base.get(i, [])
-                out[i] = diff_patches(base, chars)
-                self._patch_base[i] = chars
+                base = self._patch_base.get(d, [])
+                out[d] = diff_patches(base, chars)
+                self._patch_base[d] = chars
         return out
 
     # -- cross-shard reductions (the ICI/DCN collectives) ------------------
 
-    def digest(self, full: bool = True) -> int:
+    def reshard(self, assignment: Optional[Sequence[int]] = None) -> dict:
+        """Load-balance doc placement across shards (SURVEY §5.8(c)).
+
+        Streaming sessions place docs at first sight and never move them
+        (``rebalance`` is placement-time only), so skewed arrival leaves hot
+        shards bounding round latency.  This moves packed doc rows between
+        shards as ONE gather over the doc axis — under a mesh XLA lowers
+        the cross-shard row movement to collective permutes over ICI (the
+        all-to-all) — while every logical doc id, clock, interner, pending
+        queue and fallback flag stays put: placement is an internal detail
+        behind ``_row_of``/``_doc_at``, so reads, ingest and digests are
+        unchanged (digest is a doc-sum — permutation-invariant by
+        construction; tests assert it bit-equal across a reshard).
+
+        ``assignment`` maps each logical doc to a target shard (len
+        ``num_docs``); default balances per-shard LIVE SLOT load greedily
+        (largest doc first onto the least-loaded shard with a free row).
+        Shards are ``mesh.size`` for mesh sessions, else the read-block
+        count (balancing per-block read/digest latency).  Returns
+        ``{"moved": n, "shard_load": [...]}``."""
+        n_blocks = -(-self._padded_docs // self._read_chunk)
+        n_shards = self.mesh.size if self.mesh is not None else n_blocks
+        if n_shards <= 1 or self.num_docs == 0:
+            return {"moved": 0, "shard_load": [0] * max(n_shards, 1)}
+        if self._padded_docs % n_shards:
+            raise ValueError("padded doc axis must divide the shard count")
+        rows_per_shard = self._padded_docs // n_shards
+        sizes = np.asarray(self.state.num_slots)[self._row_of[: self.num_docs]]
+        if assignment is None:
+            order = sorted(range(self.num_docs), key=lambda d: -int(sizes[d]))
+            load = [0] * n_shards
+            free = [rows_per_shard] * n_shards
+            assignment = [0] * self.num_docs
+            for d in order:
+                s = min((s for s in range(n_shards) if free[s] > 0),
+                        key=lambda s: load[s])
+                assignment[d] = s
+                load[s] += int(sizes[d])
+                free[s] -= 1
+        else:
+            assignment = [int(s) for s in assignment]
+            if len(assignment) != self.num_docs:
+                raise ValueError("assignment must cover every doc")
+            for s, count in zip(*np.unique(assignment, return_counts=True)):
+                if not 0 <= s < n_shards:
+                    raise ValueError(f"shard {s} out of range")
+                if count > rows_per_shard:
+                    raise ValueError(f"shard {s} over capacity: {count} docs")
+
+        next_row = [s * rows_per_shard for s in range(n_shards)]
+        new_row = np.empty(self.num_docs, np.int64)
+        for d, s in enumerate(assignment):
+            new_row[d] = next_row[s]
+            next_row[s] += 1
+        moved = int((new_row != self._row_of).sum())
+        if moved == 0:
+            pass
+        else:
+            # permutation: new physical row r carries old row src[r]; rows
+            # not holding a doc recycle the old empty rows (zeros), so src
+            # is a full permutation and pad rows stay no-op
+            src = np.full(self._padded_docs, -1, np.int64)
+            src[new_row] = self._row_of
+            spare = iter(sorted(
+                set(range(self._padded_docs)) - set(int(r) for r in self._row_of)
+            ))
+            for r in range(self._padded_docs):
+                if src[r] < 0:
+                    src[r] = next(spare)
+            idx = jnp.asarray(src)
+            state = PackedDocs(*(jnp.take(x, idx, axis=0) for x in self.state))
+            self.state = shard_docs(state, self.mesh) if self.mesh is not None else state
+            self._row_of = new_row
+            self._doc_at = np.full(self._padded_docs, -1, np.int64)
+            self._doc_at[new_row] = np.arange(self.num_docs)
+            # placement changed: every physically-keyed cache is stale, and
+            # in-flight async digests must not write back (epoch guard)
+            self._resolved_cache = (-1, {})
+            self._carried_digest.clear()
+            self._digest_dirty.clear()
+            self._placement_epoch += 1
+        shard_load = [0] * n_shards
+        for d, s in enumerate(assignment):
+            shard_load[s] += int(sizes[d])
+        return {"moved": moved, "shard_load": shard_load}
+
+    def _carried_block_digest(self, bi: int):
+        """(digest, overflow) for one block via the carried store when the
+        block is clean — untouched since its digest was computed AND holding
+        the same fallback mask — else a fresh fused resolution, written back
+        to the carry.  This is what makes the per-round digest cost scale
+        with touched docs (VERDICT r3 task 2)."""
+        carried = self._carried_digest.get(bi)
+        if carried is not None and bi not in self._digest_dirty and \
+                np.array_equal(carried[1], self._block_fallback_mask(bi)):
+            return carried[0], carried[2]
+        entry = self._digest_resolution(bi)
+        digest, ov = entry.digest, entry.overflow
+        self._carried_digest[bi] = (digest, entry.on_device, ov)
+        self._digest_dirty.discard(bi)
+        return digest, ov
+
+    def digest(self, full: bool = True, refresh: bool = False) -> int:
         """Global convergence digest: with a mesh, XLA lowers the cross-doc
         reduction to an all-reduce over ICI.  Two sessions that converged
         hold equal digests.
@@ -1142,14 +1286,23 @@ class StreamingMerge:
 
         The digest is a doc-sum of per-doc hashes, so it is computed per
         read-block and summed mod 2^32 — identical to the whole-batch value
-        while bounding device memory at 100K-doc scale."""
+        while bounding device memory at 100K-doc scale.  Per-round cost is
+        INCREMENTAL: blocks untouched since their last digest reuse the
+        carried scalar (see :meth:`_carried_block_digest`).
+        ``refresh=True`` is the verification path: every block re-resolves
+        from current device state, ignoring (and rebuilding) the carry."""
         from .mesh import doc_digest_host
 
-        on_device_all = np.asarray(
-            [not s.fallback for s in self.docs]
-            + [False] * (self._padded_docs - self.num_docs),
-            bool,
-        )
+        if refresh:
+            self._carried_digest.clear()
+            self._digest_dirty.clear()
+            self._resolved_cache = (-1, {})
+
+        # per-ROW device mask (doc placement goes through _row_of/_doc_at)
+        on_device_all = np.zeros(self._padded_docs, bool)
+        for d, s in enumerate(self.docs):
+            if not s.fallback:
+                on_device_all[self._row_of[d]] = True
         total = 0
         replay_docs = [i for i, s in enumerate(self.docs) if s.fallback]
         n_blocks = -(-self._padded_docs // self._read_chunk)
@@ -1157,9 +1310,9 @@ class StreamingMerge:
             lo, hi = self._block_bounds(bi)
             if full:
                 # shares the per-round block resolution with the read paths
-                # (one fused program); fetches scalar + overflow only
-                entry = self._digest_resolution(bi)
-                digest, ov = entry.digest, entry.overflow
+                # (one fused program); fetches scalar + overflow only —
+                # clean blocks skip even that via the carried digest
+                digest, ov = self._carried_block_digest(bi)
             else:
                 digest, overflow = _resolve_digest_jit(
                     self._state_block(bi), self.comment_capacity,
@@ -1168,9 +1321,9 @@ class StreamingMerge:
                 digest, ov = int(digest), np.asarray(overflow)
             total = (total + digest) & 0xFFFFFFFF
             replay_docs.extend(
-                int(d) + lo
-                for d in np.nonzero(ov & on_device_all[lo:hi])[0]
-                if int(d) + lo < self.num_docs
+                int(self._doc_at[int(r) + lo])
+                for r in np.nonzero(ov & on_device_all[lo:hi])[0]
+                if int(self._doc_at[int(r) + lo]) >= 0
             )
         s_cap = self.state.slot_capacity
         for i in replay_docs:
@@ -1199,16 +1352,25 @@ class StreamingMerge:
         zero fallbacks/overflows may wait at any time)."""
         parts = []
         for bi in range(-(-self._padded_docs // self._read_chunk)):
+            lo, hi = self._block_bounds(bi)
+            docs_here = self._doc_at[lo:hi].copy()  # schedule-time placement
+            carried = self._carried_digest.get(bi)
+            if carried is not None and bi not in self._digest_dirty and \
+                    np.array_equal(carried[1], self._block_fallback_mask(bi)):
+                # clean block: nothing to schedule — carry the scalar
+                parts.append((bi, lo, carried[0], carried[2], carried[1],
+                              docs_here))
+                continue
             entry = self._digest_resolution(bi)
             # keep ONLY the scalar + overflow device refs and the mask — not
             # the _BlockResolution itself, whose resolved (D, S) planes would
             # otherwise stay pinned on device across the handle's lifetime,
             # defeating the size-2 block-cache memory bound at 100K docs
             parts.append((
-                self._block_bounds(bi)[0], entry.digest_dev,
-                entry.device.overflow, entry.on_device,
+                bi, lo, entry.digest_dev, entry.device.overflow,
+                entry.on_device, docs_here,
             ))
-        return _PendingDigest(self, parts)
+        return _PendingDigest(self, parts, self.rounds, self._placement_epoch)
 
     def _digest_tables(self, lo: int, hi: int):
         """Per-block (D, ·) uint32 content-hash tables for the full digest:
@@ -1220,10 +1382,13 @@ class StreamingMerge:
         d_block = hi - lo
         sess_attr = self._frame_attrs.content_hashes()
         sess_keys = self._map_keys.content_hashes()
+        # rows hold docs through the placement indirection: table row r-lo
+        # describes the doc at physical row r (identity until reshard)
         enc = {
-            d: self.docs[d].encoder
-            for d in range(lo, min(hi, self.num_docs))
-            if not self.docs[d].frame_mode and self.docs[d].encoder is not None
+            row: self.docs[d].encoder
+            for row in range(lo, hi)
+            if (d := int(self._doc_at[row])) >= 0
+            and not self.docs[d].frame_mode and self.docs[d].encoder is not None
         }
         a_w = _width_bucket(max(
             [len(sess_attr)] + [len(e.attrs.content_hashes()) for e in enc.values()]
@@ -1237,19 +1402,20 @@ class StreamingMerge:
         comment_hash = np.zeros((d_block, c_w), np.uint32)
         attr_hash[:, : len(sess_attr)] = sess_attr[None, :]
         key_hash[:, : len(sess_keys)] = sess_keys[None, :]
-        for d, e in enc.items():
+        for row, e in enc.items():
             ah = e.attrs.content_hashes()
             kh = e.keys.content_hashes()
-            attr_hash[d - lo] = 0
-            attr_hash[d - lo, : len(ah)] = ah
-            key_hash[d - lo] = 0
-            key_hash[d - lo, : len(kh)] = kh
+            attr_hash[row - lo] = 0
+            attr_hash[row - lo, : len(ah)] = ah
+            key_hash[row - lo] = 0
+            key_hash[row - lo, : len(kh)] = kh
             # object-path comment marks index the same per-doc attr interner
-            comment_hash[d - lo, : min(c_w, len(ah))] = ah[:min(c_w, len(ah))]
+            comment_hash[row - lo, : min(c_w, len(ah))] = ah[:min(c_w, len(ah))]
         for d, table in self._doc_comment_ids.items():
-            if lo <= d < min(hi, self.num_docs) and self.docs[d].frame_mode:
+            row = int(self._row_of[d])
+            if lo <= row < hi and self.docs[d].frame_mode:
                 ch = table.content_hashes()
-                comment_hash[d - lo, : min(c_w, len(ch))] = ch[:min(c_w, len(ch))]
+                comment_hash[row - lo, : min(c_w, len(ch))] = ch[:min(c_w, len(ch))]
         tables = (jnp.asarray(attr_hash), jnp.asarray(comment_hash), jnp.asarray(key_hash))
         if self.mesh is not None:
             tables = shard_docs(tables, self.mesh)
@@ -1355,12 +1521,15 @@ class _PendingDigest:
     them with host-side replay hashes exactly as ``digest()`` does, then
     releases the device refs."""
 
-    __slots__ = ("_session", "_parts", "_value")
+    __slots__ = ("_session", "_parts", "_value", "_stamp", "_epoch")
 
-    def __init__(self, session: "StreamingMerge", parts) -> None:
+    def __init__(self, session: "StreamingMerge", parts, stamp: int,
+                 epoch: int) -> None:
         self._session = session
         self._parts = parts
         self._value: Optional[int] = None
+        self._stamp = stamp  # session round at scheduling time
+        self._epoch = epoch  # placement epoch at scheduling time
 
     def wait(self) -> int:
         if self._value is not None:
@@ -1368,13 +1537,25 @@ class _PendingDigest:
         s = self._session
         total = 0
         replay_docs = []
-        for lo, digest_dev, overflow_dev, on_device in self._parts:
-            total = (total + int(np.asarray(digest_dev))) & 0xFFFFFFFF
-            upper = min(lo + len(on_device), s.num_docs)
-            ov = np.asarray(overflow_dev)
-            for local in range(upper - lo):
-                if not on_device[local] or ov[local]:
-                    replay_docs.append(lo + local)
+        for bi, lo, digest_dev, overflow_dev, on_device, docs_here in self._parts:
+            if isinstance(digest_dev, int):  # carried clean-block scalar
+                digest, ov = digest_dev, overflow_dev
+            else:
+                digest, ov = int(np.asarray(digest_dev)), np.asarray(overflow_dev)
+                if s.rounds == self._stamp and s._placement_epoch == self._epoch:
+                    # the fetch doubles as the carry write-back (mask
+                    # freshness is re-checked at every carried-use site);
+                    # a reshard in between makes these scalars describe rows
+                    # that no longer hold the same docs — never write back
+                    s._carried_digest[bi] = (digest, on_device, ov)
+                    s._digest_dirty.discard(bi)
+            total = (total + digest) & 0xFFFFFFFF
+            # row -> doc through the SCHEDULE-TIME placement snapshot: the
+            # scalars describe the rows as they were when scheduled
+            for local in range(len(on_device)):
+                d = int(docs_here[local])
+                if d >= 0 and (not on_device[local] or ov[local]):
+                    replay_docs.append(d)
         from .mesh import doc_digest_host
 
         s_cap = s.state.slot_capacity

@@ -143,6 +143,55 @@ def main() -> None:
         n_shards = len(s.state.elem_id.sharding.device_set)
         assert n_shards == n, f"expected {n} shards, got {n_shards}"
 
+        # ---- skewed arrival + reshard (SURVEY §5.8(c)) ----
+        # first-seen placement pins heavy docs wherever they arrived; the
+        # reshard all-to-all must restore per-shard load balance with the
+        # digest bit-unchanged
+        skew_stats = None
+        if n > 1:
+            sk_docs = args.docs_per_device * n
+            heavy = generate_workload(args.seed ^ 0x5E, num_docs=sk_docs // 4,
+                                      ops_per_doc=args.ops_per_doc * 3)
+            light = generate_workload(args.seed ^ 0x5F, num_docs=sk_docs - len(heavy),
+                                      ops_per_doc=max(8, args.ops_per_doc // 4))
+            sk_w = heavy + light  # heavy docs all land in the first shard(s)
+            sk = StreamingMerge(
+                num_docs=sk_docs, actors=("doc1", "doc2", "doc3"), mesh=mesh,
+                slot_capacity=12 * args.ops_per_doc,
+                mark_capacity=6 * args.ops_per_doc,
+                tomb_capacity=6 * args.ops_per_doc,
+                round_insert_capacity=256, round_delete_capacity=128,
+                round_mark_capacity=128,
+            )
+            sk.ingest_frames(
+                (d, encode_frame([ch for log in w.values() for ch in log]))
+                for d, w in enumerate(sk_w)
+            )
+            sk.drain()
+            d_before = sk.digest()
+
+            def shard_loads(sess):
+                slots = np.asarray(sess.state.num_slots)
+                per = sess._padded_docs // n
+                return [int(slots[i * per:(i + 1) * per].sum()) for i in range(n)]
+
+            loads_before = shard_loads(sk)
+            t0 = time.perf_counter()
+            moved = sk.reshard()
+            np.asarray(sk.state.num_slots)  # sync the gather
+            reshard_s = time.perf_counter() - t0
+            loads_after = shard_loads(sk)
+            assert sk.digest() == d_before, "reshard changed the digest"
+            skew_stats = {
+                "docs": sk_docs,
+                "moved_docs": moved["moved"],
+                "reshard_seconds": round(reshard_s, 3),
+                "shard_load_before": loads_before,
+                "shard_load_after": loads_after,
+                "imbalance_before": round(max(loads_before) / max(1, min(loads_before)), 2),
+                "imbalance_after": round(max(loads_after) / max(1, min(loads_after)), 2),
+            }
+
         # ---- fixed-probe digest: content must be mesh-size invariant ----
         ps = StreamingMerge(
             num_docs=16, actors=("doc1", "doc2", "doc3"), mesh=mesh,
@@ -170,6 +219,7 @@ def main() -> None:
             },
             "fixed_work_seconds": round(fixed_s, 3),
             "fixed_work_ops_per_sec": round(fixed_ops / fixed_s, 1),
+            "skewed_arrival_reshard": skew_stats,
             "probe_digest": digests[n],
         }))
 

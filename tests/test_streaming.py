@@ -300,3 +300,147 @@ def test_digest_equal_across_different_demotion_sets():
     assert on_device.digest(full=False) == demoted.digest(full=False)
     # …but the full-state digest correctly sees the extra map register
     assert on_device.digest() != demoted.digest()
+
+
+def test_span_marks_are_isolated_copies():
+    """Mark dicts are memoized inside the vectorized span decode — but the
+    copies handed out must be isolated ALL the way down: mutating one span's
+    nested mark values (link url, comment list) must not reformat any other
+    span or doc sharing the same formatting (ADVICE r3 + review r4)."""
+    from peritext_tpu.testing.generate import generate_docs
+
+    docs, _, initial = generate_docs("hello world", 1)
+    (d1,) = docs
+    link, _ = d1.change([{
+        "path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 5,
+        "markType": "link", "attrs": {"url": "https://a.example"},
+    }])
+    comment, _ = d1.change([{
+        "path": ["text"], "action": "addMark", "startIndex": 0, "endIndex": 5,
+        "markType": "comment", "attrs": {"id": "c-1"},
+    }])
+    sess = StreamingMerge(num_docs=2, actors=("doc1",))
+    for d in range(2):
+        sess.ingest(d, [initial, link, comment])
+    sess.drain()
+    spans = sess.read_all()
+    assert spans[0] == spans[1]
+    marked = next(sp for sp in spans[0] if "link" in sp["marks"])
+    # nested mutation on doc 0's span...
+    marked["marks"]["link"]["url"] = "https://evil.example"
+    marked["marks"]["comment"].append({"id": "c-2"})
+    marked["marks"]["strong"] = {"active": True}
+    # ...must leave doc 1's identically-formatted span untouched
+    twin = next(sp for sp in spans[1] if "link" in sp["marks"])
+    assert twin["marks"]["link"]["url"] == "https://a.example"
+    assert twin["marks"]["comment"] == [{"id": "c-1"}]
+    assert "strong" not in twin["marks"]
+
+
+class TestReshard:
+    """Live doc re-sharding (SURVEY §5.8(c)): move packed doc rows across
+    shards, digest-invariant, with ingest continuing afterwards."""
+
+    def _skewed(self, seed=5):
+        workloads = generate_workload(seed=seed, num_docs=8, ops_per_doc=30)
+        big = generate_workload(seed=seed + 1, num_docs=2, ops_per_doc=150)
+        workloads[0], workloads[1] = big[0], big[1]
+        return workloads
+
+    def _split(self, w):
+        chs = [ch for log in w.values() for ch in log]
+        half = len(chs) // 2
+        return chs[:half], chs[half:]
+
+    def test_reshard_preserves_state_and_keeps_ingesting(self):
+        workloads = self._skewed()
+        halves = [self._split(w) for w in workloads]
+        s = StreamingMerge(
+            num_docs=8, actors=ACTORS, read_chunk=2,
+            round_insert_capacity=256, round_delete_capacity=128,
+            round_mark_capacity=128,
+        )
+        for d, (first, _) in enumerate(halves):
+            s.ingest(d, first)
+        s.drain()
+        before_digest, before_reads = s.digest(), s.read_all()
+
+        r = s.reshard()
+        assert r["moved"] > 0
+        # skew is balanced: worst shard no longer dominates
+        assert max(r["shard_load"]) < 0.7 * sum(r["shard_load"])
+        # placement is invisible: digests and reads are bit-identical
+        assert s.digest() == before_digest == s.digest(refresh=True)
+        assert s.read_all() == before_reads
+
+        # the session keeps running on the new placement
+        for d, (_, second) in enumerate(halves):
+            s.ingest(d, second)
+        s.drain()
+        assert s.read_all() == oracle_merge(workloads)
+        assert s.digest() == s.digest(refresh=True)
+
+    def test_reshard_mesh_all_to_all_digest_invariant(self):
+        from peritext_tpu.parallel.mesh import make_mesh
+
+        workloads = self._skewed(seed=11)
+        halves = [self._split(w) for w in workloads]
+        s = StreamingMerge(num_docs=8, actors=ACTORS, mesh=make_mesh(4),
+                           round_insert_capacity=256,
+                           round_delete_capacity=128, round_mark_capacity=128)
+        for d, (first, _) in enumerate(halves):
+            s.ingest(d, first)
+        s.drain()
+        before = s.digest()
+        r = s.reshard()
+        assert s.digest() == before
+        for d, (_, second) in enumerate(halves):
+            s.ingest(d, second)
+        s.drain()
+        assert s.read_all() == oracle_merge(workloads)
+        # meshless session with same data agrees (cross-topology invariance)
+        flat = StreamingMerge(num_docs=8, actors=ACTORS,
+                              round_insert_capacity=256,
+                              round_delete_capacity=128,
+                              round_mark_capacity=128)
+        for d, w in enumerate(workloads):
+            flat.ingest(d, [ch for log in w.values() for ch in log])
+        flat.drain()
+        assert flat.digest() == s.digest()
+
+    def test_reshard_explicit_assignment_and_validation(self):
+        workloads = self._skewed(seed=21)
+        s = StreamingMerge(num_docs=8, actors=ACTORS, read_chunk=2,
+                           round_insert_capacity=256,
+                           round_delete_capacity=128, round_mark_capacity=128)
+        for d, w in enumerate(workloads):
+            s.ingest(d, [ch for log in w.values() for ch in log])
+        s.drain()
+        before = s.digest()
+        # explicit: reverse the blocks
+        s.reshard([3, 3, 2, 2, 1, 1, 0, 0])
+        assert s.digest() == before
+        assert s.read_all() == oracle_merge(workloads)
+        with pytest.raises(ValueError, match="capacity"):
+            s.reshard([0] * 8)  # 8 docs into a 2-row shard
+        with pytest.raises(ValueError, match="cover"):
+            s.reshard([0, 1])
+
+    def test_reshard_between_async_digest_and_wait(self):
+        """A reshard between digest_async() and wait() must neither corrupt
+        the returned value (the scalars describe schedule-time rows) nor
+        write stale pre-reshard digests into the carry (review r4)."""
+        workloads = self._skewed(seed=31)
+        s = StreamingMerge(num_docs=8, actors=ACTORS, read_chunk=2,
+                           round_insert_capacity=256,
+                           round_delete_capacity=128, round_mark_capacity=128)
+        for d, w in enumerate(workloads):
+            s.ingest(d, [ch for log in w.values() for ch in log])
+        s.drain()
+        s.docs[3].fallback = True  # a replay doc exercises the row->doc map
+        expected = s.digest(refresh=True)
+        pending = s.digest_async()
+        assert s.reshard()["moved"] > 0
+        assert pending.wait() == expected
+        # the carry was not polluted by the pre-reshard scalars
+        assert s.digest() == s.digest(refresh=True) == expected
