@@ -50,6 +50,11 @@ PROBE_TIMEOUT = float(os.environ.get("PT_BENCH_PROBE_TIMEOUT", "120"))
 PROBE_ATTEMPTS = int(os.environ.get("PT_BENCH_PROBE_ATTEMPTS", "3"))
 PROBE_BACKOFF = float(os.environ.get("PT_BENCH_PROBE_BACKOFF", "5"))
 WORKER_TIMEOUT = float(os.environ.get("PT_BENCH_TIMEOUT", "2700"))
+# Ladder mode (the no-args default): per-row worker timeout and a global
+# deadline after which remaining rows are recorded as skipped — one slow or
+# wedged row must never cost the round its entire evidence record.
+ROW_TIMEOUT = float(os.environ.get("PT_BENCH_ROW_TIMEOUT", "900"))
+LADDER_DEADLINE = float(os.environ.get("PT_BENCH_LADDER_DEADLINE", "3600"))
 
 # The probe child: initialize the default jax backend (axon plugin when the
 # tunnel is up, else cpu) AND round-trip one tiny device computation —
@@ -140,6 +145,29 @@ def measure_native_baseline(num_docs: int = 16, ops_per_doc: int = 256, seed: in
     return total_ops / best
 
 
+def _baselines_for(ops_per_doc: int, seed: int):
+    """(python_oracle, native_cpp) baselines — reused from the ladder's
+    baselines row via PT_BENCH_BASELINES when the shapes match, else
+    measured in-process (the scalar baselines cost ~30 s each, too much to
+    re-pay in every ladder row)."""
+    blob = os.environ.get("PT_BENCH_BASELINES")
+    if blob:
+        try:
+            b = json.loads(blob)
+        except json.JSONDecodeError:
+            b = None
+        if b and b.get("scalar_python_ops_per_sec"):
+            python = b["scalar_python_ops_per_sec"]
+            if b.get("native_ops_per_doc") == ops_per_doc and \
+                    b.get("native_cpp_ops_per_sec"):
+                return python, b["native_cpp_ops_per_sec"]
+            return python, measure_native_baseline(ops_per_doc=ops_per_doc, seed=seed)
+    return (
+        measure_scalar_baseline(),
+        measure_native_baseline(ops_per_doc=ops_per_doc, seed=seed),
+    )
+
+
 def run(args) -> dict:
     import jax
 
@@ -218,8 +246,7 @@ def run(args) -> dict:
     np.asarray(resolved.overflow)
     resolve_time = (time.perf_counter() - t0) / args.iters
 
-    baseline = measure_scalar_baseline()
-    native_baseline = measure_native_baseline(ops_per_doc=args.ops_per_doc, seed=args.seed or 7)
+    baseline, native_baseline = _baselines_for(args.ops_per_doc, args.seed or 7)
     honest = native_baseline or baseline
 
     return {
@@ -246,12 +273,21 @@ def run(args) -> dict:
     }
 
 
-def build_arrival(workloads, rounds: int, seed, as_frames: bool = True):
-    """Per-doc round batches of a streaming session's arrival: shuffle each
-    workload's changes (cross-round arrival skew), split into ``rounds``
-    batches, and — for the wire path — encode each batch per-sender
+def build_arrival(workloads, rounds: int, seed, as_frames: bool = True,
+                  arrival_model: str = "shuffle", wire: str = "v2"):
+    """Per-doc round batches of a streaming session's arrival, split into
+    ``rounds`` batches and — for the wire path — encoded per-sender
     sequential (senders flush their queues in order, changeQueue semantics;
     also what the wire codec's delta context expects).
+
+    ``arrival_model``: "shuffle" (the r1-r3 bench shape: full random
+    shuffle, i.e. per-sender REORDERING — a stress the real transport never
+    produces, kept for record continuity and scheduling stress) or "fifo"
+    (per-sender FIFO with random cross-sender interleave — what TCP + the
+    reference's changeQueue actually deliver, src/changeQueue.ts:16-28).
+    ``wire``: "v2" self-contained frames, or "v4" session-scoped frames
+    (one WireSession per doc link: persistent string dictionary + deflate,
+    codec.WireSession).
 
     SHARED by the end-to-end (run_streaming) and engine-limit (run_engine)
     rows: the engine row's whole value is being the same workload minus
@@ -259,19 +295,31 @@ def build_arrival(workloads, rounds: int, seed, as_frames: bool = True):
     Returns (arrival, wire_bytes)."""
     import random
 
-    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.parallel.codec import WireSession, encode_frame
 
     rng = random.Random(seed)
     arrival = []
     wire_bytes = 0
     for w in workloads:
-        changes = [ch for log in w.values() for ch in log]
-        rng.shuffle(changes)
+        if arrival_model == "fifo":
+            logs = {a: list(l) for a, l in w.items()}
+            actors = sorted(logs)
+            changes = []
+            while True:
+                live = [a for a in actors if logs[a]]
+                if not live:
+                    break
+                changes.append(logs[rng.choice(live)].pop(0))
+        else:
+            changes = [ch for log in w.values() for ch in log]
+            rng.shuffle(changes)
         size = -(-len(changes) // rounds)
         batches = [changes[i : i + size] for i in range(0, len(changes), size)]
         if as_frames:
+            enc = WireSession(compress=True).encode_frame if wire == "v4" \
+                else encode_frame
             batches = [
-                encode_frame(sorted(b, key=lambda c: (c.actor, c.seq)))
+                enc(sorted(b, key=lambda c: (c.actor, c.seq)))
                 for b in batches
             ]
             wire_bytes += sum(len(b) for b in batches)
@@ -359,8 +407,7 @@ def run_streaming(args) -> dict:
     total_ops = sum(
         len(ch.ops) for w in workloads for log in w.values() for ch in log
     )
-    baseline = measure_scalar_baseline()
-    native_baseline = measure_native_baseline(ops_per_doc=args.ops_per_doc, seed=args.seed or 7)
+    baseline, native_baseline = _baselines_for(args.ops_per_doc, args.seed or 7)
     honest = native_baseline or baseline
     value = total_ops / elapsed
     return {
@@ -384,7 +431,7 @@ def run_streaming(args) -> dict:
     }
 
 
-def _run_bounded(argv, timeout):
+def _run_bounded(argv, timeout, env=None):
     """Run argv in its own session under a hard timeout; SIGKILL the whole
     process group on expiry (a plain terminate can leave tunnel threads
     holding the pipe open).  Returns (rc, stdout, stderr); rc is None on
@@ -395,6 +442,7 @@ def _run_bounded(argv, timeout):
         stderr=subprocess.PIPE,
         text=True,
         start_new_session=True,
+        env=env,
     )
     try:
         out, err = proc.communicate(timeout=timeout)
@@ -635,16 +683,369 @@ def run_engine(args) -> dict:
     }
 
 
+def run_baselines(args) -> dict:
+    """Scalar baselines row (BASELINE config 1): the pure-Python oracle and
+    the C++ single-core apply, measured once per ladder and shared with the
+    other rows via PT_BENCH_BASELINES."""
+    python = measure_scalar_baseline()
+    native = measure_native_baseline(ops_per_doc=256, seed=7)
+    return {
+        "metric": "baseline_ops_per_sec",
+        "value": round(native or python, 1),
+        "unit": "ops/s",
+        "vs_baseline": 1.0,
+        "baseline_impl": "cpp-single-core-scalar-apply" if native
+                         else "python-scalar-oracle",
+        "scalar_python_ops_per_sec": round(python, 1),
+        "native_cpp_ops_per_sec": round(native, 1) if native else None,
+        "native_ops_per_doc": 256,
+        "platform": "cpu",
+    }
+
+
+def run_wire(args) -> dict:
+    """Wire-efficiency row: bytes/op of the binary frame codec on the three
+    shapes the round-3 analysis tracks (VERDICT r3 weak #4) — interactive
+    typing, a causal fuzz session, and the streaming bench's arrival frames
+    — each against the reference's JSON-per-change wire
+    (src/micromerge.ts:563-564) as the compression baseline.  Each shape is
+    measured self-contained (v2) and through a session-scoped WireSession
+    (v4: persistent string dictionary + deflate, VERDICT r3 task 3).
+    Host-only: no device work, so the row is platform-independent."""
+    from peritext_tpu.core.doc import Doc
+    from peritext_tpu.parallel.causal import causal_sort
+    from peritext_tpu.parallel.codec import WireSession, decode_frame, encode_frame
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    def json_bytes(chs):
+        return sum(len(json.dumps(c.to_json()).encode()) for c in chs)
+
+    def session_bytes(frame_batches):
+        """Total v4 bytes: one WireSession per link, frames in order."""
+        enc = WireSession(compress=True)
+        dec = WireSession(compress=True)
+        total = 0
+        for chs in frame_batches:
+            f = enc.encode_frame(chs)
+            assert dec.decode_frame(f) == chs
+            total += len(f)
+        return total
+
+    shapes = {}
+
+    # typing shape: 20 multi-char inserts (the reference's chained-op path)
+    d = Doc("alice")
+    chs = [d.change([{"path": [], "action": "makeList", "key": "text"}])[0]]
+    text = "The quick brown fox jumps over the lazy dog. " * 20
+    pos = 0
+    for i in range(20):
+        seg = text[i * 45:(i + 1) * 45]
+        chs.append(d.change([{"path": ["text"], "action": "insert",
+                              "index": pos, "values": list(seg)}])[0])
+        pos += len(seg)
+    f = encode_frame(chs)
+    assert decode_frame(f) == chs
+    n = sum(len(c.ops) for c in chs)
+    shapes["typing"] = {
+        "bytes_per_op": round(len(f) / n, 2),
+        "session_bytes_per_op": round(session_bytes([chs]) / n, 2),
+        "json_bytes_per_op": round(json_bytes(chs) / n, 2),
+        "ops": n,
+    }
+
+    # fuzz-session shape: causally-ordered 3-replica session logs
+    tot_b = tot_o = tot_j = tot_s = 0
+    for wl in generate_workload(seed=21, num_docs=3, ops_per_doc=140):
+        sess = causal_sort([ch for log in wl.values() for ch in log])
+        f = encode_frame(sess)
+        assert decode_frame(f) == sess
+        tot_b += len(f)
+        tot_s += session_bytes([sess])
+        tot_j += json_bytes(sess)
+        tot_o += sum(len(c.ops) for c in sess)
+    shapes["fuzz_session"] = {
+        "bytes_per_op": round(tot_b / tot_o, 2),
+        "session_bytes_per_op": round(tot_s / tot_o, 2),
+        "json_bytes_per_op": round(tot_j / tot_o, 2),
+        "ops": tot_o,
+    }
+
+    # streaming-bench shape: the arrival frames the streaming row pays, in
+    # both arrival models (shuffle = r1-r3 record continuity; fifo = what
+    # TCP + changeQueue actually deliver) and both wire generations
+    docs = args.docs
+    workloads = generate_workload(seed=args.seed, num_docs=docs, ops_per_doc=192)
+    total_ops = sum(len(c.ops) for w in workloads for log in w.values() for c in log)
+    sample_json = sum(
+        json_bytes([c for log in w.values() for c in log]) for w in workloads[:32]
+    )
+    sample_ops = sum(
+        len(c.ops) for w in workloads[:32] for log in w.values() for c in log
+    )
+    variants = {}
+    for model in ("shuffle", "fifo"):
+        for wire in ("v2", "v4"):
+            _, wb = build_arrival(workloads, rounds=4, seed=args.seed,
+                                  arrival_model=model, wire=wire)
+            variants[f"{model}_{wire}"] = round(wb / total_ops, 2)
+    # host-link model: a DCN link between two hosts muxes EVERY doc's frames
+    # through one WireSession (per-doc sessions above are the conservative
+    # bound — real deployments share the link dictionary + deflate window)
+    from peritext_tpu.parallel.codec import WireSession as _WS
+
+    batches, _ = build_arrival(workloads, rounds=4, seed=args.seed,
+                               as_frames=False, arrival_model="fifo")
+    enc, dec = _WS(compress=True), _WS(compress=True)
+    link_bytes = 0
+    for r in range(4):
+        for doc_batches in batches:
+            if r < len(doc_batches):
+                b = sorted(doc_batches[r], key=lambda c: (c.actor, c.seq))
+                f = enc.encode_frame(b)
+                assert dec.decode_frame(f) == b
+                link_bytes += len(f)
+    variants["fifo_v4_host_link"] = round(link_bytes / total_ops, 2)
+    shapes["bench_frames"] = {
+        "bytes_per_op": variants["shuffle_v2"],   # r1-r3 continuity number
+        "variants_bytes_per_op": variants,
+        "session_bytes_per_op": variants["fifo_v4_host_link"],  # real transport
+        "json_bytes_per_op": round(sample_json / sample_ops, 2),
+        "ops": total_ops,
+        "docs": docs,
+    }
+
+    headline = shapes["bench_frames"]["session_bytes_per_op"]
+    return {
+        "metric": "wire_bytes_per_op",
+        "value": headline,
+        "unit": "B/op",
+        # vs the JSON wire: how many times smaller the binary frames are
+        "vs_baseline": round(shapes["bench_frames"]["json_bytes_per_op"] / headline, 2),
+        "baseline_impl": "json-encoded changes (reference wire, src/micromerge.ts:563)",
+        "shapes": shapes,
+        "platform": "host",
+    }
+
+
+def run_sweep(args) -> dict:
+    """Full-corpus sweep row (BASELINE config 5b, VERDICT r3 task 5): build
+    an N-doc converged session on carried device state (the scale demo's
+    shape: one 3-replica session streamed to every doc as wire frames over 2
+    arrival rounds), then MEASURE the full read_all / read_patches_all
+    sweeps and the full-state digest — the numbers round 3 projected from
+    2,048-doc memoization measurements instead of timing."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    from peritext_tpu.api.batch import _oracle_doc
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.parallel.streaming import StreamingMerge
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    d = args.docs
+    w = generate_workload(seed=args.seed, num_docs=1, ops_per_doc=args.ops_per_doc)[0]
+    changes = [ch for log in w.values() for ch in log]
+    half = len(changes) // 2
+    frames = [encode_frame(changes[:half]), encode_frame(changes[half:])]
+    expected = _oracle_doc(w).get_text_with_formatting(["text"])
+    total_ops = sum(len(c.ops) for c in changes) * d
+
+    sess = StreamingMerge(
+        num_docs=d, actors=("doc1", "doc2", "doc3"),
+        slot_capacity=512, mark_capacity=160, tomb_capacity=192,
+        round_insert_capacity=192, round_delete_capacity=96,
+        round_mark_capacity=96,
+    )
+    t0 = time.perf_counter()
+    for frame in frames:
+        sess.ingest_frames((doc, frame) for doc in range(d))
+        sess.drain()
+    build_seconds = time.perf_counter() - t0
+
+    for doc in (0, d // 2, d - 1):
+        assert sess.read(doc) == expected, f"doc {doc} diverged"
+    assert not any(s.fallback for s in sess.docs), "docs demoted to scalar replay"
+    assert sess.overflow_count() == 0
+
+    t0 = time.perf_counter()
+    digest = sess.digest()
+    digest_seconds = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    all_spans = sess.read_all()
+    read_seconds = time.perf_counter() - t0
+    assert all(s == expected for s in all_spans), "full-sweep read diverged"
+    t0 = time.perf_counter()
+    n_patches = sum(len(p) for p in sess.read_patches_all())
+    patches_seconds = time.perf_counter() - t0
+
+    sweep = read_seconds + patches_seconds
+    return {
+        "metric": "full_sweep_docs_per_sec",
+        "value": round(d / sweep, 1),
+        "unit": "docs/s",
+        "vs_baseline": None,
+        "docs": d,
+        "ops_per_doc_session": sum(len(c.ops) for c in changes),
+        "total_ops": total_ops,
+        "build_seconds": round(build_seconds, 1),
+        "build_ops_per_sec": round(total_ops / build_seconds, 1),
+        "digest": f"{digest:#010x}",
+        "digest_seconds": round(digest_seconds, 2),
+        "read_all_seconds": round(read_seconds, 2),
+        "read_patches_all_seconds": round(patches_seconds, 2),
+        "sweep_seconds": round(sweep, 2),
+        "n_patches": n_patches,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def ladder_rows(platform: str):
+    """The evidence-ladder row specs: (name, BASELINE config tag, worker
+    args, platform, timeout).  Ordered so the highest-value rows land first
+    if the global deadline cuts the run short.  On a dead tunnel the SAME
+    ladder runs with platform='cpu' — full configs, never the smoke config
+    alone (VERDICT r3 task 1)."""
+    t = ROW_TIMEOUT
+    return [
+        ("baselines",    "1",  ["--mode", "baselines"], "cpu", t),
+        ("batch_8k",     "4",  ["--mode", "batch"], platform, t),
+        ("streaming",    "5",  ["--mode", "streaming"], platform, t),
+        ("wire",         "-",  ["--mode", "wire"], "cpu", t),
+        ("engine",       "5e", ["--mode", "engine"], platform, t),
+        ("batch_1k",     "3",  ["--mode", "batch", "--docs", "1024"], platform, t),
+        ("batch_128_cpu", "2", ["--mode", "batch", "--docs", "128"], "cpu", t),
+        ("batch_longdoc", "4b",
+         ["--mode", "batch", "--docs", "2048", "--ops-per-doc", "4096",
+          "--slots", "6144", "--marks", "640"], platform, t),
+        ("sweep_100k",   "5b", ["--mode", "sweep"], platform, max(t, 1800.0)),
+    ]
+
+
+def orchestrate_ladder(args) -> int:
+    """The no-args default: probe once, then run EVERY evidence row as its
+    own bounded worker and print one JSON line whose ``rows`` array carries
+    the whole ladder (VERDICT r3 task 1).  A row failure/timeout records a
+    structured entry and — if it happened on the probed TPU — flips the
+    remaining ladder to CPU, re-running the failed row there; the headline
+    fields mirror the best batch row so the driver contract (one line,
+    metric/value/vs_baseline) is unchanged."""
+    t_start = time.perf_counter()
+    extras = {}
+    if getattr(args, "profile", None) or getattr(args, "object_ingest", False):
+        print("bench: --profile/--object-ingest are not supported by the "
+              "ladder and will be ignored (use --mode batch/streaming)",
+              file=sys.stderr)
+    if args.platform:
+        platform = args.platform
+    else:
+        t0 = time.perf_counter()
+        platform, probe_tail = probe_device()
+        extras["probe_seconds"] = round(time.perf_counter() - t0, 1)
+        if platform is None:
+            extras["tpu_unavailable"] = True
+            extras["tpu_error"] = probe_tail
+            platform = "cpu"
+        elif platform == "cpu":
+            extras["tpu_unavailable"] = True
+            extras["tpu_error"] = "default jax backend is cpu (no TPU plugin attached)"
+
+    only = os.environ.get("PT_BENCH_LADDER_ROWS")
+    specs = ladder_rows(platform)
+    if only:
+        wanted = {w.strip() for w in only.split(",")}
+        specs = [s for s in specs if s[0] in wanted]
+
+    rows = []
+    baselines_blob = None
+    queue = list(specs)
+    while queue:
+        name, config, rargs, plat, timeout = queue.pop(0)
+        if plat != "cpu" and platform == "cpu":
+            plat = "cpu"  # ladder flipped to CPU after a TPU row died
+        left = LADDER_DEADLINE - (time.perf_counter() - t_start)
+        if left < 30:
+            rows.append({"row": name, "config": config, "skipped": "ladder deadline"})
+            continue
+        worker_args = list(rargs)
+        if args.smoke:
+            worker_args.append("--smoke")
+        if args.iters != 10:  # explicit --mode ladder may shape the workers
+            worker_args += ["--iters", str(args.iters)]
+        if args.seed:
+            worker_args += ["--seed", str(args.seed)]
+        if plat == "cpu" or args.platform:
+            worker_args += ["--platform", plat]
+        env = dict(os.environ)
+        if baselines_blob:
+            env["PT_BENCH_BASELINES"] = baselines_blob
+        rc, out, err = _run_bounded(
+            _worker_argv(worker_args), min(timeout, left), env=env
+        )
+        result = _parse_json_tail(out)
+        if rc == 0 and result is not None:
+            result["row"] = name
+            result["config"] = config
+            rows.append(result)
+            if name == "baselines":
+                baselines_blob = json.dumps(result)
+            continue
+        status = "timed out" if rc is None else f"rc={rc}"
+        tail = (err or out).strip()[-800:]
+        print(f"bench: ladder row {name} on {plat} {status}: {tail}",
+              file=sys.stderr)
+        rows.append({"row": name, "config": config, "platform_attempted": plat,
+                     "failed": True, "error": f"{status}: {tail}"})
+        if plat != "cpu":
+            # TPU passed the probe but a row died mid-ladder: flip the rest
+            # (and this row) to CPU so the record still carries the ladder.
+            extras["tpu_unavailable"] = True
+            extras["tpu_error"] = f"ladder row {name} on {plat} {status}"
+            platform = "cpu"
+            queue.insert(0, (name, config, rargs, "cpu", timeout))
+
+    extras["ladder_seconds"] = round(time.perf_counter() - t_start, 1)
+    headline = None
+    for want in ("batch_8k", "batch_1k", "batch_128_cpu", "streaming"):
+        headline = next(
+            (r for r in rows if r.get("row") == want and not r.get("failed")
+             and not r.get("skipped")), None)
+        if headline:
+            break
+    # a row subset (PT_BENCH_LADDER_ROWS) may not include a batch/streaming
+    # row at all: all-green rows are still a success, not a failure record
+    all_ok = bool(rows) and all(
+        not r.get("failed") and not r.get("skipped") for r in rows
+    )
+    record = {
+        "metric": headline.get("metric") if headline else "crdt_ops_per_sec_per_chip",
+        "value": headline.get("value") if headline else None,
+        "unit": "ops/s",
+        "vs_baseline": headline.get("vs_baseline") if headline else None,
+        "headline_row": headline.get("row") if headline else None,
+        **({} if headline or all_ok else {"failed": True}),
+        "rows": rows,
+        **extras,
+    }
+    print(json.dumps(record))
+    return 0 if headline or all_ok else 1
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--smoke", action="store_true", help="small fast config")
     parser.add_argument(
         "--mode",
-        choices=("batch", "streaming", "engine"),
-        default="batch",
+        choices=("batch", "streaming", "engine", "wire", "sweep", "baselines",
+                 "ladder"),
+        default=None,
         help="batch = one-shot converge (configs 2-4); streaming = config 5 "
              "end-to-end; engine = device-only streaming replay (the engine "
-             "limit, decoupled from host parse/link)",
+             "limit, decoupled from host parse/link); wire = codec bytes/op; "
+             "sweep = config-5b full-corpus read sweep; baselines = scalar "
+             "baselines only; ladder = every row as bounded sub-workers "
+             "(the default when invoked with no mode and no --smoke)",
     )
     parser.add_argument("--rounds", type=int, default=4, help="streaming arrival rounds")
     parser.add_argument(
@@ -669,7 +1070,19 @@ def main() -> None:
     )
     args = parser.parse_args()
 
+    explicit_sizing = (
+        any(v is not None for v in (args.docs, args.ops_per_doc, args.slots,
+                                    args.marks, args.profile))
+        or args.iters != 10 or args.seed != 0 or args.rounds != 4
+        or args.object_ingest
+    )
     if not args.worker:
+        if args.mode is None and not args.smoke and not explicit_sizing:
+            # the driver's plain `python bench.py`: the full evidence ladder
+            # (explicit sizing flags mean a hand-run single measurement —
+            # ladder_rows would silently drop them, so classic batch instead)
+            sys.exit(orchestrate_ladder(args))
+        args.mode = args.mode or "batch"
         # argv minus the program name IS the passthrough (worker re-parses it);
         # --platform is re-added per attempt by the orchestrator.
         argv = sys.argv[1:]
@@ -677,9 +1090,17 @@ def main() -> None:
                        if a != "--platform"
                        and not a.startswith("--platform=")
                        and not (i > 0 and argv[i - 1] == "--platform")]
+        if args.mode == "ladder":  # --smoke ladder: shrunk rows, same shape
+            sys.exit(orchestrate_ladder(args))
         sys.exit(orchestrate(args, passthrough))
 
-    if args.mode in ("streaming", "engine"):
+    args.mode = args.mode or "batch"
+    if args.mode == "sweep":
+        defaults = (2000, 220, 0, 0) if args.smoke else (100_000, 220, 0, 0)
+        args.seed = args.seed or 200
+    elif args.mode == "wire":
+        defaults = (64, 192, 0, 0) if args.smoke else (512, 192, 0, 0)
+    elif args.mode in ("streaming", "engine"):
         defaults = (64, 96, 256, 64) if args.smoke else (2048, 192, 384, 96)
     else:
         defaults = (64, 128, 192, 64) if args.smoke else (8192, 256, 384, 96)
@@ -688,7 +1109,8 @@ def main() -> None:
     args.slots = args.slots or defaults[2]
     args.marks = args.marks or defaults[3]
 
-    runners = {"streaming": run_streaming, "engine": run_engine, "batch": run}
+    runners = {"streaming": run_streaming, "engine": run_engine, "batch": run,
+               "wire": run_wire, "sweep": run_sweep, "baselines": run_baselines}
     print(json.dumps(runners[args.mode](args)))
 
 

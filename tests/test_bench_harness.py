@@ -100,6 +100,56 @@ def test_engine_mode_reports_engine_and_end_to_end():
     assert result["vs_baseline"] > 0.8
 
 
+@pytest.mark.slow
+def test_ladder_smoke_emits_rows():
+    """--mode ladder runs every selected row as its own bounded worker and
+    prints ONE JSON line with a rows array (VERDICT r3 task 1).  The
+    headline fields mirror the best batch row so the driver contract is
+    unchanged."""
+    proc = _run_bench(
+        ["--mode", "ladder", "--platform", "cpu"],
+        env_extra={"PT_BENCH_LADDER_ROWS": "baselines,batch_8k,wire"}.items(),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = _json_line(proc.stdout)
+    assert result["metric"] == "crdt_ops_per_sec_per_chip"
+    assert result["value"] > 0
+    assert result["headline_row"] == "batch_8k"
+    rows = {r["row"]: r for r in result["rows"]}
+    assert set(rows) == {"baselines", "batch_8k", "wire"}
+    assert rows["baselines"]["scalar_python_ops_per_sec"] > 0
+    assert rows["wire"]["shapes"]["typing"]["bytes_per_op"] < 4
+    assert rows["batch_8k"]["platform"] == "cpu"
+    # the batch row REUSED the baselines row's python-oracle measurement
+    # (shape-independent; the native one re-measures when ops/doc differ)
+    assert rows["batch_8k"]["python_oracle_ops_per_sec"] == \
+        rows["baselines"]["scalar_python_ops_per_sec"]
+
+
+@pytest.mark.slow
+def test_ladder_dead_tunnel_still_records_full_rows():
+    """A dead TPU backend must never shrink the record to the smoke config
+    alone: the SAME ladder reruns on CPU, flagged tpu_unavailable (VERDICT
+    r3 weak #2)."""
+    env = {
+        "PT_BENCH_SIMULATE_TPU": "fail",
+        "PT_BENCH_PROBE_ATTEMPTS": "1",
+        "PT_BENCH_PROBE_BACKOFF": "0",
+        "PT_BENCH_LADDER_ROWS": "wire,batch_128_cpu",
+    }
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--mode", "ladder", "--iters", "2", "--smoke"],
+        capture_output=True, text=True,
+        env={**os.environ, **env}, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = _json_line(proc.stdout)
+    assert result["tpu_unavailable"] is True
+    rows = {r["row"]: r for r in result["rows"]}
+    assert set(rows) == {"wire", "batch_128_cpu"}
+    assert not any(r.get("failed") for r in rows.values())
+
+
 def test_probe_ok_on_cpu_only_env_flags_unavailability(monkeypatch):
     """No TPU plugin (default backend = cpu) is recorded as tpu_unavailable
     so a driver run on a chip-less host can't masquerade as a TPU number.
