@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Generate the ProseMirror conformance fixtures (tests/pm_fixtures/).
+
+Each scenario's EDITS are authored directly in ProseMirror's wire schema
+(``Step.toJSON()`` — the exact JSON a real PM client posts through the
+bridge); this script replays them through two bridged editors (scalar
+backend) and records the converged document as ``Node.toJSON()`` of the
+reference schema.  The conformance tests then replay the fixtures from JSON
+alone — against BOTH backends — asserting byte-equal convergence, so the
+fixtures pin the full PM-JSON -> bridge -> CRDT -> patch -> PM-JSON loop.
+
+Re-run after intentionally changing merge semantics:
+    python scripts/gen_pm_fixtures.py
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+FIXTURES = Path(__file__).resolve().parent.parent / "tests" / "pm_fixtures"
+
+INITIAL = "The Peritext editor"
+
+
+def replace(frm, to, text=None, marks=None):
+    step = {"stepType": "replace", "from": frm, "to": to}
+    if text:
+        node = {"type": "text", "text": text}
+        if marks:
+            node["marks"] = marks
+        step["slice"] = {"content": [node]}
+    return step
+
+
+def add_mark(frm, to, mark_type, attrs=None):
+    mark = {"type": mark_type}
+    if attrs:
+        mark["attrs"] = attrs
+    return {"stepType": "addMark", "from": frm, "to": to, "mark": mark}
+
+
+def remove_mark(frm, to, mark_type, attrs=None):
+    mark = {"type": mark_type}
+    if attrs:
+        mark["attrs"] = attrs
+    return {"stepType": "removeMark", "from": frm, "to": to, "mark": mark}
+
+
+def typing(editor, pos, text):
+    """Per-keystroke replace steps (how PM delivers real typing)."""
+    return [
+        {"editor": editor, "steps": [replace(pos + i, pos + i, ch)]}
+        for i, ch in enumerate(text)
+    ]
+
+
+SCENARIOS = {
+    # interactive typing from both sides, merged mid-stream
+    "typing": {
+        "initial": INITIAL,
+        "events": (
+            typing("alice", 20, " rocks")
+            + [{"sync": True}]
+            + typing("bob", 1, ">> ")      # bob at the front...
+            + typing("alice", 26, "!")     # ...alice at the end, unsynced
+            + [{"sync": True}]
+        ),
+    },
+    # the reference's headline conflict: overlapping bold and italic
+    "format_overlap": {
+        "initial": INITIAL,
+        "events": [
+            {"editor": "alice", "steps": [add_mark(1, 13, "strong")]},
+            {"editor": "bob", "steps": [add_mark(5, 20, "em")]},
+            {"sync": True},
+        ],
+    },
+    # concurrent links over an overlap: one winner per character (LWW)
+    "link_conflict": {
+        "initial": INITIAL,
+        "events": [
+            {"editor": "alice",
+             "steps": [add_mark(1, 10, "link", {"url": "https://inkandswitch.com"})]},
+            {"editor": "bob",
+             "steps": [add_mark(5, 15, "link", {"url": "https://example.org"})]},
+            {"sync": True},
+        ],
+    },
+    # comments are an id-keyed set: concurrent adds coexist, removal by id
+    "comments": {
+        "initial": INITIAL,
+        "events": [
+            {"editor": "alice", "steps": [add_mark(1, 8, "comment", {"id": "c-alice"})]},
+            {"editor": "bob", "steps": [add_mark(4, 12, "comment", {"id": "c-bob"})]},
+            {"sync": True},
+            {"editor": "alice", "steps": [remove_mark(1, 8, "comment", {"id": "c-alice"})]},
+            {"sync": True},
+        ],
+    },
+    # select-and-type (content-bearing ReplaceStep) vs a concurrent delete
+    "replace_selection": {
+        "initial": INITIAL,
+        "events": [
+            {"editor": "bob", "steps": [replace(5, 13, "Micromerge")]},
+            {"editor": "alice", "steps": [replace(1, 5, "")]},
+            {"sync": True},
+        ],
+    },
+    # unbold a sub-range while the other side types inside the bold span
+    "unbold_while_typing": {
+        "initial": INITIAL,
+        "events": [
+            {"editor": "alice", "steps": [add_mark(1, 13, "strong")]},
+            {"sync": True},
+            {"editor": "bob", "steps": [remove_mark(4, 9, "strong")]},
+            *typing("alice", 5, "xy"),
+            {"sync": True},
+        ],
+    },
+    # marked typing: PM sends the stored-marks set inside the replace slice
+    "typing_with_marks": {
+        "initial": INITIAL,
+        "events": [
+            {"editor": "alice", "steps": [add_mark(1, 4, "strong")]},
+            {"sync": True},
+            {"editor": "bob",
+             "steps": [replace(4, 4, "se", [{"type": "strong"}])]},
+            {"sync": True},
+        ],
+    },
+}
+
+
+def run_scenario(spec):
+    from peritext_tpu.bridge.bridge import create_editor, initialize_docs
+    from peritext_tpu.bridge.pm import editor_doc_to_pm, transaction_from_pm
+    from peritext_tpu.parallel.pubsub import Publisher
+
+    pub = Publisher()
+    editors = {
+        "alice": create_editor("alice", pub),
+        "bob": create_editor("bob", pub),
+    }
+    initialize_docs([editors["alice"], editors["bob"]], spec["initial"])
+    for event in spec["events"]:
+        if event.get("sync"):
+            for ed in editors.values():
+                ed.sync()
+            continue
+        ed = editors[event["editor"]]
+        ed.dispatch(transaction_from_pm(event["steps"]))
+    for ed in editors.values():
+        ed.sync()
+    views = {name: editor_doc_to_pm(ed.view) for name, ed in editors.items()}
+    assert views["alice"] == views["bob"], "scenario did not converge"
+    return views["alice"], editors["alice"].text
+
+
+def main():
+    FIXTURES.mkdir(exist_ok=True)
+    for name, spec in SCENARIOS.items():
+        expected_doc, expected_text = run_scenario(spec)
+        out = dict(spec)
+        out["expected_doc"] = expected_doc
+        out["expected_text"] = expected_text
+        path = FIXTURES / f"{name}.json"
+        path.write_text(json.dumps(out, indent=1) + "\n")
+        print(f"{name}: {expected_text!r}")
+
+
+if __name__ == "__main__":
+    main()
