@@ -48,6 +48,9 @@ def test_page_serves_player(essay_url):
     with urllib.request.urlopen(url + "/") as res:
         page = res.read()
     assert b"Play" in page and b"oplog" in page and b"flash" in page
+    # live mark-span sidebars (reference demo's Marks panel, index.html:19-25)
+    assert b'id="marks-alice"' in page and b'id="marks-bob"' in page
+    assert b"renderMarkPanel" in page
 
 
 def test_stepping_advances_sections_highlights_and_oplog(essay_url):

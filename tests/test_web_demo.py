@@ -44,9 +44,15 @@ def _text(spans):
 
 def test_page_and_state(demo_url):
     with urllib.request.urlopen(demo_url + "/") as res:
-        assert b"contenteditable" in res.read()
+        page = res.read()
+    assert b"contenteditable" in page
+    # live mark-span sidebars (reference demo's Marks panel, index.html:19-25)
+    assert b'id="marks-alice"' in page and b'id="marks-bob"' in page
+    assert b"renderMarkPanel" in page
     state = _get(demo_url, "/state")
     assert _text(state["alice"]["spans"]) == _text(state["bob"]["spans"])
+    # the state payload carries everything the panel renders: per-span marks
+    assert all("marks" in sp for sp in state["alice"]["spans"])
 
 
 def test_edit_queue_sync_converges(demo_url):
