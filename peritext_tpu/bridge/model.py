@@ -26,11 +26,22 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ..core.spans import add_characters_to_spans
 from ..core.types import FormatSpan, MarkMap
-from ..schema import MARK_SPEC
+from ..schema import MARK_SPEC, excludes_of
 
 
 def _add_mark_to_map(marks: MarkMap, mark_type: str, attrs: Optional[Dict[str, Any]]) -> MarkMap:
     out = dict(marks)
+    # PM Mark.addToSet consults the schema's excludes (presentation half of
+    # the reference markSpec), in BOTH directions: an existing mark that
+    # excludes the new type rejects the add outright, and the new mark
+    # evicts the types it excludes.  The default excludes only the mark's
+    # own type (same-type replace below); comments exclude nothing.
+    for existing in out:
+        if existing != mark_type and mark_type in excludes_of(existing):
+            return out
+    for excluded in excludes_of(mark_type):
+        if excluded != mark_type:
+            out.pop(excluded, None)
     spec = MARK_SPEC.get(mark_type)
     if spec is not None and spec.allow_multiple:
         entries = [dict(e) for e in out.get(mark_type, [])]

@@ -212,3 +212,66 @@ def test_fixture_outbound_patches_serialize_to_pm(path):
     from peritext_tpu.bridge.bridge import editor_doc_from_crdt
 
     assert editor_doc_to_pm(editor_doc_from_crdt(observer)) == spec["expected_doc"]
+
+
+class TestPresentationSchema:
+    """The presentation half of the reference markSpec (src/schema.ts:45-96):
+    excludes and toDOM, modeled so a real PM schema can be built from
+    peritext_tpu.schema."""
+
+    def test_excludes_defaults_and_comment_override(self):
+        from peritext_tpu.schema import excludes_of
+
+        assert excludes_of("strong") == ("strong",)  # PM default: own type
+        assert excludes_of("link") == ("link",)
+        assert excludes_of("comment") == ()  # schema.ts:77 excludes: ""
+
+    def test_mark_to_dom_shapes(self):
+        from peritext_tpu.schema import mark_to_dom
+
+        assert mark_to_dom("strong") == ["strong"]
+        assert mark_to_dom("em") == ["em"]
+        a = mark_to_dom("link", {"url": "https://a"})
+        assert a[0] == "a" and a[1]["href"] == "https://a"
+        assert a[1]["style"].startswith("color: #")
+        # per-url color is deterministic and url-dependent
+        assert mark_to_dom("link", {"url": "https://a"}) == a
+        assert mark_to_dom("link", {"url": "https://b"}) != a
+        c = mark_to_dom("comment", {"id": "c1"})
+        assert c == ["span", {"data-mark": "comment", "data-comment-id": "c1"}]
+
+    def test_add_to_set_honors_excludes(self):
+        from peritext_tpu.bridge.model import _add_mark_to_map
+
+        # same-type add replaces (default excludes), other types coexist
+        m = _add_mark_to_map({}, "link", {"url": "https://old"})
+        m = _add_mark_to_map(m, "strong", None)
+        m = _add_mark_to_map(m, "link", {"url": "https://new"})
+        assert m["link"]["url"] == "https://new" and "strong" in m
+        # comments exclude nothing: they stack with themselves and others
+        m = _add_mark_to_map(m, "comment", {"id": "c1"})
+        m = _add_mark_to_map(m, "comment", {"id": "c2"})
+        assert [e["id"] for e in m["comment"]] == ["c1", "c2"]
+        assert "link" in m and "strong" in m
+
+    def test_cross_type_excludes_both_directions(self, monkeypatch):
+        """A custom spec whose excludes names ANOTHER type follows PM
+        Mark.addToSet in both directions: the new mark evicts types it
+        excludes, and an existing mark that excludes the new type rejects
+        the add."""
+        from peritext_tpu import schema
+        from peritext_tpu.bridge.model import _add_mark_to_map
+
+        spec = dict(schema.MARK_SPEC)
+        spec["strong"] = schema.MarkSchema(
+            inclusive=True, allow_multiple=False, excludes=("strong", "em"))
+        monkeypatch.setattr(schema, "MARK_SPEC", spec)
+
+        # adding strong evicts an existing em...
+        m = _add_mark_to_map({}, "em", None)
+        m = _add_mark_to_map(m, "strong", None)
+        assert "em" not in m and "strong" in m
+        # ...and an existing strong rejects a later em add
+        m2 = _add_mark_to_map({}, "strong", None)
+        m2 = _add_mark_to_map(m2, "em", None)
+        assert "em" not in m2 and "strong" in m2
