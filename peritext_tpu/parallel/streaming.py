@@ -67,6 +67,8 @@ from ..ops.resolve import resolve, resolve_jit
 from ..utils.interning import Interner, OrderedActorTable
 from .causal import causal_schedule
 from .codec import decode_frame, encode_frame
+from jax.sharding import NamedSharding, PartitionSpec as P
+
 from .mesh import convergence_digest, shard_docs
 
 @partial(jax.jit, static_argnums=1)
@@ -88,7 +90,7 @@ def _resolve_digest_jit(state: PackedDocs, comment_capacity: int, row_mask):
 @partial(jax.jit, static_argnums=1)
 def _resolve_block_digest_jit(
     state: PackedDocs, comment_capacity: int, row_mask,
-    attr_hash, comment_hash, key_hash,
+    sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
 ):
     """ONE program per block and round: span resolution (what every read
     path needs) PLUS the fused FULL-STATE convergence digest — visible text,
@@ -96,9 +98,11 @@ def _resolve_block_digest_jit(
     map-register table.  The reference's convergence oracles compare full
     formatted text (test/fuzz.ts:245-278), and cross-replica map state is
     part of the document too.  Interned identities enter only through the
-    per-session content-hash tables (``attr_hash``/``comment_hash``/
-    ``key_hash``, (D, ·) uint32), so digests are comparable across sessions
-    with different intern orders.
+    session content-hash tables (``sess_attr``/``sess_key``, flat (A,)/(K,)
+    uint32, broadcast to rows HERE — shipping a pre-broadcast (D, A) table
+    through a tunneled device link was the entire digest-stage cost) plus
+    the sparse object-path overrides (``row_map``/``obj_attr``/``obj_key``),
+    so digests are comparable across sessions with different intern orders.
 
     Returning both from one program means digest() and the read paths share
     the per-round resolution work (the block cache), and a digest-only sync
@@ -107,6 +111,16 @@ def _resolve_block_digest_jit(
     from ..ops.packed import VK_DELETED, VK_STR
     from ..ops.resolve import COMMENT_TYPE, LINK_TYPE
     from .mesh import per_doc_format_digest, per_doc_register_digest, per_doc_text_digest
+
+    d = row_map.shape[0]
+    if obj_attr.shape[0]:  # static: compiled only when object docs exist
+        safe = jnp.clip(row_map, 0, obj_attr.shape[0] - 1)
+        is_obj = (row_map >= 0)[:, None]
+        attr_hash = jnp.where(is_obj, obj_attr[safe], sess_attr[None, :])
+        key_hash = jnp.where(is_obj, obj_key[safe], sess_key[None, :])
+    else:
+        attr_hash = jnp.broadcast_to(sess_attr[None, :], (d, sess_attr.shape[0]))
+        key_hash = jnp.broadcast_to(sess_key[None, :], (d, sess_key.shape[0]))
 
     resolved = resolve(state, comment_capacity, with_comments=True)
     mask = row_mask & ~resolved.overflow
@@ -284,6 +298,9 @@ class StreamingMerge:
         #: their pre-reshard scalars back into the carry nor map their
         #: schedule-time rows through the new placement
         self._placement_epoch = 0
+        #: per-(lo, hi) device-resident digest hash tables, keyed by an
+        #: interner/placement fingerprint (see _digest_tables)
+        self._digest_tables_cache: Dict = {}
         self._actor_table = OrderedActorTable(self.actors)
         # frame-native session state (bulk path, ops/frames.parse_frames_bulk):
         # parsed-but-unscheduled changes pool as (doc_of_change, ParsedChanges)
@@ -1373,12 +1390,20 @@ class StreamingMerge:
         return _PendingDigest(self, parts, self.rounds, self._placement_epoch)
 
     def _digest_tables(self, lo: int, hi: int):
-        """Per-block (D, ·) uint32 content-hash tables for the full digest:
-        interned-id -> FNV-1a hash for link/mark attrs, per-doc dense comment
-        ids, and map keys/string-values.  Frame-mode docs share the session
-        tables (one row broadcast); object-path docs carry their per-doc
-        encoder tables; fallback rows are masked out device-side so their
-        contents are irrelevant."""
+        """Compact content-hash tables for the full digest: interned-id ->
+        FNV-1a hash for link/mark attrs, per-doc dense comment ids, and map
+        keys/string-values.
+
+        Frame-mode docs all share the SESSION tables, so those ship as flat
+        ``(A,)`` / ``(K,)`` arrays and are broadcast to rows on DEVICE — a
+        host-side ``(D, A)`` materialization was ~A*4 bytes per doc per
+        digest through the device link (128 MB/call at 2K docs x 16K attrs,
+        the whole streaming digest stage cost on a tunneled chip).  Only
+        object-path docs carry genuinely per-doc id spaces: their encoder
+        tables ride in a sparse ``(n_obj, A)`` override matrix addressed by
+        ``row_map`` (-1 = session tables).  Per-doc comment-id tables stay
+        dense — (D, comment_capacity) is small.  Fallback rows are masked
+        out device-side so their contents are irrelevant."""
         d_block = hi - lo
         sess_attr = self._frame_attrs.content_hashes()
         sess_keys = self._map_keys.content_hashes()
@@ -1390,6 +1415,22 @@ class StreamingMerge:
             if (d := int(self._doc_at[row])) >= 0
             and not self.docs[d].frame_mode and self.docs[d].encoder is not None
         }
+        # interner/placement fingerprint: tables only change when an interner
+        # grows, object-doc membership shifts, or docs move rows — reuse the
+        # device-resident copies otherwise (repeat transfers, and under a
+        # mesh the replicated device_put, are the cost being avoided here)
+        key = (
+            len(sess_attr), len(sess_keys), self._placement_epoch,
+            tuple((row, len(e.attrs.content_hashes()), len(e.keys.content_hashes()))
+                  for row, e in enc.items()),
+            tuple(sorted(
+                (d, len(t)) for d, t in self._doc_comment_ids.items()
+                if lo <= int(self._row_of[d]) < hi and self.docs[d].frame_mode
+            )),
+        )
+        cached = self._digest_tables_cache.get((lo, hi))
+        if cached is not None and cached[0] == key:
+            return cached[1]
         a_w = _width_bucket(max(
             [len(sess_attr)] + [len(e.attrs.content_hashes()) for e in enc.values()]
         ))
@@ -1397,18 +1438,23 @@ class StreamingMerge:
             [len(sess_keys)] + [len(e.keys.content_hashes()) for e in enc.values()]
         ))
         c_w = self.comment_capacity
-        attr_hash = np.zeros((d_block, a_w), np.uint32)
-        key_hash = np.zeros((d_block, k_w), np.uint32)
+        # override row count is bucketed like the widths: each new object-path
+        # doc must not mint a fresh (n_obj, ·) shape -> XLA recompile
+        n_obj_w = _width_bucket(len(enc)) if enc else 0
+        sess_attr_t = np.zeros(a_w, np.uint32)
+        sess_attr_t[: len(sess_attr)] = sess_attr
+        sess_key_t = np.zeros(k_w, np.uint32)
+        sess_key_t[: len(sess_keys)] = sess_keys
+        row_map = np.full(d_block, -1, np.int32)
+        obj_attr = np.zeros((n_obj_w, a_w), np.uint32)
+        obj_key = np.zeros((n_obj_w, k_w), np.uint32)
         comment_hash = np.zeros((d_block, c_w), np.uint32)
-        attr_hash[:, : len(sess_attr)] = sess_attr[None, :]
-        key_hash[:, : len(sess_keys)] = sess_keys[None, :]
-        for row, e in enc.items():
+        for i, (row, e) in enumerate(enc.items()):
             ah = e.attrs.content_hashes()
             kh = e.keys.content_hashes()
-            attr_hash[row - lo] = 0
-            attr_hash[row - lo, : len(ah)] = ah
-            key_hash[row - lo] = 0
-            key_hash[row - lo, : len(kh)] = kh
+            row_map[row - lo] = i
+            obj_attr[i, : len(ah)] = ah
+            obj_key[i, : len(kh)] = kh
             # object-path comment marks index the same per-doc attr interner
             comment_hash[row - lo, : min(c_w, len(ah))] = ah[:min(c_w, len(ah))]
         for d, table in self._doc_comment_ids.items():
@@ -1416,9 +1462,24 @@ class StreamingMerge:
             if lo <= row < hi and self.docs[d].frame_mode:
                 ch = table.content_hashes()
                 comment_hash[row - lo, : min(c_w, len(ch))] = ch[:min(c_w, len(ch))]
-        tables = (jnp.asarray(attr_hash), jnp.asarray(comment_hash), jnp.asarray(key_hash))
+        comment_hash_d = jnp.asarray(comment_hash)
+        row_map_d = jnp.asarray(row_map)
+        sess_attr_d = jnp.asarray(sess_attr_t)
+        sess_key_d = jnp.asarray(sess_key_t)
+        obj_attr_d = jnp.asarray(obj_attr)
+        obj_key_d = jnp.asarray(obj_key)
         if self.mesh is not None:
-            tables = shard_docs(tables, self.mesh)
+            comment_hash_d, row_map_d = shard_docs(
+                (comment_hash_d, row_map_d), self.mesh
+            )
+            repl = NamedSharding(self.mesh, P())  # session/override tables
+            sess_attr_d, sess_key_d, obj_attr_d, obj_key_d = (
+                jax.device_put(x, repl)
+                for x in (sess_attr_d, sess_key_d, obj_attr_d, obj_key_d)
+            )
+        tables = (sess_attr_d, sess_key_d, comment_hash_d, row_map_d,
+                  obj_attr_d, obj_key_d)
+        self._digest_tables_cache[(lo, hi)] = (key, tables)
         return tables
 
     # -- checkpoint support (peritext_tpu.checkpoint.save_session) ----------
