@@ -170,10 +170,17 @@ def decode_block_spans(resolved: ResolvedDocs, attr_of, comment_of, doc_mask=Non
     combinations, so the per-segment ``_segment_marks`` work collapses to a
     dict hit.  Each span still gets its OWN tiny copy: a caller mutating one
     span's marks must not silently reformat unrelated spans (ADVICE r3)."""
-    out = [[] for _ in range(np.asarray(resolved.visible).shape[0])]
-    rows, _, seg_starts, seg_ends, text, lww, link, bits, feat = _block_flat(
-        resolved, doc_mask
-    )
+    n_docs = np.asarray(resolved.visible).shape[0]
+    return _spans_from_flat(_block_flat(resolved, doc_mask), attr_of,
+                            comment_of, n_docs)
+
+
+def _spans_from_flat(flat, attr_of, comment_of, n_docs: int):
+    """Shared segment loop of the span decoders (full and compact flatten
+    feed the same tuple).  One memoized ``_segment_marks`` per distinct
+    (interners, features) key; one defensive copy per span (ADVICE r3)."""
+    out = [[] for _ in range(n_docs)]
+    rows, _, seg_starts, seg_ends, text, lww, link, bits, feat = flat
     cache: dict = {}
     for s, e in zip(seg_starts.tolist(), seg_ends.tolist()):
         d = int(rows[s])
@@ -186,24 +193,23 @@ def decode_block_spans(resolved: ResolvedDocs, attr_of, comment_of, doc_mask=Non
     return out
 
 
-def block_char_states(resolved: ResolvedDocs, elem_id_block, actor_table,
-                      attr_of, comment_of, doc_mask=None):
-    """Per-doc ``(identity, char, marks)`` lists for a whole block — the
-    batched twin of ops/patches.doc_chars_device.  Characters in a segment
-    share ONE marks dict (diff consumers compare marks by equality, and the
-    shared reference makes adjacent-equality checks O(1))."""
+def _char_states_from_flat(flat, packed_elems, actor_table, attr_of,
+                           comment_of, n_docs: int):
+    """Shared segment loop of the char-state decoders: per-doc
+    ``(identity, char, marks)`` lists.  Characters in a segment share ONE
+    per-segment marks copy (diff consumers compare marks by equality, and
+    the shared reference makes adjacent-equality checks O(1)); the memoized
+    master never escapes, so mutating one segment's marks can't reformat
+    another (ADVICE r3).  ``packed_elems`` are the (N,) packed elem ids
+    aligned with the flat character order."""
     from .packed import ACTOR_BITS, MAX_ACTORS
 
-    vis = np.asarray(resolved.visible)
-    out = [[] for _ in range(vis.shape[0])]
-    rows, cols, seg_starts, seg_ends, text, lww, link, bits, feat = _block_flat(
-        resolved, doc_mask
-    )
+    out = [[] for _ in range(n_docs)]
+    rows, _, seg_starts, seg_ends, text, lww, link, bits, feat = flat
     if len(rows) == 0:
         return out
-    packed = np.asarray(elem_id_block)[rows, cols]
-    ctrs = (packed >> ACTOR_BITS).tolist()
-    actor_idx = (packed & MAX_ACTORS).tolist()
+    ctrs = (packed_elems >> ACTOR_BITS).tolist()
+    actor_idx = (packed_elems & MAX_ACTORS).tolist()
     actor_names = [actor_table.lookup(i) for i in range(len(actor_table))]
     cache: dict = {}
     for s, e in zip(seg_starts.tolist(), seg_ends.tolist()):
@@ -213,14 +219,26 @@ def block_char_states(resolved: ResolvedDocs, elem_id_block, actor_table,
         marks = cache.get(key)
         if marks is None:
             marks = cache[key] = _segment_marks(s, lww, link, bits, attrs, comments)
-        # chars within a segment share ONE per-segment copy (adjacent
-        # equality stays O(1) identity); the memoized master never escapes,
-        # so mutating one segment's marks can't reformat another (ADVICE r3)
         seg_marks = _copy_marks(marks)
         bucket = out[d]
         for j in range(s, e):
             bucket.append(((ctrs[j], actor_names[actor_idx[j]]), text[j], seg_marks))
     return out
+
+
+def block_char_states(resolved: ResolvedDocs, elem_id_block, actor_table,
+                      attr_of, comment_of, doc_mask=None):
+    """Per-doc ``(identity, char, marks)`` lists for a whole block — the
+    batched twin of ops/patches.doc_chars_device, and the full-plane oracle
+    the compact variant is differentially tested against."""
+    vis = np.asarray(resolved.visible)
+    flat = _block_flat(resolved, doc_mask)
+    rows, cols = flat[0], flat[1]
+    if len(rows) == 0:
+        return [[] for _ in range(vis.shape[0])]
+    packed = np.asarray(elem_id_block)[rows, cols]
+    return _char_states_from_flat(flat, packed, actor_table, attr_of,
+                                  comment_of, vis.shape[0])
 
 
 def decode_doc_text(resolved: ResolvedDocs, doc_index: int) -> str:
@@ -291,3 +309,96 @@ def decode_doc_root(state, resolved: ResolvedDocs, doc_index: int, keys: Interne
         return out
 
     return build(OBJ_ROOT)
+
+
+# -- compact (visible-prefix) block decode ----------------------------------
+#
+# The full resolved planes are (D, S)-shaped over SLOT capacity; a sweep
+# transfers them host-side even though only the visible characters matter
+# (a typical streamed doc holds ~100 visible chars in 512 slots, and the
+# tunneled d2h link is the sweep's wall clock).  CompactBlock is the same
+# information gathered device-side to a visible-prefix layout of bucketed
+# width W << S, with the LWW planes bit-packed: ~5x less transfer per doc.
+
+
+class CompactBlock:
+    """Numpy visible-prefix planes of one resolved block.
+
+    ``n_vis[d]`` chars of doc ``d`` live at columns ``0..n_vis[d]`` of:
+    ``char``(int32), ``elem``(packed int32 elem ids), ``link``(int32 attr
+    ids), ``lww``(uint8 bitmask over LWW mark types), ``comment_bits``
+    ((D, Wd, W) uint32).  ``overflow`` is carried for the device/replay
+    routing mask."""
+
+    __slots__ = ("n_vis", "char", "elem", "link", "lww", "comment_bits",
+                 "overflow")
+
+    def __init__(self, n_vis, char, elem, link, lww, comment_bits, overflow):
+        self.n_vis = np.asarray(n_vis)
+        self.char = np.asarray(char)
+        self.elem = np.asarray(elem)
+        self.link = np.asarray(link)
+        self.lww = np.asarray(lww)
+        self.comment_bits = np.asarray(comment_bits)
+        self.overflow = np.asarray(overflow)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(getattr(self, f).nbytes for f in self.__slots__)
+
+
+def _block_flat_compact(c: CompactBlock, doc_mask=None):
+    """Compact-layout twin of :func:`_block_flat`: flatten to doc-major
+    visible characters + per-char features + same-mark run boundaries.
+    Columns are CHARACTER indices (the compact layout's native order), and
+    the per-type LWW bools are unpacked from the bitmask plane."""
+    d, w = c.char.shape
+    vis = np.arange(w)[None, :] < c.n_vis[:, None]
+    if doc_mask is not None:
+        vis &= np.asarray(doc_mask)[:, None]
+    rows, cols = np.nonzero(vis)
+    if len(rows) == 0:
+        return rows, cols, rows, rows, "", None, None, None, None
+    chars = c.char[rows, cols]
+    lww_bits = c.lww[rows, cols].astype(np.int64)  # (N,) packed
+    shifts = np.arange(8, dtype=np.int64)  # uint8 plane: up to 8 LWW types
+    lww = ((lww_bits[:, None] >> shifts[None, :]) & 1).astype(bool)  # (N, 8)
+    link = c.link[rows, cols]
+    bits = (
+        c.comment_bits[rows, :, cols]
+        if c.comment_bits.shape[1]
+        else np.zeros((len(rows), 0), np.uint32)
+    )  # (N, Wd) uint32
+    feat = np.concatenate(
+        [lww_bits[:, None], link[:, None].astype(np.int64), bits.astype(np.int64)],
+        axis=1,
+    )
+    boundary = np.ones(len(rows), bool)
+    boundary[1:] = (rows[1:] != rows[:-1]) | np.any(feat[1:] != feat[:-1], axis=1)
+    seg_starts = np.nonzero(boundary)[0]
+    seg_ends = np.append(seg_starts[1:], len(rows))
+    text = "".join(map(chr, chars.tolist()))
+    return rows, cols, seg_starts, seg_ends, text, lww, link, bits, feat
+
+
+def decode_block_spans_compact(c: CompactBlock, attr_of, comment_of,
+                               doc_mask=None):
+    """:func:`decode_block_spans` over a :class:`CompactBlock` — identical
+    output (tests/test_device_path.py pins compact == full on the same
+    block), one visible-prefix transfer instead of full (D, S) planes."""
+    return _spans_from_flat(_block_flat_compact(c, doc_mask), attr_of,
+                            comment_of, c.char.shape[0])
+
+
+def block_char_states_compact(c: CompactBlock, actor_table, attr_of,
+                              comment_of, doc_mask=None):
+    """:func:`block_char_states` over a :class:`CompactBlock`: per-doc
+    ``(identity, char, marks)`` lists, identities unpacked from the
+    compacted elem-id plane."""
+    flat = _block_flat_compact(c, doc_mask)
+    rows, cols = flat[0], flat[1]
+    if len(rows) == 0:
+        return [[] for _ in range(c.char.shape[0])]
+    packed = c.elem[rows, cols]
+    return _char_states_from_flat(flat, packed, actor_table, attr_of,
+                                  comment_of, c.char.shape[0])

@@ -34,6 +34,7 @@ collaborative documents", per BASELINE.json config 5.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Dict, Iterable, List, Optional, Sequence
@@ -138,6 +139,42 @@ def _resolve_block_digest_jit(
     return resolved, jnp.sum(per_doc, dtype=jnp.uint32)
 
 
+@partial(jax.jit, static_argnums=2)
+def _compact_planes_jit(resolved, elem_id, width: int):
+    """Gather a resolved block's planes to a visible-prefix layout of static
+    ``width`` columns (visible chars keep their slot order; ``n_vis[d]``
+    marks how many are real).  The LWW type planes pack to one uint8 bitmask
+    per char.  This is what sweeps transfer instead of the (D, S) planes —
+    ~5x fewer bytes per doc through the device link at typical occupancy."""
+    # uint8 bitmask plane: a 9th LWW mark type would silently vanish from
+    # every sweep read — fail the trace instead (trace-time, free at run)
+    assert resolved.lww_active.shape[1] <= 8, "lww bitmask plane is uint8"
+    order = jnp.argsort(~resolved.visible, axis=1, stable=True)[:, :width]
+    take = lambda x: jnp.take_along_axis(x, order, axis=1)  # noqa: E731
+    n_vis = jnp.sum(resolved.visible, axis=1).astype(jnp.int32)
+    lww_bits = jnp.zeros(resolved.char.shape, jnp.uint8)
+    for t in range(resolved.lww_active.shape[1]):
+        lww_bits = lww_bits | (
+            resolved.lww_active[:, t, :].astype(jnp.uint8) << t
+        )
+    words = resolved.comment_bits.shape[1]
+    comment_c = (
+        jnp.stack(
+            [take(resolved.comment_bits[:, w, :]) for w in range(words)], axis=1
+        )
+        if words
+        else jnp.zeros((resolved.char.shape[0], 0, width), jnp.uint32)
+    )
+    return (n_vis, take(resolved.char), take(elem_id),
+            take(resolved.link_attr), take(lww_bits), comment_c,
+            resolved.overflow)
+
+
+@jax.jit
+def _max_visible_jit(visible):
+    return jnp.max(jnp.sum(visible, axis=1))
+
+
 class _BlockResolution:
     """Per-(round, block) resolution artifacts: the device-side resolved
     planes, the fused full-state digest scalar, and a LAZY numpy conversion.
@@ -182,6 +219,14 @@ def _width_bucket(n: int) -> int:
     while w < n:
         w *= 2
     return w
+
+
+#: byte budget for the per-(round, epoch) CompactBlock cache — 100K docs of
+#: compacted planes is ~250 MB, comfortably inside it; sessions beyond the
+#: budget degrade to one transfer per sweep instead of one per round
+_COMPACT_CACHE_BYTES = int(
+    os.environ.get("PT_COMPACT_CACHE_BYTES", 512 * 1024 * 1024)
+)
 
 
 @dataclass
@@ -301,6 +346,10 @@ class StreamingMerge:
         #: per-(lo, hi) device-resident digest hash tables, keyed by an
         #: interner/placement fingerprint (see _digest_tables)
         self._digest_tables_cache: Dict = {}
+        #: fetched CompactBlocks for the current (round, epoch) — lets
+        #: read_all + read_patches_all share one device transfer per block
+        #: (bounded by _COMPACT_CACHE_BYTES; beyond it each sweep re-fetches)
+        self._compact_cache: tuple = ((-1, -1), {}, 0)
         self._actor_table = OrderedActorTable(self.actors)
         # frame-native session state (bulk path, ops/frames.parse_frames_bulk):
         # parsed-but-unscheduled changes pool as (doc_of_change, ParsedChanges)
@@ -1111,11 +1160,41 @@ class StreamingMerge:
             lo // self._read_chunk
         ) & ~np.asarray(resolved.overflow)[: hi - lo]
 
+    def _compact_block(self, block_index: int):
+        """Fetched visible-prefix planes of one block (ops/decode.
+        CompactBlock): the resolution's (D, S) planes gathered device-side
+        to bucketed visible-prefix width and transferred ONCE — the sweep
+        paths decode from this instead of the full planes (~5x less through
+        the device link), and a (round, epoch)-scoped byte-bounded cache
+        lets a spans sweep and a patches sweep share the transfer."""
+        from ..ops.decode import CompactBlock
+
+        stamp = (self.rounds, self._placement_epoch)
+        if self._compact_cache[0] != stamp:
+            self._compact_cache = (stamp, {}, 0)
+        _, cache, nbytes = self._compact_cache
+        hit = cache.get(block_index)
+        if hit is not None:
+            return hit
+        entry = self._resolution(block_index)
+        width = min(
+            _width_bucket(int(_max_visible_jit(entry.device.visible))),
+            self.state.slot_capacity,
+        )
+        c = CompactBlock(*_compact_planes_jit(
+            entry.device, self._state_block(block_index).elem_id, width
+        ))
+        if nbytes + c.nbytes <= _COMPACT_CACHE_BYTES:
+            cache[block_index] = c
+            self._compact_cache = (stamp, cache, nbytes + c.nbytes)
+        return c
+
     def read_all(self) -> List[List[FormatSpan]]:
         """Span sweep over every doc: device docs decode in ONE vectorized
-        pass per block (ops/decode.decode_block_spans — Python touches only
-        mark-run segments), fallback/overflow docs replay."""
-        from ..ops.decode import decode_block_spans
+        pass per block (ops/decode.decode_block_spans_compact — Python
+        touches only mark-run segments, the device link only visible-prefix
+        planes), fallback/overflow docs replay."""
+        from ..ops.decode import decode_block_spans_compact
 
         out: List[Optional[List[FormatSpan]]] = [None] * self.num_docs
         n_blocks = -(-self._padded_docs // self._read_chunk)
@@ -1124,10 +1203,12 @@ class StreamingMerge:
             docs_here = self._doc_at[lo:hi]
             if not (docs_here >= 0).any():
                 continue  # pad-only block: nothing to resolve
-            resolved = self._resolved_block(bi)
-            mask = self._block_device_mask(resolved, lo, hi)
+            compact = self._compact_block(bi)
+            mask = self._block_device_mask(compact, lo, hi)
             attr_of, comment_of = self._block_tables(lo)
-            spans = decode_block_spans(resolved, attr_of, comment_of, doc_mask=mask)
+            spans = decode_block_spans_compact(
+                compact, attr_of, comment_of, doc_mask=mask
+            )
             for local, d in enumerate(docs_here):
                 if d < 0:
                     continue
@@ -1139,10 +1220,12 @@ class StreamingMerge:
 
     def read_patches_all(self) -> List[List]:
         """Batched incremental-patch sweep: one vectorized char-state
-        extraction per block (ops/decode.block_char_states), then the per-doc
-        identity diff — config 5's async patch scatter for a whole-session
-        sweep (the per-doc ``read_patches`` stays for point reads)."""
-        from ..ops.decode import block_char_states
+        extraction per block (ops/decode.block_char_states_compact), then
+        the per-doc identity diff — config 5's async patch scatter for a
+        whole-session sweep (the per-doc ``read_patches`` stays for point
+        reads).  Shares the per-block compact transfer with read_all via
+        the (round, epoch) cache."""
+        from ..ops.decode import block_char_states_compact
         from ..ops.patches import diff_patches, doc_chars_scalar
 
         out: List[List] = [None] * self.num_docs
@@ -1152,13 +1235,11 @@ class StreamingMerge:
             docs_here = self._doc_at[lo:hi]
             if not (docs_here >= 0).any():
                 continue  # pad-only block
-            resolved = self._resolved_block(bi)
-            mask = self._block_device_mask(resolved, lo, hi)
+            compact = self._compact_block(bi)
+            mask = self._block_device_mask(compact, lo, hi)
             attr_of, comment_of = self._block_tables(lo)
-            elem_block = np.asarray(self.state.elem_id[lo:hi])
-            chars_block = block_char_states(
-                resolved, elem_block, self._actor_table, attr_of, comment_of,
-                doc_mask=mask,
+            chars_block = block_char_states_compact(
+                compact, self._actor_table, attr_of, comment_of, doc_mask=mask
             )
             for local, d in enumerate(docs_here):
                 if d < 0:

@@ -333,3 +333,40 @@ def test_comment_capacity_beyond_one_bitmask_word():
     ).merge([w])
     assert report.fallback_docs == []
     assert report.spans[0] == _oracle_doc(w).get_text_with_formatting(["text"])
+
+
+def test_compact_block_decode_matches_full_planes():
+    """The compact visible-prefix decoders are pinned against their
+    full-plane twins on the SAME resolved block (the full path is the
+    oracle the compact path's docstrings promise)."""
+    from peritext_tpu.ops.decode import (
+        block_char_states,
+        block_char_states_compact,
+        decode_block_spans,
+        decode_block_spans_compact,
+    )
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.parallel.streaming import StreamingMerge
+
+    d = 12
+    workloads = generate_workload(seed=33, num_docs=d, ops_per_doc=64)
+    s = StreamingMerge(num_docs=d, actors=("doc1", "doc2", "doc3"),
+                       slot_capacity=192)
+    for doc, w in enumerate(workloads):
+        s.ingest_frame(doc, encode_frame([c for log in w.values() for c in log]))
+    s.drain()
+
+    full = s._resolved_block(0)
+    compact = s._compact_block(0)
+    lo, hi = s._block_bounds(0)
+    mask = s._block_device_mask(full, lo, hi)
+    attr_of, comment_of = s._block_tables(lo)
+
+    assert decode_block_spans_compact(compact, attr_of, comment_of, mask) == \
+        decode_block_spans(full, attr_of, comment_of, mask)
+    elem_block = np.asarray(s.state.elem_id[lo:hi])
+    assert block_char_states_compact(
+        compact, s._actor_table, attr_of, comment_of, mask
+    ) == block_char_states(
+        full, elem_block, s._actor_table, attr_of, comment_of, mask
+    )
