@@ -185,7 +185,11 @@ def _spans_from_flat(flat, attr_of, comment_of, n_docs: int):
     for s, e in zip(seg_starts.tolist(), seg_ends.tolist()):
         d = int(rows[s])
         attrs, comments = attr_of(d), comment_of(d)
-        key = (id(attrs), id(comments), feat[s].tobytes())
+        # the per-doc comment table only shapes marks when the segment has
+        # comment bits — keying on its identity otherwise would give every
+        # doc its own memo row and defeat the cross-doc dedup entirely
+        has_c = bool(bits[s].any())
+        key = (id(attrs), id(comments) if has_c else 0, feat[s].tobytes())
         marks = cache.get(key)
         if marks is None:
             marks = cache[key] = _segment_marks(s, lww, link, bits, attrs, comments)
@@ -215,7 +219,8 @@ def _char_states_from_flat(flat, packed_elems, actor_table, attr_of,
     for s, e in zip(seg_starts.tolist(), seg_ends.tolist()):
         d = int(rows[s])
         attrs, comments = attr_of(d), comment_of(d)
-        key = (id(attrs), id(comments), feat[s].tobytes())
+        has_c = bool(bits[s].any())  # see _spans_from_flat on the memo key
+        key = (id(attrs), id(comments) if has_c else 0, feat[s].tobytes())
         marks = cache.get(key)
         if marks is None:
             marks = cache[key] = _segment_marks(s, lww, link, bits, attrs, comments)
