@@ -444,3 +444,31 @@ class TestReshard:
         assert pending.wait() == expected
         # the carry was not polluted by the pre-reshard scalars
         assert s.digest() == s.digest(refresh=True) == expected
+
+
+def test_compact_width_prior_too_small_widens_not_truncates():
+    """The sweep's packed transfer trusts a session-wide width prior and
+    must RE-FETCH wider — never silently truncate — when a live doc's
+    visible count exceeds it (streaming._finish_compact)."""
+    from peritext_tpu.parallel.codec import encode_frame
+
+    d = 6
+    workloads = generate_workload(seed=91, num_docs=d, ops_per_doc=96)
+    s = StreamingMerge(num_docs=d, actors=("doc1", "doc2", "doc3"),
+                       slot_capacity=256)
+    for doc, w in enumerate(workloads):
+        s.ingest_frame(doc, encode_frame([c for log in w.values() for c in log]))
+    s.drain()
+    oracle = oracle_merge(workloads)
+    assert any(
+        sum(len(sp["text"]) for sp in spans) > 8 for spans in oracle
+    ), "workload too small to exercise the widen path"
+
+    # poison the width cache with a floor-small prior, as a session whose
+    # first block held only tiny docs would have recorded
+    s._compact_width = {-1: 8}
+    for bi in range(-(-s._padded_docs // s._read_chunk)):
+        s._compact_width[bi] = 8
+    assert s.read_all() == oracle
+    # the refetch recorded honest widths for the next sweep
+    assert s._compact_width[-1] > 8
