@@ -88,14 +88,11 @@ def _resolve_digest_jit(state: PackedDocs, comment_capacity: int, row_mask):
     )
 
 
-@partial(jax.jit, static_argnums=1)
-def _resolve_block_digest_jit(
-    state: PackedDocs, comment_capacity: int, row_mask,
-    sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
-):
-    """ONE program per block and round: span resolution (what every read
-    path needs) PLUS the fused FULL-STATE convergence digest — visible text,
-    resolved formatting (LWW winner bits, link url, comment-id sets) and the
+def _per_doc_full_digest(state, resolved, row_mask,
+                         sess_attr, sess_key, comment_hash, row_map,
+                         obj_attr, obj_key):
+    """(D,) uint32 per-doc FULL-STATE hashes — visible text, resolved
+    formatting (LWW winner bits, link url, comment-id sets) and the
     map-register table.  The reference's convergence oracles compare full
     formatted text (test/fuzz.ts:245-278), and cross-replica map state is
     part of the document too.  Interned identities enter only through the
@@ -104,11 +101,8 @@ def _resolve_block_digest_jit(
     through a tunneled device link was the entire digest-stage cost) plus
     the sparse object-path overrides (``row_map``/``obj_attr``/``obj_key``),
     so digests are comparable across sessions with different intern orders.
-
-    Returning both from one program means digest() and the read paths share
-    the per-round resolution work (the block cache), and a digest-only sync
-    point fetches just the scalar + overflow vector — not the (D, S)
-    planes."""
+    Masked or overflowed rows contribute ZERO (their host-side replay hash
+    is summed in instead)."""
     from ..ops.packed import VK_DELETED, VK_STR
     from ..ops.resolve import COMMENT_TYPE, LINK_TYPE
     from .mesh import per_doc_format_digest, per_doc_register_digest, per_doc_text_digest
@@ -123,7 +117,6 @@ def _resolve_block_digest_jit(
         attr_hash = jnp.broadcast_to(sess_attr[None, :], (d, sess_attr.shape[0]))
         key_hash = jnp.broadcast_to(sess_key[None, :], (d, sess_key.shape[0]))
 
-    resolved = resolve(state, comment_capacity, with_comments=True)
     mask = row_mask & ~resolved.overflow
     per_doc = per_doc_text_digest(resolved.char, resolved.visible)
     per_doc = per_doc + per_doc_format_digest(
@@ -135,8 +128,95 @@ def _resolve_block_digest_jit(
         state.r_obj, state.r_key, state.r_op, state.r_kind, state.r_val,
         key_hash, VK_DELETED, VK_STR,
     )
-    per_doc = jnp.where(mask, per_doc, jnp.uint32(0))
-    return resolved, jnp.sum(per_doc, dtype=jnp.uint32)
+    return jnp.where(mask, per_doc, jnp.uint32(0))
+
+
+@partial(jax.jit, static_argnums=1)
+def _resolve_block_digest_jit(
+    state: PackedDocs, comment_capacity: int, row_mask,
+    sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
+):
+    """ONE program per block and round: span resolution (what every read
+    path needs) PLUS the (D,) per-doc full-state hash vector (see
+    :func:`_per_doc_full_digest`).  Returning both from one program means
+    digest() and the read paths share the per-round resolution work (the
+    block cache), and a digest-only sync point fetches just the per-doc
+    vector + overflow — not the (D, S) planes.  The vector (not a scalar)
+    comes back so the carried per-ROW digest plane can absorb it: later
+    rounds re-hash only the rows they touch."""
+    resolved = resolve(state, comment_capacity, with_comments=True)
+    per_doc = _per_doc_full_digest(
+        state, resolved, row_mask,
+        sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
+    )
+    return resolved, per_doc
+
+
+_GATHER_ROWS_CACHE: Dict = {}
+
+
+def _gather_rows(state: PackedDocs, rows_idx, mesh) -> PackedDocs:
+    """K-row gather along the doc axis for the touched-rows digest.
+
+    Meshless: one jitted fancy-index gather.  Mesh: an explicit shard_map —
+    each device selects the rows its shard owns (zeros elsewhere) and a
+    psum merges them — because the SPMD partitioner lowers a dynamic gather
+    from a doc-sharded operand to an ALL-GATHER of the full operand, which
+    made a 16-doc round's digest scale with total session docs.  Traffic
+    here is K x row-bytes per device, independent of D."""
+    fn = _GATHER_ROWS_CACHE.get(mesh)
+    if fn is None:
+        if mesh is None:
+            fn = jax.jit(lambda st, idx: tuple(x[idx] for x in st))
+        else:
+            from jax.experimental.shard_map import shard_map
+
+            from .mesh import DOC_AXIS
+
+            def per_shard(local, idx):
+                d_local = local[0].shape[0]
+                start = jax.lax.axis_index(DOC_AXIS) * d_local
+                rel = idx - start
+                inb = (rel >= 0) & (rel < d_local)
+                safe = jnp.clip(rel, 0, d_local - 1)
+                out = []
+                for x in local:
+                    g = x[safe]
+                    m = inb.reshape((-1,) + (1,) * (g.ndim - 1))
+                    if g.dtype == jnp.bool_:
+                        g = jax.lax.psum(
+                            jnp.where(m, g.astype(jnp.int32), 0), DOC_AXIS
+                        ).astype(jnp.bool_)
+                    else:
+                        g = jax.lax.psum(jnp.where(m, g, 0), DOC_AXIS)
+                    out.append(g)
+                return tuple(out)
+
+            fn = jax.jit(shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(P(DOC_AXIS), P()), out_specs=P(),
+            ))
+        _GATHER_ROWS_CACHE[mesh] = fn
+    return PackedDocs(*fn(tuple(state), rows_idx))
+
+
+@partial(jax.jit, static_argnums=1)
+def _rows_digest_jit(
+    sub: PackedDocs, comment_capacity: int, row_mask,
+    sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
+):
+    """Per-doc full-state hashes for a GATHERED row subset (see
+    :func:`_gather_rows`): resolve and hash only the (power-of-two
+    bucketed) rows a round touched, so the per-round digest cost scales
+    with touched docs on every platform and mesh — the block program
+    re-resolves docs/block (the whole batch, under a mesh) even for a
+    one-doc round.  Padding rows (``row_mask`` False) hash to zero."""
+    resolved = resolve(sub, comment_capacity, with_comments=True)
+    per_doc = _per_doc_full_digest(
+        sub, resolved, row_mask,
+        sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
+    )
+    return per_doc, resolved.overflow
 
 
 @partial(jax.jit, static_argnums=2)
@@ -203,28 +283,28 @@ def _max_visible_jit(visible):
 
 class _BlockResolution:
     """Per-(round, block) resolution artifacts: the device-side resolved
-    planes, the fused full-state digest scalar, and a LAZY numpy conversion.
-    Digest-only sync points fetch the scalar + overflow (a few bytes + D
-    bools); only actual span/patch reads pay the (D, S) plane transfer —
-    through a narrow device link that asymmetry is the difference between a
-    ~ms and a ~second sync."""
+    planes, the fused per-doc full-state hash vector, and a LAZY numpy
+    conversion.  Digest-only sync points fetch the hash vector + overflow
+    (D uint32 + D bools); only actual span/patch reads pay the (D, S) plane
+    transfer — through a narrow device link that asymmetry is the
+    difference between a ~ms and a ~second sync."""
 
     __slots__ = ("device", "digest_dev", "on_device", "_np", "_overflow",
-                 "_digest_int")
+                 "_digest_vec")
 
     def __init__(self, device, digest_dev, on_device):
         self.device = device
-        self.digest_dev = digest_dev
+        self.digest_dev = digest_dev  # (D,) per-doc hash vector, device
         self.on_device = on_device  # fallback mask the digest was fused with
         self._np = None
         self._overflow = None
-        self._digest_int = None
+        self._digest_vec = None
 
     @property
-    def digest(self) -> int:
-        if self._digest_int is None:
-            self._digest_int = int(np.asarray(self.digest_dev))
-        return self._digest_int
+    def digest_per_doc(self) -> np.ndarray:
+        if self._digest_vec is None:
+            self._digest_vec = np.asarray(self.digest_dev)
+        return self._digest_vec
 
     @property
     def overflow(self) -> np.ndarray:
@@ -344,19 +424,22 @@ class StreamingMerge:
         self._patch_base: Dict[int, list] = {}
         # per-round cache of numpy-resolved doc blocks: (rounds, {bi: resolved})
         self._resolved_cache = (-1, {})
-        # Incremental convergence digest (VERDICT r3 task 2): per-block
-        # digest scalars CARRIED across rounds.  A round marks dirty only
-        # the blocks whose docs it actually applied ops to, so a per-round
-        # digest sync re-resolves work proportional to TOUCHED docs — not
-        # the whole session (the r3 weak-scaling tables showed the digest
-        # stage growing linearly with total docs at fixed round size).
-        # Safety: carried entries are keyed to the block's fallback mask
-        # (any demotion invalidates on comparison) and per-doc digests are
-        # invariant under interner growth (digest tables are gathered by
-        # ids present in the doc's own rows).  digest(refresh=True) is the
+        # Incremental convergence digest (VERDICT r3 task 2): per-ROW
+        # full-state hashes CARRIED across rounds in host planes.  A round
+        # invalidates only the rows it applied ops to; digest() re-hashes
+        # heavily-dirty blocks through the fused block program (shared with
+        # the read paths) and pools the remaining dirty rows into ONE
+        # gathered sub-batch program, so the per-round digest cost scales
+        # with TOUCHED docs on every platform and mesh (a block — the whole
+        # batch, under a mesh — is never re-resolved for a one-doc round).
+        # Fallback masking happens at SUM time from current flags, so
+        # demotions never stale the carried hashes; per-doc hashes are
+        # invariant under interner growth (tables are gathered by ids
+        # present in the doc's own rows).  digest(refresh=True) is the
         # full-recompute verification path.
-        self._carried_digest: Dict[int, tuple] = {}
-        self._digest_dirty: set = set()
+        self._digest_plane = np.zeros(self._padded_docs, np.uint32)
+        self._digest_ov = np.zeros(self._padded_docs, bool)
+        self._digest_row_valid = np.zeros(self._padded_docs, bool)
         # Physical placement indirection (SURVEY §5.8(c) re-sharding):
         # logical doc d lives in device row _row_of[d]; _doc_at is the
         # inverse (-1 = empty/pad row).  Identity until reshard() moves
@@ -691,11 +774,9 @@ class StreamingMerge:
             # single-device path: ship flat streams proportional to real ops
             # and rebuild the padded layout on device (kernel._pad_from_flat)
             self.state = self._apply_compact(enc, (ki, kd, km, kp))
-        # incremental digest bookkeeping: only blocks holding rows this
-        # round wrote need their carried digest recomputed
-        self._digest_dirty.update(
-            int(b) for b in np.unique(np.nonzero(enc.num_ops)[0] // self._read_chunk)
-        )
+        # incremental digest bookkeeping: only the rows this round wrote
+        # need their carried per-row hash recomputed
+        self._digest_row_valid[np.nonzero(enc.num_ops)[0]] = False
         self.rounds += 1
         GLOBAL_COUNTERS.add("streaming.rounds")
         GLOBAL_COUNTERS.add("streaming.scheduled_changes", scheduled)
@@ -1438,39 +1519,130 @@ class StreamingMerge:
             # placement changed: every physically-keyed cache is stale, and
             # in-flight async digests must not write back (epoch guard)
             self._resolved_cache = (-1, {})
-            self._carried_digest.clear()
-            self._digest_dirty.clear()
+            self._digest_row_valid[:] = False
             self._placement_epoch += 1
         shard_load = [0] * n_shards
         for d, s in enumerate(assignment):
             shard_load[s] += int(sizes[d])
         return {"moved": moved, "shard_load": shard_load}
 
-    def _block_digest_stale(self, bi: int) -> bool:
-        carried = self._carried_digest.get(bi)
-        return not (
-            carried is not None and bi not in self._digest_dirty
-            and np.array_equal(carried[1], self._block_fallback_mask(bi))
+    def _digest_tables_rows(self, rows: np.ndarray, n_real: int):
+        """Digest hash tables for a GATHERED row subset (the sub-batch
+        program) — same shapes/semantics as :meth:`_digest_tables` but
+        row-indexed by position in ``rows``; small, so uncached.  Only the
+        first ``n_real`` positions are real (the rest is power-of-two
+        padding that repeats row 0 — its table entries must stay zero, and
+        building them would also let the padding shadow the REAL row 0);
+        everything here is O(n_real), not O(session)."""
+        k = len(rows)
+        sess_attr = self._frame_attrs.content_hashes()
+        sess_keys = self._map_keys.content_hashes()
+        enc = {}
+        for i in range(n_real):
+            d = int(self._doc_at[rows[i]])
+            if d >= 0 and not self.docs[d].frame_mode and \
+                    self.docs[d].encoder is not None:
+                enc[i] = self.docs[d].encoder
+        a_w = _width_bucket(max(
+            [len(sess_attr)] + [len(e.attrs.content_hashes()) for e in enc.values()]
+        ))
+        k_w = _width_bucket(max(
+            [len(sess_keys)] + [len(e.keys.content_hashes()) for e in enc.values()]
+        ))
+        c_w = self.comment_capacity
+        sess_attr_t = np.zeros(a_w, np.uint32)
+        sess_attr_t[: len(sess_attr)] = sess_attr
+        sess_key_t = np.zeros(k_w, np.uint32)
+        sess_key_t[: len(sess_keys)] = sess_keys
+        row_map = np.full(k, -1, np.int32)
+        obj_attr = np.zeros((_width_bucket(len(enc)) if enc else 0, a_w), np.uint32)
+        obj_key = np.zeros((obj_attr.shape[0], k_w), np.uint32)
+        comment_hash = np.zeros((k, c_w), np.uint32)
+        for j, (i, e) in enumerate(enc.items()):
+            ah = e.attrs.content_hashes()
+            kh = e.keys.content_hashes()
+            row_map[i] = j
+            obj_attr[j, : len(ah)] = ah
+            obj_key[j, : len(kh)] = kh
+            comment_hash[i, : min(c_w, len(ah))] = ah[:min(c_w, len(ah))]
+        for i in range(n_real):
+            d = int(self._doc_at[rows[i]])
+            table = self._doc_comment_ids.get(d) if d >= 0 else None
+            if table is not None and self.docs[d].frame_mode:
+                ch = table.content_hashes()
+                comment_hash[i, : min(c_w, len(ch))] = ch[:min(c_w, len(ch))]
+        return (jnp.asarray(sess_attr_t), jnp.asarray(sess_key_t),
+                jnp.asarray(comment_hash), jnp.asarray(row_map),
+                jnp.asarray(obj_attr), jnp.asarray(obj_key))
+
+    def _on_device_mask(self) -> np.ndarray:
+        """(padded,) bool: rows currently backed by device state (their doc
+        not fallback); placement goes through ``_row_of``."""
+        on_dev = np.zeros(self._padded_docs, bool)
+        for d, s in enumerate(self.docs):
+            if not s.fallback:
+                on_dev[self._row_of[d]] = True
+        return on_dev
+
+    def _schedule_rows_digest(self, rest: np.ndarray):
+        """Dispatch the gathered sub-batch hash program for dirty rows
+        ``rest`` (shared by digest() and digest_async()); returns the
+        device refs ``(per_doc_dev, ov_dev)`` — callers slice the first
+        ``len(rest)`` entries after fetching."""
+        k = _width_bucket(len(rest))
+        rows_idx = np.zeros(k, np.int32)
+        rows_idx[: len(rest)] = rest
+        mask = np.zeros(k, bool)
+        mask[: len(rest)] = True
+        sub = _gather_rows(self.state, jnp.asarray(rows_idx), self.mesh)
+        return _rows_digest_jit(
+            sub, self.comment_capacity, jnp.asarray(mask),
+            *self._digest_tables_rows(rows_idx, len(rest)),
         )
 
-    def _carried_block_digest(self, bi: int, prefetched=None):
-        """(digest, overflow) for one block via the carried store when the
-        block is clean — untouched since its digest was computed AND holding
-        the same fallback mask — else a fresh fused resolution, written back
-        to the carry.  This is what makes the per-round digest cost scale
-        with touched docs (VERDICT r3 task 2).  ``prefetched`` is an entry
-        digest()'s lookahead loop already dispatched for this block (its
-        scalar is mid-copy while the previous block's is being summed).  A
-        prefetched entry already proved the block stale — no second
-        fallback-mask rebuild here."""
-        if prefetched is None and not self._block_digest_stale(bi):
-            carried = self._carried_digest[bi]
-            return carried[0], carried[2]
-        entry = prefetched if prefetched is not None else self._digest_resolution(bi)
-        digest, ov = entry.digest, entry.overflow
-        self._carried_digest[bi] = (digest, entry.on_device, ov)
-        self._digest_dirty.discard(bi)
-        return digest, ov
+    def _refresh_digest_rows(self):
+        """Bring the carried per-row hash plane current for every on-device
+        real-doc row, re-hashing only invalid rows: heavily-dirty blocks go
+        through the fused block program (lookahead-pipelined, shared with
+        the read paths); the remaining dirty rows pool into ONE gathered
+        sub-batch program regardless of how many blocks they span."""
+        on_dev = self._on_device_mask()
+        need = ~self._digest_row_valid & on_dev & (self._doc_at >= 0)
+        if not need.any():
+            return on_dev
+        n_blocks = -(-self._padded_docs // self._read_chunk)
+        heavy = []
+        for bi in range(n_blocks):
+            lo, hi = self._block_bounds(bi)
+            if int(need[lo:hi].sum()) > (hi - lo) // 4:
+                heavy.append(bi)
+        # heavy blocks: fused resolve+hash, lookahead-1 pipelined
+        pending: Dict[int, object] = {}
+        nxt = 0
+        for j, bi in enumerate(heavy):
+            while nxt < len(heavy) and nxt <= j + 1:
+                entry = self._digest_resolution(heavy[nxt])
+                for a in (entry.digest_dev, entry.device.overflow):
+                    try:
+                        a.copy_to_host_async()
+                    except AttributeError:
+                        pass
+                pending[heavy[nxt]] = entry
+                nxt += 1
+            entry = pending.pop(bi)
+            lo, hi = self._block_bounds(bi)
+            self._digest_plane[lo:hi] = entry.digest_per_doc
+            self._digest_ov[lo:hi] = entry.overflow
+            self._digest_row_valid[lo:hi] = on_dev[lo:hi] & (self._doc_at[lo:hi] >= 0)
+            need[lo:hi] = False
+        # the long tail: one gathered sub-batch program
+        rest = np.nonzero(need)[0]
+        if len(rest):
+            per_doc_dev, ov_dev = self._schedule_rows_digest(rest)
+            self._digest_plane[rest] = np.asarray(per_doc_dev)[: len(rest)]
+            self._digest_ov[rest] = np.asarray(ov_dev)[: len(rest)]
+            self._digest_row_valid[rest] = True
+        return on_dev
 
     def digest(self, full: bool = True, refresh: bool = False) -> int:
         """Global convergence digest: with a mesh, XLA lowers the cross-doc
@@ -1495,65 +1667,47 @@ class StreamingMerge:
         a doc too large for any device row hashes consistently between
         fallback peers only.)
 
-        The digest is a doc-sum of per-doc hashes, so it is computed per
-        read-block and summed mod 2^32 — identical to the whole-batch value
-        while bounding device memory at 100K-doc scale.  Per-round cost is
-        INCREMENTAL: blocks untouched since their last digest reuse the
-        carried scalar (see :meth:`_carried_block_digest`).
-        ``refresh=True`` is the verification path: every block re-resolves
-        from current device state, ignoring (and rebuilding) the carry."""
+        The digest is a doc-sum of per-doc hashes carried in a host-side
+        per-row plane; a call re-hashes only rows invalidated since the
+        last one (see :meth:`_refresh_digest_rows`), then sums the plane
+        mod 2^32 — identical to a whole-batch recompute while keeping the
+        per-round cost proportional to touched docs.  ``refresh=True`` is
+        the verification path: every row re-hashes from current device
+        state, ignoring (and rebuilding) the carried plane."""
         from .mesh import doc_digest_host
 
         if refresh:
-            self._carried_digest.clear()
-            self._digest_dirty.clear()
+            self._digest_row_valid[:] = False
             self._resolved_cache = (-1, {})
 
-        # per-ROW device mask (doc placement goes through _row_of/_doc_at)
-        on_device_all = np.zeros(self._padded_docs, bool)
-        for d, s in enumerate(self.docs):
-            if not s.fallback:
-                on_device_all[self._row_of[d]] = True
-        total = 0
         replay_docs = [i for i, s in enumerate(self.docs) if s.fallback]
-        n_blocks = -(-self._padded_docs // self._read_chunk)
-        # lookahead-1 prefetch of stale blocks: dispatch the NEXT block's
-        # fused resolve+digest (and start its scalar/overflow device->host
-        # copies) before blocking on the current one, so per-block RPC
-        # latency overlaps the following block's device execution
-        prefetched: Dict[int, object] = {}
-        nxt = 0
-        for bi in range(n_blocks):
-            while full and nxt < n_blocks and nxt <= bi + 1:
-                if self._block_digest_stale(nxt):
-                    entry = self._digest_resolution(nxt)
-                    for a in (entry.digest_dev, entry.device.overflow):
-                        try:
-                            a.copy_to_host_async()
-                        except AttributeError:
-                            pass
-                    prefetched[nxt] = entry
-                nxt += 1
-            lo, hi = self._block_bounds(bi)
-            if full:
-                # shares the per-round block resolution with the read paths
-                # (one fused program); fetches scalar + overflow only —
-                # clean blocks skip even that via the carried digest
-                digest, ov = self._carried_block_digest(
-                    bi, prefetched=prefetched.pop(bi, None)
-                )
-            else:
+        if full:
+            on_device_all = self._refresh_digest_rows()
+            ok = (self._digest_row_valid & on_device_all & ~self._digest_ov
+                  & (self._doc_at >= 0))
+            total = int(self._digest_plane[ok].sum(dtype=np.uint32))
+            replay_docs.extend(
+                int(self._doc_at[r])
+                for r in np.nonzero(self._digest_ov & on_device_all
+                                    & (self._doc_at >= 0))[0]
+            )
+        else:
+            on_device_all = self._on_device_mask()
+            total = 0
+            n_blocks = -(-self._padded_docs // self._read_chunk)
+            for bi in range(n_blocks):
+                lo, hi = self._block_bounds(bi)
                 digest, overflow = _resolve_digest_jit(
                     self._state_block(bi), self.comment_capacity,
                     jnp.asarray(on_device_all[lo:hi]),
                 )
                 digest, ov = int(digest), np.asarray(overflow)
-            total = (total + digest) & 0xFFFFFFFF
-            replay_docs.extend(
-                int(self._doc_at[int(r) + lo])
-                for r in np.nonzero(ov & on_device_all[lo:hi])[0]
-                if int(self._doc_at[int(r) + lo]) >= 0
-            )
+                total = (total + digest) & 0xFFFFFFFF
+                replay_docs.extend(
+                    int(self._doc_at[int(r) + lo])
+                    for r in np.nonzero(ov & on_device_all[lo:hi])[0]
+                    if int(self._doc_at[int(r) + lo]) >= 0
+                )
         s_cap = self.state.slot_capacity
         for i in replay_docs:
             doc = _replay_doc(self._replay_changes(self.docs[i]))
@@ -1573,32 +1727,39 @@ class StreamingMerge:
         round-trip, and the digest overlaps the next round's host-side
         ingest parsing (VERDICT r2 weak #7).
 
-        Semantics: the device scalars snapshot the state AT SCHEDULING time
-        (the per-round block cache).  Docs that were already fallback — or
-        that the overflow vector routes to scalar replay — are hashed at
-        ``wait()`` time from their CURRENT change history, so call ``wait()``
-        before further ingestion whenever such docs exist (sessions with
-        zero fallbacks/overflows may wait at any time)."""
+        Semantics: the device hashes snapshot the state AT SCHEDULING time
+        (the per-round block cache / carried row plane).  Docs that were
+        already fallback — or that the overflow vectors route to scalar
+        replay — are hashed at ``wait()`` time from their CURRENT change
+        history, so call ``wait()`` before further ingestion whenever such
+        docs exist (sessions with zero fallbacks/overflows may wait at any
+        time)."""
+        on_dev = self._on_device_mask()
+        need = ~self._digest_row_valid & on_dev & (self._doc_at >= 0)
         parts = []
-        for bi in range(-(-self._padded_docs // self._read_chunk)):
+        n_blocks = -(-self._padded_docs // self._read_chunk)
+        for bi in range(n_blocks):
             lo, hi = self._block_bounds(bi)
-            docs_here = self._doc_at[lo:hi].copy()  # schedule-time placement
-            if not self._block_digest_stale(bi):
-                # clean block: nothing to schedule — carry the scalar
-                carried = self._carried_digest[bi]
-                parts.append((bi, lo, carried[0], carried[2], carried[1],
-                              docs_here))
-                continue
-            entry = self._digest_resolution(bi)
-            # keep ONLY the scalar + overflow device refs and the mask — not
-            # the _BlockResolution itself, whose resolved (D, S) planes would
-            # otherwise stay pinned on device across the handle's lifetime,
-            # defeating the size-2 block-cache memory bound at 100K docs
-            parts.append((
-                bi, lo, entry.digest_dev, entry.device.overflow,
-                entry.on_device, docs_here,
-            ))
-        return _PendingDigest(self, parts, self.rounds, self._placement_epoch)
+            if int(need[lo:hi].sum()) > (hi - lo) // 4:
+                entry = self._digest_resolution(bi)
+                # keep ONLY the hash-vector + overflow device refs — not
+                # the _BlockResolution itself, whose resolved (D, S) planes
+                # would otherwise stay pinned on device across the handle's
+                # lifetime, defeating the block-cache memory bound
+                parts.append(("block", lo, hi, entry.digest_dev,
+                              entry.device.overflow))
+                need[lo:hi] = False
+        rest = np.nonzero(need)[0]
+        if len(rest):
+            per_doc, ov = self._schedule_rows_digest(rest)
+            parts.append(("rows", rest, per_doc, ov))
+        snapshot = (
+            self._digest_plane.copy(), self._digest_ov.copy(),
+            self._digest_row_valid.copy(), on_dev, self._doc_at.copy(),
+            [i for i, s in enumerate(self.docs) if s.fallback],
+        )
+        return _PendingDigest(self, parts, snapshot, self.rounds,
+                              self._placement_epoch)
 
     def _digest_tables(self, lo: int, hi: int):
         """Compact content-hash tables for the full digest: interned-id ->
@@ -1787,18 +1948,22 @@ def _doc_char_slots(doc: Doc):
 class _PendingDigest:
     """Deferred digest handle from :meth:`StreamingMerge.digest_async`.
 
-    Holds references to the per-block device SCALARS and overflow vectors
-    only (safe across cache eviction, and a few bytes each — never the
-    resolved planes) plus the scheduling-time fallback masks; ``wait`` folds
-    them with host-side replay hashes exactly as ``digest()`` does, then
+    Holds references to the scheduled per-doc hash VECTORS and overflow
+    vectors only (safe across cache eviction — never the resolved planes)
+    plus a scheduling-time snapshot of the carried row plane and masks;
+    ``wait`` merges the fetched vectors into the snapshot, folds host-side
+    replay hashes exactly as ``digest()`` does, writes the fresh hashes
+    back into the live plane when no round/reshard intervened, then
     releases the device refs."""
 
-    __slots__ = ("_session", "_parts", "_value", "_stamp", "_epoch")
+    __slots__ = ("_session", "_parts", "_snapshot", "_value", "_stamp",
+                 "_epoch")
 
-    def __init__(self, session: "StreamingMerge", parts, stamp: int,
-                 epoch: int) -> None:
+    def __init__(self, session: "StreamingMerge", parts, snapshot,
+                 stamp: int, epoch: int) -> None:
         self._session = session
         self._parts = parts
+        self._snapshot = snapshot
         self._value: Optional[int] = None
         self._stamp = stamp  # session round at scheduling time
         self._epoch = epoch  # placement epoch at scheduling time
@@ -1807,27 +1972,34 @@ class _PendingDigest:
         if self._value is not None:
             return self._value
         s = self._session
-        total = 0
-        replay_docs = []
-        for bi, lo, digest_dev, overflow_dev, on_device, docs_here in self._parts:
-            if isinstance(digest_dev, int):  # carried clean-block scalar
-                digest, ov = digest_dev, overflow_dev
+        plane, ovp, valid, on_dev, doc_at, fallback_docs = self._snapshot
+        writeback = (s.rounds == self._stamp
+                     and s._placement_epoch == self._epoch)
+        for part in self._parts:
+            if part[0] == "block":
+                _, lo, hi, vec_dev, ov_dev = part
+                vec, ov = np.asarray(vec_dev), np.asarray(ov_dev)
+                rows = np.arange(lo, hi)
             else:
-                digest, ov = int(np.asarray(digest_dev)), np.asarray(overflow_dev)
-                if s.rounds == self._stamp and s._placement_epoch == self._epoch:
-                    # the fetch doubles as the carry write-back (mask
-                    # freshness is re-checked at every carried-use site);
-                    # a reshard in between makes these scalars describe rows
-                    # that no longer hold the same docs — never write back
-                    s._carried_digest[bi] = (digest, on_device, ov)
-                    s._digest_dirty.discard(bi)
-            total = (total + digest) & 0xFFFFFFFF
-            # row -> doc through the SCHEDULE-TIME placement snapshot: the
-            # scalars describe the rows as they were when scheduled
-            for local in range(len(on_device)):
-                d = int(docs_here[local])
-                if d >= 0 and (not on_device[local] or ov[local]):
-                    replay_docs.append(d)
+                _, rows, vec_dev, ov_dev = part
+                vec = np.asarray(vec_dev)[: len(rows)]
+                ov = np.asarray(ov_dev)[: len(rows)]
+            plane[rows], ovp[rows] = vec, ov
+            valid[rows] = on_dev[rows] & (doc_at[rows] >= 0)
+            if writeback:
+                # a round/reshard in between makes these hashes describe
+                # rows that no longer hold the same content — never write
+                # back then (the snapshot math above still answers for
+                # scheduling time)
+                s._digest_plane[rows] = vec
+                s._digest_ov[rows] = ov
+                s._digest_row_valid[rows] = valid[rows]
+        ok = valid & on_dev & ~ovp & (doc_at >= 0)
+        total = int(plane[ok].sum(dtype=np.uint32))
+        replay_docs = list(fallback_docs)
+        replay_docs.extend(
+            int(doc_at[r]) for r in np.nonzero(ovp & on_dev & (doc_at >= 0))[0]
+        )
         from .mesh import doc_digest_host
 
         s_cap = s.state.slot_capacity
@@ -1839,6 +2011,7 @@ class _PendingDigest:
             total = (total + part) & 0xFFFFFFFF
         self._value = total
         self._parts = ()  # release the device refs once folded
+        self._snapshot = None
         return total
 
 

@@ -139,6 +139,41 @@ def main() -> None:
         fixed_run()
         fixed_s = time.perf_counter() - t0
 
+        # ---- touched-round digest (r3 VERDICT task 2 "done" signal) ----
+        # a converged session absorbs a FIXED 16-doc round (the held-back
+        # second half of those docs' real histories, so causality holds);
+        # the incremental digest must re-resolve only the touched span, so
+        # this stage must NOT grow with the session's total docs (idle
+        # rounds are cheaper still: all carried).  Mesh sessions hold one
+        # whole-batch block, so their touched span is docs/devices — flat
+        # per device under weak scaling.
+        warm_round, held = {}, {}
+        first_frames = []
+        for i, w in enumerate(workloads):
+            ch = [c for log in w.values() for c in log]
+            if i < 16:
+                first_frames.append(encode_frame(ch[: len(ch) // 3]))
+                warm_round[i] = encode_frame(ch[len(ch) // 3: 2 * len(ch) // 3])
+                held[i] = encode_frame(ch[2 * len(ch) // 3:])
+            else:
+                first_frames.append(encode_frame(ch))
+        ts = mk()
+        ts.ingest_frames(list(enumerate(first_frames)))
+        ts.drain()
+        ts.digest()  # warm the carried row plane
+        ts.ingest_frames(list(warm_round.items()))
+        ts.drain()
+        ts.digest()  # warm the touched-rows sub-batch program (compiles)
+        ts.ingest_frames(list(held.items()))
+        ts.drain()
+        np.asarray(ts.state.num_slots)  # attribute apply to its own stage
+        t0 = time.perf_counter()
+        ts.digest()
+        touched_digest_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        ts.digest()
+        idle_digest_s = time.perf_counter() - t0
+
         # shard-count sanity: the doc axis really spans all n devices
         n_shards = len(s.state.elem_id.sharding.device_set)
         assert n_shards == n, f"expected {n} shards, got {n_shards}"
@@ -219,6 +254,8 @@ def main() -> None:
             },
             "fixed_work_seconds": round(fixed_s, 3),
             "fixed_work_ops_per_sec": round(fixed_ops / fixed_s, 1),
+            "touched_round_digest_seconds": round(touched_digest_s, 3),
+            "idle_round_digest_seconds": round(idle_digest_s, 4),
             "skewed_arrival_reshard": skew_stats,
             "probe_digest": digests[n],
         }))

@@ -309,3 +309,43 @@ def test_clean_blocks_skip_resolution_entirely():
     finally:
         StreamingMerge._digest_resolution = orig
     assert s.digest(refresh=True) == changed
+
+
+def test_touched_rows_digest_row0_comment_doc_with_padding():
+    """Regression: the gathered-rows digest pads its row-index vector with
+    zeros; the padding must never shadow the REAL row 0's comment-id
+    tables (a frame-mode comment doc at row 0, touched alone, once made
+    digest() != digest(refresh=True))."""
+    from peritext_tpu.core.doc import Doc
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.parallel.streaming import StreamingMerge
+
+    d = 12
+    frames_a, frames_b = [], []
+    for i in range(d):
+        doc = Doc(actor_id="doc1")
+        c1, _ = doc.change([
+            {"path": [], "action": "makeList", "key": "text"},
+            {"path": ["text"], "action": "insert", "index": 0,
+             "values": list(f"hello world {i}")},
+            {"path": ["text"], "action": "addMark", "startIndex": 0,
+             "endIndex": 5, "markType": "comment",
+             "attrs": {"id": f"c-{i}"}},
+        ])
+        c2, _ = doc.change([
+            {"path": ["text"], "action": "addMark", "startIndex": 6,
+             "endIndex": 11, "markType": "strong"},
+        ])
+        frames_a.append(encode_frame([c1]))
+        frames_b.append(encode_frame([c2]))
+
+    s = StreamingMerge(num_docs=d, actors=("doc1",), slot_capacity=64)
+    s.ingest_frames(list(enumerate(frames_a)))
+    s.drain()
+    s.digest()  # carried plane now covers every row
+    # touch ONLY doc 0 (physical row 0) -> sub-batch path, K=8 bucket pads
+    # seven zero entries that all alias row 0
+    s.ingest_frame(0, frames_b[0])
+    s.drain()
+    incremental = s.digest()
+    assert incremental == s.digest(refresh=True)
