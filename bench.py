@@ -633,8 +633,11 @@ def run_engine(args) -> dict:
     assert s.overflow_count() == 0, \
         f"{s.overflow_count()} docs overflowed device capacities (raise --slots/--marks)"
 
-    # replay: pre-stage everything device-side, then chain the rounds
-    state0 = empty_docs(d, args.slots, args.marks, tomb_capacity=args.slots)
+    # replay: pre-stage everything device-side, then chain the rounds.
+    # The captured rounds are _padded_docs-shaped (meshless sessions pad to
+    # a read-block multiple), so the replay state must match.
+    state0 = empty_docs(s._padded_docs, args.slots, args.marks,
+                        tomb_capacity=args.slots)
     state0 = jax.device_put(state0)
     staged = [
         ((tuple(jax.device_put(np.asarray(c)) for c in counts), ins, dels, marks, maps), widths)

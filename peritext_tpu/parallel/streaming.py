@@ -152,6 +152,15 @@ def _resolve_block_digest_jit(
     return resolved, per_doc
 
 
+@jax.jit
+def _concat_state_jit(*blocks: PackedDocs) -> PackedDocs:
+    """Reassemble block-chunked apply outputs along the doc axis (one fused
+    device program; compiled once per block count)."""
+    return PackedDocs(*(jnp.concatenate(xs, axis=0) for xs in zip(*blocks)))
+
+
+
+
 _GATHER_ROWS_CACHE: Dict = {}
 
 
@@ -410,9 +419,16 @@ class StreamingMerge:
         # Sharding needs equal shards: pad the DEVICE doc axis up to a mesh
         # multiple; padded rows are permanently empty docs (all-zero streams
         # are no-ops) and are invisible in the public API (num_docs, reads).
-        self._padded_docs = (
-            -(-num_docs // mesh.size) * mesh.size if mesh is not None else num_docs
-        )
+        # Meshless sessions larger than a read block pad to a BLOCK multiple
+        # instead, so every block-chunked program (apply, resolve, compact)
+        # compiles exactly one doc shape — a ragged tail block would mint a
+        # second XLA shape for each.
+        if mesh is not None:
+            self._padded_docs = -(-num_docs // mesh.size) * mesh.size
+        elif num_docs > read_chunk:
+            self._padded_docs = -(-num_docs // read_chunk) * read_chunk
+        else:
+            self._padded_docs = num_docs
         # reads resolve the doc axis in blocks of this size (see the
         # block-cached resolution section); meshed state is never sliced
         self._read_chunk_requested = read_chunk
@@ -462,6 +478,9 @@ class StreamingMerge:
         #: per-block visible-prefix widths (-1 = session-wide prior); see
         #: _compact_width_for
         self._compact_width: Dict[int, int] = {}
+        #: last chunked-apply output blocks (next round's inputs); None
+        #: whenever self.state was rebuilt outside _apply_compact
+        self._apply_blocks: Optional[list] = None
         self._actor_table = OrderedActorTable(self.actors)
         # frame-native session state (bulk path, ops/frames.parse_frames_bulk):
         # parsed-but-unscheduled changes pool as (doc_of_change, ParsedChanges)
@@ -728,7 +747,13 @@ class StreamingMerge:
         # (host->device transfer every round), so trickle rounds shrink them.
         # One shared power-of-two shift keeps the apply-program variant count
         # logarithmic; any doc with large pending work keeps the full widths.
-        ki, kd, km, kp = self._round_widths(pool, obj_streams, ki, kd, km, kp)
+        # Block-chunked sessions keep the widths FIXED instead: the flat
+        # streams already transfer only real ops, and at 100K-doc scale each
+        # extra (width-set x stream-bucket) shape is a multi-second XLA
+        # compile of the apply program — one shape amortizes across every
+        # block and round.
+        if self._padded_docs <= self._read_chunk:
+            ki, kd, km, kp = self._round_widths(pool, obj_streams, ki, kd, km, kp)
 
         enc = _RoundBuffers(self._padded_docs, ki, kd, km, kp)
         for i, streams in obj_streams.items():
@@ -782,41 +807,120 @@ class StreamingMerge:
         GLOBAL_COUNTERS.add("streaming.scheduled_changes", scheduled)
         return scheduled
 
+    @staticmethod
+    def _flatten_round(enc: _RoundBuffers, widths, lo: int, hi: int):
+        """Doc-major flat streams + counts for rows [lo, hi) of a round."""
+        ki, kd, km, kp = widths
+        ic, dc = enc.ins_count[lo:hi], enc.del_count[lo:hi]
+        mc, pc = enc.mark_count[lo:hi], enc.map_count[lo:hi]
+        mi = np.arange(ki, dtype=np.int32)[None, :] < ic[:, None]
+        md = np.arange(kd, dtype=np.int32)[None, :] < dc[:, None]
+        mm = np.arange(km, dtype=np.int32)[None, :] < mc[:, None]
+        mp = np.arange(kp, dtype=np.int32)[None, :] < pc[:, None]
+        return (
+            (ic, dc, mc, pc),
+            (enc.ins_ref[lo:hi][mi], enc.ins_op[lo:hi][mi], enc.ins_char[lo:hi][mi]),
+            enc.del_target[lo:hi][md],
+            {col: enc.marks[col][lo:hi][mm] for col in MARK_COLS},
+            {col: enc.map_ops[col][lo:hi][mp] for col in MAP_STREAM_COLS},
+        )
+
+    @staticmethod
+    def _pad_put(v: np.ndarray, cap: Optional[int] = None):
+        """Pow-of-two pad + ASYNC h2d: the copy streams while the host
+        parses/schedules the next block (a jit call would otherwise block
+        on each input)."""
+        if cap is None:
+            cap = _width_bucket(len(v))
+        out = np.zeros(cap, np.int32)
+        out[: len(v)] = v
+        return jax.device_put(out)
+
     def _apply_compact(self, enc: _RoundBuffers, widths) -> PackedDocs:
         """Dispatch one round via kernel.apply_batch_compact_jit: the host
         link carries flat op streams (power-of-two padded) plus per-doc
-        counts instead of the mostly-zero (D, K) staging rows."""
+        counts instead of the mostly-zero (D, K) staging rows.
+
+        Sessions larger than a read block apply BLOCK-CHUNKED: the round's
+        rows slice into read_chunk-doc blocks whose flat streams share one
+        pow-of-two bucket per stream kind, so XLA compiles ONE block-shaped
+        program reused across blocks and rounds — at 100K docs the
+        whole-batch shape cost ~22 s of XLA compile PER ROUND (stream
+        totals land in a different bucket each round) plus hundreds of MB
+        of monolithic transfer; block shapes compile once in seconds, and
+        per-block transfers overlap the next block's host flatten.  The
+        per-block states concatenate back on device (one fused program)."""
         from ..ops.kernel import apply_batch_compact_jit
 
-        ki, kd, km, kp = widths
-        mi = np.arange(ki, dtype=np.int32)[None, :] < enc.ins_count[:, None]
-        md = np.arange(kd, dtype=np.int32)[None, :] < enc.del_count[:, None]
-        mm = np.arange(km, dtype=np.int32)[None, :] < enc.mark_count[:, None]
-        mp = np.arange(kp, dtype=np.int32)[None, :] < enc.map_count[:, None]
+        d = enc.ins_count.shape[0]
+        chunk = self._read_chunk
+        if self._capture_rounds is not None or d <= chunk:
+            flat = self._flatten_round(enc, widths, 0, d)
+            counts, ins, dels, marks, maps = flat
+            round_inputs = (
+                counts,
+                tuple(self._pad_put(v) for v in ins),
+                self._pad_put(dels),
+                {c: self._pad_put(v) for c, v in marks.items()},
+                {c: self._pad_put(v) for c, v in maps.items()},
+            )
+            if self._capture_rounds is not None:
+                # engine-limit benchmarking (bench.py --mode engine): record
+                # the round's device-ready inputs so a replay can time the
+                # pure device engine with zero host parse/schedule/transfer
+                self._capture_rounds.append((round_inputs, widths))
+            # whole-batch apply rebuilds state outside the chunked path —
+            # any carried blocks describe the PREVIOUS state
+            self._apply_blocks = None
+            return apply_batch_compact_jit(self.state, *round_inputs, widths=widths)
 
-        def pad(v: np.ndarray) -> np.ndarray:
-            cap = 8
-            while cap < len(v):
-                cap *= 2
-            out = np.zeros(cap, np.int32)
-            out[: len(v)] = v
-            # async h2d: the copy streams while the host parses/schedules the
-            # next round (the jit call would otherwise block on each input)
-            return jax.device_put(out)
-
-        round_inputs = (
-            (enc.ins_count, enc.del_count, enc.mark_count, enc.map_count),
-            (pad(enc.ins_ref[mi]), pad(enc.ins_op[mi]), pad(enc.ins_char[mi])),
-            pad(enc.del_target[md]),
-            {col: pad(enc.marks[col][mm]) for col in MARK_COLS},
-            {col: pad(enc.map_ops[col][mp]) for col in MAP_STREAM_COLS},
-        )
-        if self._capture_rounds is not None:
-            # engine-limit benchmarking (bench.py --mode engine): record the
-            # round's device-ready inputs so a replay can time the pure
-            # device engine with zero host parse/schedule/transfer per round
-            self._capture_rounds.append((round_inputs, widths))
-        return apply_batch_compact_jit(self.state, *round_inputs, widths=widths)
+        n_blocks = -(-d // chunk)
+        touched = [
+            bi for bi in range(n_blocks)
+            if enc.num_ops[slice(*self._block_bounds(bi))].any()
+        ]
+        if not touched:
+            return self.state
+        flats = {
+            bi: self._flatten_round(enc, widths, *self._block_bounds(bi))
+            for bi in touched
+        }
+        # shared stream buckets: every touched block compiles to ONE shape
+        b_ins = _width_bucket(max(len(f[1][0]) for f in flats.values()))
+        b_del = _width_bucket(max(len(f[2]) for f in flats.values()))
+        b_mark = _width_bucket(max(
+            len(next(iter(f[3].values()))) for f in flats.values()
+        ))
+        b_map = _width_bucket(max(
+            len(next(iter(f[4].values()))) for f in flats.values()
+        ))
+        # block inputs come from the PREVIOUS round's outputs — steady-state
+        # rounds never slice the concatenated state (device slicing is a
+        # compile per (leaf shape, start), and a traced-start dynamic slice
+        # of the 22-leaf state compiled in ~28 s at 100K docs).  Keeping the
+        # block list alongside the concatenated state costs a second device
+        # copy of session state (~1 GB at 100K docs) — the price of never
+        # re-slicing; untouched blocks pass through by reference.
+        blocks_in = self._apply_blocks
+        if blocks_in is None:
+            blocks_in = [
+                PackedDocs(*(x[lo:hi] for x in self.state))
+                for lo, hi in (self._block_bounds(b) for b in range(n_blocks))
+            ]
+        new_blocks = list(blocks_in)
+        for bi in touched:
+            counts, ins, dels, marks, maps = flats[bi]
+            new_blocks[bi] = apply_batch_compact_jit(
+                blocks_in[bi],
+                counts,
+                tuple(self._pad_put(v, b_ins) for v in ins),
+                self._pad_put(dels, b_del),
+                {c: self._pad_put(v, b_mark) for c, v in marks.items()},
+                {c: self._pad_put(v, b_map) for c, v in maps.items()},
+                widths=widths,
+            )
+        self._apply_blocks = new_blocks
+        return _concat_state_jit(*new_blocks)
 
     def _round_widths(self, pool, obj_streams, ki: int, kd: int, km: int, kp: int):
         """Shrink this round's stream widths by a shared power-of-two shift
@@ -1520,6 +1624,7 @@ class StreamingMerge:
             # in-flight async digests must not write back (epoch guard)
             self._resolved_cache = (-1, {})
             self._digest_row_valid[:] = False
+            self._apply_blocks = None
             self._placement_epoch += 1
         shard_load = [0] * n_shards
         for d, s in enumerate(assignment):
