@@ -472,3 +472,39 @@ def test_compact_width_prior_too_small_widens_not_truncates():
     assert s.read_all() == oracle
     # the refetch recorded honest widths for the next sweep
     assert s._compact_width[-1] > 8
+
+
+def test_block_chunked_apply_matches_whole_batch():
+    """The block-chunked round apply (sessions larger than a read block,
+    incl. the padded doc axis, shared stream buckets and carried block
+    states) must produce bit-identical state to the whole-batch apply."""
+    from peritext_tpu.parallel.codec import encode_frame
+
+    d = 26  # deliberately NOT a block multiple: exercises meshless padding
+    workloads = generate_workload(seed=77, num_docs=d, ops_per_doc=72)
+    sessions = [
+        StreamingMerge(num_docs=d, actors=("doc1", "doc2", "doc3"),
+                       slot_capacity=256, read_chunk=rc)
+        for rc in (8, 1024)  # chunked (4 blocks, padded to 32) vs single
+    ]
+    for s in sessions:
+        for doc, w in enumerate(workloads):
+            ch = [c for log in w.values() for c in log]
+            s.ingest_frame(doc, encode_frame(ch[: len(ch) // 2]))
+        s.drain()
+        # second round exercises the carried-block fast path
+        for doc, w in enumerate(workloads):
+            ch = [c for log in w.values() for c in log]
+            s.ingest_frame(doc, encode_frame(ch[len(ch) // 2:]))
+        s.drain()
+    chunked, single = sessions
+    # the comparison is vacuous if docs silently demoted to scalar replay —
+    # the native block path must actually have run
+    for s in sessions:
+        assert not any(ds.fallback for ds in s.docs)
+        assert s.pending_count() == 0
+        assert s.overflow_count() == 0
+    assert chunked.digest() == single.digest()
+    assert chunked.read_all() == single.read_all()
+    oracle = oracle_merge(workloads)
+    assert single.read_all() == oracle
