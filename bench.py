@@ -55,6 +55,15 @@ WORKER_TIMEOUT = float(os.environ.get("PT_BENCH_TIMEOUT", "2700"))
 # wedged row must never cost the round its entire evidence record.
 ROW_TIMEOUT = float(os.environ.get("PT_BENCH_ROW_TIMEOUT", "900"))
 LADDER_DEADLINE = float(os.environ.get("PT_BENCH_LADDER_DEADLINE", "3600"))
+# The driver keeps only a short tail of stdout; round 4's single ~5 KB JSON
+# line outgrew it and BENCH_r04.json recorded parsed=null (VERDICT r4 weak
+# #1).  The ladder therefore prints a COMPACT summary as the LAST line —
+# hard-budgeted below — and writes the full rows to a sidecar file.
+FINAL_LINE_BUDGET = int(os.environ.get("PT_BENCH_FINAL_LINE_BUDGET", "1536"))
+SIDECAR = os.environ.get(
+    "PT_BENCH_SIDECAR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_self.json"),
+)
 
 # The probe child: initialize the default jax backend (axon plugin when the
 # tunnel is up, else cpu) AND round-trip one tiny device computation —
@@ -1032,8 +1041,69 @@ def orchestrate_ladder(args) -> int:
         "rows": rows,
         **extras,
     }
+    # Full record: sidecar file (the judge's evidence) + an early stdout line
+    # (so a human log still carries everything).  The LAST line is the
+    # compact summary the driver parses — budget enforced by compact_record
+    # and pinned by tests/test_bench_harness.py.
+    try:
+        with open(SIDECAR, "w") as fh:
+            json.dump(record, fh, indent=1)
+        record["sidecar"] = os.path.basename(SIDECAR)
+    except OSError as exc:  # unwritable sidecar dir must not cost the line
+        print(f"bench: sidecar write failed: {exc}", file=sys.stderr)
     print(json.dumps(record))
+    print(json.dumps(compact_record(record)))
     return 0 if headline or all_ok else 1
+
+
+def compact_record(record, budget=None):
+    """Shrink a full ladder record to the driver-parsed summary: headline
+    fields plus per-row ``{row, value, unit, platform, config, vs_baseline}``
+    (and failure markers), guaranteed to serialize within ``budget`` bytes
+    (VERDICT r4 task 1).  Degrades by dropping optional per-row fields, then
+    trailing rows, never the headline."""
+    budget = FINAL_LINE_BUDGET if budget is None else budget
+    head = {k: record.get(k) for k in
+            ("metric", "value", "unit", "vs_baseline", "headline_row")}
+    if record.get("failed"):
+        head["failed"] = True
+    for k in ("sidecar", "tpu_unavailable", "probe_seconds", "ladder_seconds"):
+        if k in record:
+            head[k] = record[k]
+    if "tpu_error" in record:
+        head["tpu_error"] = str(record["tpu_error"])[:160]
+
+    def row_of(r, keys):
+        out = {"row": r.get("row")}
+        for k in keys:
+            if r.get(k) is not None:
+                out[k] = r[k]
+        if r.get("failed"):
+            out["failed"] = True
+        if r.get("skipped"):
+            out["skipped"] = True
+        return out
+
+    tiers = (("value", "unit", "platform", "config", "vs_baseline"),
+             ("value", "unit", "platform"),
+             ("value",))
+    # degrade fields first (all rows kept), truncate rows only when even
+    # the slimmest field tier overflows
+    for keys in tiers:
+        out = dict(head, rows=[row_of(r, keys) for r in record.get("rows", [])])
+        if len(json.dumps(out)) <= budget:
+            return out
+    while out["rows"]:
+        out["rows"] = out["rows"][:-1]
+        out["rows_truncated"] = True
+        if len(json.dumps(out)) <= budget:
+            return out
+    head["rows"] = []
+    if len(json.dumps(head)) > budget and "tpu_error" in head:
+        head["tpu_error"] = head["tpu_error"][:40]
+        if len(json.dumps(head)) > budget:
+            del head["tpu_error"]
+    return head
 
 
 def main() -> None:
