@@ -172,7 +172,17 @@ def _gather_rows(state: PackedDocs, rows_idx, mesh) -> PackedDocs:
     psum merges them — because the SPMD partitioner lowers a dynamic gather
     from a doc-sharded operand to an ALL-GATHER of the full operand, which
     made a 16-doc round's digest scale with total session docs.  Traffic
-    here is K x row-bytes per device, independent of D."""
+    here is K x row-bytes per device, independent of D (the analytic bound
+    lives in DESIGN.md §10; tests/test_sharding.py pins the lowered HLO:
+    psum all-reduces on (K, ...) shapes only, no all-gather of the (D, ...)
+    operand)."""
+    return PackedDocs(*gather_rows_fn(mesh)(tuple(state), rows_idx))
+
+
+def gather_rows_fn(mesh):
+    """The jitted K-row gather for ``mesh`` (cached).  Exposed as a
+    function so the HLO-inspection test can ``.lower()`` exactly the
+    program :func:`_gather_rows` dispatches."""
     fn = _GATHER_ROWS_CACHE.get(mesh)
     if fn is None:
         if mesh is None:
@@ -206,7 +216,7 @@ def _gather_rows(state: PackedDocs, rows_idx, mesh) -> PackedDocs:
                 in_specs=(P(DOC_AXIS), P()), out_specs=P(),
             ))
         _GATHER_ROWS_CACHE[mesh] = fn
-    return PackedDocs(*fn(tuple(state), rows_idx))
+    return fn
 
 
 @partial(jax.jit, static_argnums=1)
