@@ -71,19 +71,28 @@ def _insert_loop(elem_id, char, n0, overflow0, ins_ref, ins_op, ins_char):
 
 
 def _append_rows(table, count, rows, rows_count):
-    """Masked scatter appending ``rows`` (dict or single array) into append-only
-    ``table`` at [count, count + rows_count); out-of-range writes drop."""
+    """Append ``rows`` (dict or single array) into append-only ``table`` at
+    [count, count + rows_count); out-of-range rows drop.
+
+    Formulated as a GATHER over the capacity axis (each table slot j takes
+    rows[j - count] when j lands in the appended range, else keeps itself)
+    rather than a scatter into the table: the vmapped scatter lowered so
+    badly on TPU that the mark-phase append dominated the whole round-apply
+    program (68 ms of an ~18 ms-floor dispatch at km=128, round-5 phase
+    attribution, scripts/apply_phase_cost.py); the gather+select is a dense
+    vectorized op."""
     single = not isinstance(table, dict)
     tables = {"_": table} if single else table
     new_rows = {"_": rows} if single else rows
     cap = next(iter(tables.values())).shape[0]
     km = next(iter(new_rows.values())).shape[0]
-    src = jnp.arange(km, dtype=jnp.int32)
-    dst = count + src
-    valid = src < rows_count
-    dst = jnp.where(valid, dst, cap)
+    j = jnp.arange(cap, dtype=jnp.int32)
+    rel = j - count
+    take = (rel >= 0) & (rel < rows_count)
+    safe = jnp.clip(rel, 0, km - 1)
     out = {
-        col: tables[col].at[dst].set(new_rows[col], mode="drop") for col in tables
+        col: jnp.where(take, new_rows[col][safe], tables[col])
+        for col in tables
     }
     overflow = count + rows_count > cap
     new_count = jnp.minimum(count + rows_count, cap)
@@ -322,6 +331,59 @@ def apply_batch_compact_jit(state, stream_counts, ins_flat, del_flat, mark_flat,
         state, stream_counts, ins_flat, del_flat, mark_flat, map_flat,
         widths=widths, insert_impl=insert_impl,
         insert_loop_slots=insert_loop_slots,
+    )
+
+
+def apply_batch_compact_rounds(
+    state: PackedDocs,
+    rounds,  # tuple of per-round (stream_counts, ins_flat, del_flat, mark_flat, map_flat)
+    *,
+    widths_seq,  # static tuple of per-round widths tuples
+    loop_slots_seq,  # static tuple of per-round insert_loop_slots
+    insert_impl: str = "auto",
+) -> PackedDocs:
+    """K causally-ordered rounds chained inside ONE program.
+
+    The axon platform charges ~11 ms per dispatch of the 21-leaf state
+    program regardless of its compute (round-5 floor probe,
+    scripts/apply_phase_cost.py --floor), so a drain with several pending
+    rounds pays K floors when each round dispatches alone.  Chaining the
+    rounds in one jit keeps per-round causal semantics bit-identical (the
+    same apply_batch_compact sequence, just traced together) and pays the
+    floor once.  Compile cache is keyed by the static
+    (widths_seq, loop_slots_seq); the scheduler's pow-2 width bucketing
+    keeps the variant count small."""
+    if not (len(rounds) == len(widths_seq) == len(loop_slots_seq)):
+        raise ValueError(
+            f"rounds/widths_seq/loop_slots_seq length mismatch: "
+            f"{len(rounds)}/{len(widths_seq)}/{len(loop_slots_seq)}"
+        )
+    for r, widths, loop_slots in zip(rounds, widths_seq, loop_slots_seq):
+        counts, ins_flat, del_flat, mark_flat, map_flat = r
+        state = apply_batch_compact(
+            state, counts, ins_flat, del_flat, mark_flat, map_flat,
+            widths=widths, insert_impl=insert_impl,
+            insert_loop_slots=loop_slots,
+        )
+    return state
+
+
+_apply_rounds_jit = jax.jit(
+    apply_batch_compact_rounds,
+    static_argnames=("widths_seq", "loop_slots_seq", "insert_impl"),
+)
+
+
+def apply_batch_compact_rounds_jit(state, rounds, *, widths_seq,
+                                   loop_slots_seq,
+                                   insert_impl: str = "auto") -> PackedDocs:
+    """jit-compiled :func:`apply_batch_compact_rounds` (``"auto"`` resolved
+    at the boundary, as in :func:`apply_batch_jit`)."""
+    if insert_impl == "auto":
+        insert_impl = resolve_insert_impl(state.elem_id)
+    return _apply_rounds_jit(
+        state, tuple(rounds), widths_seq=tuple(widths_seq),
+        loop_slots_seq=tuple(loop_slots_seq), insert_impl=insert_impl,
     )
 
 

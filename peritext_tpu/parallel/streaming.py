@@ -159,6 +159,25 @@ def _concat_state_jit(*blocks: PackedDocs) -> PackedDocs:
     return PackedDocs(*(jnp.concatenate(xs, axis=0) for xs in zip(*blocks)))
 
 
+@partial(jax.jit, static_argnums=1)
+def _split_blocks_jit(state: tuple, bounds: tuple):
+    return tuple(
+        tuple(x[lo:hi] for x in state) for lo, hi in bounds
+    )
+
+
+def _split_blocks(state: PackedDocs, bounds: tuple):
+    """Slice session state into per-block states as ONE device program.
+
+    The obvious `x[lo:hi]` per leaf per block dispatches n_blocks x 21
+    separate slice programs — ~0.1 s each through the axon tunnel, which
+    made the first chunked round's block-list construction cost ~4.5 s at
+    16K docs (round-5 ingest profile) and ~27 s at 100K.  One jitted
+    program (static bounds: compiled once per session shape) returns every
+    block in a single dispatch."""
+    return [PackedDocs(*b) for b in _split_blocks_jit(tuple(state), bounds)]
+
+
 
 
 _GATHER_ROWS_CACHE: Dict = {}
@@ -510,6 +529,14 @@ class StreamingMerge:
         # when a list, _apply_compact records each round's device-ready
         # inputs (engine-limit bench replay; see bench.py run_engine)
         self._capture_rounds: Optional[list] = None
+        # Per-ROW cumulative admitted inserts: a host-side upper bound on
+        # device slot occupancy (slots only grow, one per admitted insert;
+        # device-side convergence dedup can only make the true count
+        # smaller).  max() of it bounds the pallas insert loop's slot
+        # window (kernel insert_loop_slots) so early/steady rounds scan
+        # the occupied prefix, not the whole slot capacity.  Maintained at
+        # every admission site; reshard() permutes it with the rows.
+        self._cum_ins = np.zeros(self._padded_docs, np.int64)
         state = empty_docs(self._padded_docs, slot_capacity, mark_capacity,
                            tomb_capacity, map_capacity=map_capacity)
         self.state: PackedDocs = shard_docs(state, mesh) if mesh is not None else state
@@ -704,6 +731,18 @@ class StreamingMerge:
         dispatched asynchronously; the caller may immediately ingest and
         schedule the next round while the TPU runs this one.
         """
+        enc, widths, scheduled = self._schedule_round()
+        if scheduled:
+            self._commit_rounds([(enc, widths)])
+        return scheduled
+
+    def _schedule_round(self):
+        """The HOST half of a round: causal admission into staging buffers
+        (object-path encode + the C++ frame scheduler), width selection —
+        no device dispatch.  Returns ``(enc, widths, scheduled)``;
+        ``drain`` schedules several rounds back-to-back and commits them as
+        one fused program (the scheduling state is host-only clocks, so
+        admission never needs the previous round's device result)."""
         ki, kd, km, kp = self.round_caps
         scheduled = 0
 
@@ -751,7 +790,7 @@ class StreamingMerge:
 
         pool = self._gather_pool()
         if scheduled == 0 and pool is None:
-            return 0
+            return None, None, 0
 
         # Adaptive round widths: the (D, K) staging buffers are a real cost
         # (host->device transfer every round), so trickle rounds shrink them.
@@ -799,23 +838,63 @@ class StreamingMerge:
             scheduled += self._step_frame_docs(pool, enc, (ki, kd, km, kp))
 
         if scheduled == 0:
-            return 0
-        if self.mesh is not None:
-            # sharded path: padded (D, K) rows partition cleanly over the mesh
-            arrays = encoded_arrays_of(enc)
-            arrays = shard_docs(arrays, self.mesh)
-            self.state = apply_batch_jit(self.state, arrays)
-        else:
-            # single-device path: ship flat streams proportional to real ops
-            # and rebuild the padded layout on device (kernel._pad_from_flat)
-            self.state = self._apply_compact(enc, (ki, kd, km, kp))
-        # incremental digest bookkeeping: only the rows this round wrote
-        # need their carried per-row hash recomputed
-        self._digest_row_valid[np.nonzero(enc.num_ops)[0]] = False
-        self.rounds += 1
-        GLOBAL_COUNTERS.add("streaming.rounds")
+            return None, None, 0
         GLOBAL_COUNTERS.add("streaming.scheduled_changes", scheduled)
-        return scheduled
+        return enc, (ki, kd, km, kp), scheduled
+
+    #: max rounds chained into one fused dispatch by drain(); bounds both
+    #: the compile-cache variant space and the staged host memory
+    FUSE_MAX_ROUNDS = 8
+
+    def _commit_rounds(self, batch) -> None:
+        """The DEVICE half: dispatch scheduled rounds ``[(enc, widths),
+        ...]`` — one fused program when several rounds are pending (the
+        axon platform charges ~11 ms per dispatch of the 21-leaf state
+        program no matter its compute; see kernel
+        .apply_batch_compact_rounds) — plus the per-round digest/round
+        bookkeeping.  Mesh and block-chunked sessions commit per round
+        (their dispatch paths are shape-disciplined differently)."""
+        fuse = (
+            len(batch) > 1
+            and self.mesh is None
+            and self._padded_docs <= self._read_chunk
+        )
+        if fuse:
+            from ..ops.kernel import apply_batch_compact_rounds_jit
+
+            rounds, widths_seq, loop_seq = [], [], []
+            for enc, widths in batch:
+                self._cum_ins += enc.ins_count
+                round_inputs, loop_slots = self._device_round_inputs(
+                    enc, widths)
+                rounds.append(round_inputs)
+                widths_seq.append(widths)
+                loop_seq.append(loop_slots)
+            self._apply_blocks = None
+            self.state = apply_batch_compact_rounds_jit(
+                self.state, rounds, widths_seq=widths_seq,
+                loop_slots_seq=loop_seq)
+            for enc, _ in batch:
+                self._digest_row_valid[np.nonzero(enc.num_ops)[0]] = False
+                self.rounds += 1
+                GLOBAL_COUNTERS.add("streaming.rounds")
+            return
+        for enc, widths in batch:
+            self._cum_ins += enc.ins_count
+            if self.mesh is not None:
+                # sharded path: padded (D, K) rows partition over the mesh
+                arrays = encoded_arrays_of(enc)
+                arrays = shard_docs(arrays, self.mesh)
+                self.state = apply_batch_jit(self.state, arrays)
+            else:
+                # single-device path: flat streams proportional to real
+                # ops, padded layout rebuilt on device (_pad_from_flat)
+                self.state = self._apply_compact(enc, widths)
+            # incremental digest bookkeeping: only the rows this round
+            # wrote need their carried per-row hash recomputed
+            self._digest_row_valid[np.nonzero(enc.num_ops)[0]] = False
+            self.rounds += 1
+            GLOBAL_COUNTERS.add("streaming.rounds")
 
     @staticmethod
     def _flatten_round(enc: _RoundBuffers, widths, lo: int, hi: int):
@@ -846,6 +925,32 @@ class StreamingMerge:
         out[: len(v)] = v
         return jax.device_put(out)
 
+    def _device_round_inputs(self, enc: _RoundBuffers, widths):
+        """Whole-batch device inputs for one scheduled round: flatten, pow-2
+        pad + async h2d, slot-window bound from the (already-updated)
+        cumulative-insert plane, and the engine-bench capture hook — the
+        ONE place the fused and per-round dispatch paths share, so the
+        capture tuple and padding can never desync between them.
+        Returns ``(round_inputs, loop_slots)``."""
+        d = enc.ins_count.shape[0]
+        s_cap = int(self.state.elem_id.shape[1])
+        bound = _width_bucket(int(self._cum_ins.max()))
+        loop_slots = bound if bound < s_cap else None
+        counts, ins, dels, marks, maps = self._flatten_round(enc, widths, 0, d)
+        round_inputs = (
+            tuple(jax.device_put(np.ascontiguousarray(c)) for c in counts),
+            tuple(self._pad_put(v) for v in ins),
+            self._pad_put(dels),
+            {c: self._pad_put(v) for c, v in marks.items()},
+            {c: self._pad_put(v) for c, v in maps.items()},
+        )
+        if self._capture_rounds is not None:
+            # engine-limit benchmarking (bench.py --mode engine): record the
+            # round's device-ready inputs so a replay can time the pure
+            # device engine with zero host parse/schedule/transfer
+            self._capture_rounds.append((round_inputs, widths, loop_slots))
+        return round_inputs, loop_slots
+
     def _apply_compact(self, enc: _RoundBuffers, widths) -> PackedDocs:
         """Dispatch one round via kernel.apply_batch_compact_jit: the host
         link carries flat op streams (power-of-two padded) plus per-doc
@@ -865,24 +970,19 @@ class StreamingMerge:
         d = enc.ins_count.shape[0]
         chunk = self._read_chunk
         if self._capture_rounds is not None or d <= chunk:
-            flat = self._flatten_round(enc, widths, 0, d)
-            counts, ins, dels, marks, maps = flat
-            round_inputs = (
-                counts,
-                tuple(self._pad_put(v) for v in ins),
-                self._pad_put(dels),
-                {c: self._pad_put(v) for c, v in marks.items()},
-                {c: self._pad_put(v) for c, v in maps.items()},
-            )
-            if self._capture_rounds is not None:
-                # engine-limit benchmarking (bench.py --mode engine): record
-                # the round's device-ready inputs so a replay can time the
-                # pure device engine with zero host parse/schedule/transfer
-                self._capture_rounds.append((round_inputs, widths))
+            round_inputs, loop_slots = self._device_round_inputs(enc, widths)
             # whole-batch apply rebuilds state outside the chunked path —
             # any carried blocks describe the PREVIOUS state
             self._apply_blocks = None
-            return apply_batch_compact_jit(self.state, *round_inputs, widths=widths)
+            return apply_batch_compact_jit(self.state, *round_inputs,
+                                           widths=widths,
+                                           insert_loop_slots=loop_slots)
+        # Slot-window bound for the pallas insert loop: pow-2 bucketed so a
+        # growing session mints at most log2(S) program shapes; None once
+        # the bound reaches the slot capacity (full window).
+        s_cap = int(self.state.elem_id.shape[1])
+        bound = _width_bucket(int(self._cum_ins.max()))
+        loop_slots = bound if bound < s_cap else None
 
         n_blocks = -(-d // chunk)
         touched = [
@@ -913,10 +1013,10 @@ class StreamingMerge:
         # re-slicing; untouched blocks pass through by reference.
         blocks_in = self._apply_blocks
         if blocks_in is None:
-            blocks_in = [
-                PackedDocs(*(x[lo:hi] for x in self.state))
-                for lo, hi in (self._block_bounds(b) for b in range(n_blocks))
-            ]
+            blocks_in = _split_blocks(
+                self.state,
+                tuple(self._block_bounds(b) for b in range(n_blocks)),
+            )
         new_blocks = list(blocks_in)
         for bi in touched:
             counts, ins, dels, marks, maps = flats[bi]
@@ -928,13 +1028,39 @@ class StreamingMerge:
                 {c: self._pad_put(v, b_mark) for c, v in marks.items()},
                 {c: self._pad_put(v, b_map) for c, v in maps.items()},
                 widths=widths,
+                insert_loop_slots=loop_slots,
             )
         self._apply_blocks = new_blocks
         return _concat_state_jit(*new_blocks)
 
+    #: fraction of frame-pool docs whose whole pending need must fit the
+    #: round width; the skewed tail above it defers to later (cheap,
+    #: mostly-idle) rounds instead of inflating every doc's padded width —
+    #: the apply program's insert phase costs D x width x slot-window, so
+    #: one heavy doc at width 256 made 2,048 docs pay 4-5x the p98 width
+    #: (the measured 47x engine-vs-batch gap of VERDICT r4 task 2)
+    ROUND_WIDTH_QUANTILE = 0.98
+
     def _round_widths(self, pool, obj_streams, ki: int, kd: int, km: int, kp: int):
-        """Shrink this round's stream widths by a shared power-of-two shift
-        while every doc's pending need (clamped at the session caps) fits."""
+        """Shrink this round's stream widths by a shared power-of-two shift.
+
+        Object-path docs were already admitted at the full caps, so their
+        exact usage is a hard floor.  Frame-pool docs defer un-admitted
+        changes to the next round anyway (the C++ scheduler budgets a
+        causal prefix per doc), so their widths follow the
+        ROUND_WIDTH_QUANTILE of per-doc need — bounded below by the largest
+        single change in the pool, which guarantees every doc still admits
+        at least one change per round (no livelock, no demotion: the
+        scheduler's never-fits check sees the same floor).
+
+        Each stream kind buckets INDEPENDENTLY (round 5): the insert width
+        drives the expensive sequential phase (cost ~ ki x slot window per
+        doc), and under shuffle arrival the delete/mark backlogs grow
+        faster than the insert need (targets must exist first), so the old
+        shared power-of-two shift let a deep delete queue inflate the
+        insert width 2-4x.  Worst-case program-variant count stays small:
+        pow-2 buckets per kind, and consecutive rounds have similar
+        needs."""
         need_i = max((len(s.ins) for s in obj_streams.values()), default=0)
         need_d = max((len(s.dels) for s in obj_streams.values()), default=0)
         need_m = max((len(s.marks) for s in obj_streams.values()), default=0)
@@ -944,19 +1070,25 @@ class StreamingMerge:
             starts = np.nonzero(
                 np.concatenate([[True], doc_of[1:] != doc_of[:-1]])
             )[0]
-            need_i = max(need_i, min(ki, int(np.add.reduceat(parsed.cnt_ins, starts).max())))
-            need_d = max(need_d, min(kd, int(np.add.reduceat(parsed.cnt_del, starts).max())))
-            need_m = max(need_m, min(km, int(np.add.reduceat(parsed.cnt_mark, starts).max())))
-            need_p = max(need_p, min(kp, int(np.add.reduceat(parsed.cnt_map, starts).max())))
-        shift = 0
-        while (
-            (ki >> (shift + 1)) >= max(need_i, 8)
-            and (kd >> (shift + 1)) >= max(need_d, 8)
-            and (km >> (shift + 1)) >= max(need_m, 8)
-            and (kp >> (shift + 1)) >= max(need_p, 8)
-        ):
-            shift += 1
-        return ki >> shift, kd >> shift, km >> shift, kp >> shift
+            q = self.ROUND_WIDTH_QUANTILE
+            wants = []
+            for cap, cnt in ((ki, parsed.cnt_ins), (kd, parsed.cnt_del),
+                             (km, parsed.cnt_mark), (kp, parsed.cnt_map)):
+                per_doc = np.minimum(np.add.reduceat(cnt, starts), cap)
+                floor = int(cnt.max()) if len(cnt) else 0  # largest single change
+                want = max(floor,
+                           int(np.quantile(per_doc, q)) if len(per_doc) else 0)
+                wants.append(min(cap, want))
+            need_i = max(need_i, wants[0])
+            need_d = max(need_d, wants[1])
+            need_m = max(need_m, wants[2])
+            need_p = max(need_p, wants[3])
+        return (
+            min(ki, _width_bucket(max(need_i, 8))),
+            min(kd, _width_bucket(max(need_d, 8))),
+            min(km, _width_bucket(max(need_m, 8))),
+            min(kp, _width_bucket(max(need_p, 8))),
+        )
 
     def _gather_pool(self):
         """Merge pooled parsed-change chunks into one doc-grouped batch:
@@ -1098,10 +1230,25 @@ class StreamingMerge:
         return scheduled
 
     def drain(self, max_rounds: int = 1_000) -> int:
-        """Step until no pending change is admissible; returns rounds run."""
+        """Step until no pending change is admissible; returns rounds run.
+
+        Scheduling is host-only (causal clocks), so drain schedules every
+        pending round FIRST and commits them as one fused device program
+        (up to FUSE_MAX_ROUNDS per dispatch) — a deep queue pays the
+        ~11 ms/dispatch platform floor once instead of once per round."""
         rounds = 0
-        while rounds < max_rounds and self.step() > 0:
-            rounds += 1
+        while rounds < max_rounds:
+            batch = []
+            while (len(batch) < self.FUSE_MAX_ROUNDS
+                   and rounds + len(batch) < max_rounds):
+                enc, widths, scheduled = self._schedule_round()
+                if not scheduled:
+                    break
+                batch.append((enc, widths))
+            if not batch:
+                break
+            self._commit_rounds(batch)
+            rounds += len(batch)
         return rounds
 
     @staticmethod
@@ -1174,7 +1321,16 @@ class StreamingMerge:
         lo, hi = self._block_bounds(block_index)
         if lo == 0 and hi == self._padded_docs:
             return self.state
-        return PackedDocs(*(x[lo:hi] for x in self.state))
+        if self._apply_blocks is None:
+            # one dispatch splits EVERY block (and the list is kept: it is
+            # exactly the "blocks match state" invariant _apply_compact
+            # maintains), instead of 21 per-leaf slice programs per block
+            n_blocks = -(-self._padded_docs // self._read_chunk)
+            self._apply_blocks = _split_blocks(
+                self.state,
+                tuple(self._block_bounds(b) for b in range(n_blocks)),
+            )
+        return self._apply_blocks[block_index]
 
     def _block_fallback_mask(self, block_index: int) -> np.ndarray:
         """(block,) bool: rows currently served by the device (a real doc's
@@ -1627,6 +1783,7 @@ class StreamingMerge:
             idx = jnp.asarray(src)
             state = PackedDocs(*(jnp.take(x, idx, axis=0) for x in self.state))
             self.state = shard_docs(state, self.mesh) if self.mesh is not None else state
+            self._cum_ins = self._cum_ins[src]  # occupancy bound rides the rows
             self._row_of = new_row
             self._doc_at = np.full(self._padded_docs, -1, np.int64)
             self._doc_at[new_row] = np.arange(self.num_docs)

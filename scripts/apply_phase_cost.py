@@ -1,0 +1,119 @@
+"""Per-phase cost of the round-apply program (round 5).
+
+Times apply_batch_compact_jit at 2048x384 with one stream width raised at
+a time (others at the 8 floor), steady-state (8 chained dispatches, one
+sync), so the expensive phase is measured rather than guessed.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from peritext_tpu.ops.encode import MARK_COLS
+    from peritext_tpu.ops.kernel import apply_batch_compact_jit
+    from peritext_tpu.ops.packed import MAP_STREAM_COLS, empty_docs
+
+    docs, slots, marks = 2048, 384, 96
+    base = jax.device_put(empty_docs(docs, slots, marks, tomb_capacity=slots))
+
+    def timed(widths, loop_slots, counts_v):
+        ki, kd, km, kp = widths
+        n_i = np.full(docs, counts_v[0], np.int32)
+        n_d = np.full(docs, counts_v[1], np.int32)
+        n_m = np.full(docs, counts_v[2], np.int32)
+        n_p = np.full(docs, counts_v[3], np.int32)
+        counts = tuple(jax.device_put(x) for x in (n_i, n_d, n_m, n_p))
+        ins = tuple(jax.device_put(np.zeros(max(int(n_i.sum()), 1), np.int32))
+                    for _ in range(3))
+        dels = jax.device_put(np.zeros(max(int(n_d.sum()), 1), np.int32))
+        mk = {c: jax.device_put(np.zeros(max(int(n_m.sum()), 1), np.int32))
+              for c in MARK_COLS}
+        mp = {c: jax.device_put(np.zeros(max(int(n_p.sum()), 1), np.int32))
+              for c in MAP_STREAM_COLS}
+
+        def one(st):
+            return apply_batch_compact_jit(
+                st, counts, ins, dels, mk, mp, widths=widths,
+                insert_loop_slots=loop_slots)
+
+        st = one(base)
+        np.asarray(st.num_slots)
+        reps = 8
+        t0 = time.perf_counter()
+        st = base
+        for _ in range(reps):
+            st = one(st)
+        np.asarray(st.num_slots)
+        return (time.perf_counter() - t0) / reps
+
+    floor = (8, 8, 8, 8)
+    print(f"floor {floor} win=64:      {timed(floor, 64, (4,2,2,1))*1e3:7.2f} ms")
+    print(f"ins   (128,8,8,8) win=128: {timed((128,8,8,8), 128, (64,2,2,1))*1e3:7.2f} ms")
+    print(f"ins   (128,8,8,8) win=384: {timed((128,8,8,8), None, (64,2,2,1))*1e3:7.2f} ms")
+    print(f"del   (8,128,8,8) win=64:  {timed((8,128,8,8), 64, (4,64,2,1))*1e3:7.2f} ms")
+    print(f"mark  (8,8,128,8) win=64:  {timed((8,8,128,8), 64, (4,2,64,1))*1e3:7.2f} ms")
+    print(f"map   (8,8,8,16)  win=64:  {timed((8,8,8,16), 64, (4,2,2,8))*1e3:7.2f} ms")
+    print(f"r3mix (128,128,128,8) win=128: {timed((128,128,128,8), 128, (64,32,32,1))*1e3:7.2f} ms")
+
+
+if __name__ == "__main__" and "--floor" not in sys.argv:
+    main()
+
+
+def floor_probe():
+    """What is the ~18 ms per-program floor made of?"""
+    import jax
+    import jax.numpy as jnp
+
+    from peritext_tpu.ops.encode import MARK_COLS
+    from peritext_tpu.ops.kernel import apply_batch_compact_jit
+    from peritext_tpu.ops.packed import MAP_STREAM_COLS, empty_docs
+
+    docs, slots, marks = 2048, 384, 96
+    base = jax.device_put(empty_docs(docs, slots, marks, tomb_capacity=slots))
+
+    def steady(fn, reps=8):
+        st = fn(base)
+        np.asarray(st.num_slots)
+        t0 = time.perf_counter()
+        st = base
+        for _ in range(reps):
+            st = fn(st)
+        np.asarray(st.num_slots)
+        return (time.perf_counter() - t0) / reps
+
+    ident = jax.jit(lambda st: st._replace(num_slots=st.num_slots + 1))
+    print(f"identity(+1 on counts):      {steady(ident)*1e3:7.2f} ms")
+    touch = jax.jit(lambda st: st._replace(
+        elem_id=st.elem_id + 1, char=st.char + 1,
+        num_slots=st.num_slots + 1))
+    print(f"touch elem+char planes:      {steady(touch)*1e3:7.2f} ms")
+    touch_all = jax.jit(lambda st: type(st)(*(x + 1 if x.dtype != jnp.bool_
+                                              else x for x in st)))
+    print(f"touch ALL planes:            {steady(touch_all)*1e3:7.2f} ms")
+
+    widths, loop_slots, cv = (8, 8, 8, 8), 64, (4, 2, 2, 1)
+    ki, kd, km, kp = widths
+    n_i = np.full(docs, cv[0], np.int32); n_d = np.full(docs, cv[1], np.int32)
+    n_m = np.full(docs, cv[2], np.int32); n_p = np.full(docs, cv[3], np.int32)
+    counts = tuple(jax.device_put(x) for x in (n_i, n_d, n_m, n_p))
+    ins = tuple(jax.device_put(np.zeros(int(n_i.sum()), np.int32)) for _ in range(3))
+    dels = jax.device_put(np.zeros(int(n_d.sum()), np.int32))
+    mk = {c: jax.device_put(np.zeros(int(n_m.sum()), np.int32)) for c in MARK_COLS}
+    mp = {c: jax.device_put(np.zeros(int(n_p.sum()), np.int32)) for c in MAP_STREAM_COLS}
+    for impl in ("pallas", "lax"):
+        fn = lambda st: apply_batch_compact_jit(
+            st, counts, ins, dels, mk, mp, widths=widths,
+            insert_loop_slots=loop_slots, insert_impl=impl)
+        print(f"floor apply impl={impl:18s}{steady(fn)*1e3:7.2f} ms")
+
+
+if __name__ == "__main__" and "--floor" in sys.argv:
+    floor_probe()

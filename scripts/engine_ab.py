@@ -1,0 +1,106 @@
+"""Same-run A/B: unfused vs fused round replay (chip load swamps
+cross-run absolutes, so variants interleave in ONE process and report
+min-of-N each)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+
+def main(docs=2048, rounds=4, opd=192):
+    import jax
+    import jax.numpy as jnp
+
+    from bench import build_arrival
+    from peritext_tpu.ops.kernel import (
+        apply_batch_compact_jit, apply_batch_compact_rounds_jit,
+    )
+    from peritext_tpu.ops.packed import empty_docs
+    from peritext_tpu.parallel.streaming import (
+        StreamingMerge, _resolve_block_digest_jit,
+    )
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    workloads = generate_workload(seed=0, num_docs=docs, ops_per_doc=opd)
+    arrival, _ = build_arrival(workloads, rounds, 0)
+    captured = []
+    s = StreamingMerge(
+        num_docs=docs, actors=("doc1", "doc2", "doc3"),
+        slot_capacity=384, mark_capacity=96, tomb_capacity=384,
+        round_insert_capacity=256, round_delete_capacity=128,
+        round_mark_capacity=128,
+    )
+    s._capture_rounds = captured
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        s.ingest_frames((doc, b[r]) for doc, b in enumerate(arrival)
+                        if r < len(b))
+        s.drain()
+    expected = s.digest()
+    print(f"live session (capture on): {time.perf_counter()-t0:.2f}s, "
+          f"{len(captured)} rounds captured")
+
+    state0 = jax.device_put(
+        empty_docs(s._padded_docs, 384, 96, tomb_capacity=384))
+    staged = [
+        ((tuple(jax.device_put(np.asarray(c)) for c in counts),
+          ins, dels, mk, mp), widths, ls)
+        for (counts, ins, dels, mk, mp), widths, ls in captured
+    ]
+    tables = s._digest_tables(0, s._padded_docs)
+    row_mask = jnp.ones(s._padded_docs, bool)
+
+    def digest_of(st):
+        _, per_doc = _resolve_block_digest_jit(
+            st, s.comment_capacity, row_mask, *tables)
+        return int(np.asarray(per_doc).sum(dtype=np.uint32))
+
+    def unfused():
+        st = state0
+        for (c, i, dl, mk, mp), w, ls in staged:
+            st = apply_batch_compact_jit(st, c, i, dl, mk, mp, widths=w,
+                                         insert_loop_slots=ls)
+        return st
+
+    def fused():
+        return apply_batch_compact_rounds_jit(
+            state0, [r[0] for r in staged],
+            widths_seq=[r[1] for r in staged],
+            loop_slots_seq=[r[2] for r in staged])
+
+    assert digest_of(unfused()) == expected
+    assert digest_of(fused()) == expected
+
+    res = {"unfused": [], "fused": []}
+    for _ in range(4):
+        for name, fn in (("unfused", unfused), ("fused", fused)):
+            t0 = time.perf_counter()
+            dg = digest_of(fn())
+            res[name].append(time.perf_counter() - t0)
+            assert dg == expected
+    for name, ts in res.items():
+        print(f"{name}: min {min(ts)*1e3:7.1f} ms  all "
+              f"{[round(t*1e3) for t in ts]}")
+
+    # live session again, capture off (the fused drain path), same process
+    t0 = time.perf_counter()
+    s2 = StreamingMerge(
+        num_docs=docs, actors=("doc1", "doc2", "doc3"),
+        slot_capacity=384, mark_capacity=96, tomb_capacity=384,
+        round_insert_capacity=256, round_delete_capacity=128,
+        round_mark_capacity=128,
+    )
+    for r in range(rounds):
+        s2.ingest_frames((doc, b[r]) for doc, b in enumerate(arrival)
+                         if r < len(b))
+        s2.drain()
+    assert s2.digest() == expected
+    print(f"live session (fused drain, warm compiles): "
+          f"{time.perf_counter()-t0:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Stage attribution for the engine-limit streaming row (VERDICT r4 task 2).
+
+Replays the captured rounds exactly as bench.py --mode engine does, but
+times the apply chain and the digest program separately (each behind its
+own sync), and sweeps round depth x docs to locate the fixed-cost knee.
+Run on the chip:  python scripts/engine_profile.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+
+def measure(docs, rounds, ops_per_doc, slots=384, marks=96, passes=3,
+            profile_dir=None):
+    import jax
+    import jax.numpy as jnp
+
+    from bench import build_arrival
+    from peritext_tpu.ops.kernel import apply_batch_compact_jit
+    from peritext_tpu.ops.packed import empty_docs
+    from peritext_tpu.parallel.streaming import (
+        StreamingMerge, _resolve_block_digest_jit,
+    )
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    workloads = generate_workload(seed=0, num_docs=docs, ops_per_doc=ops_per_doc)
+    arrival, _ = build_arrival(workloads, rounds, 0)
+
+    captured = []
+    s = StreamingMerge(
+        num_docs=docs, actors=("doc1", "doc2", "doc3"),
+        slot_capacity=slots, mark_capacity=marks, tomb_capacity=slots,
+        round_insert_capacity=256, round_delete_capacity=128,
+        round_mark_capacity=128,
+    )
+    s._capture_rounds = captured
+    for r in range(rounds):
+        s.ingest_frames((doc, batches[r]) for doc, batches in enumerate(arrival)
+                        if r < len(batches))
+        s.drain()
+    expected = s.digest()
+    assert s.overflow_count() == 0
+
+    state0 = jax.device_put(
+        empty_docs(s._padded_docs, slots, marks, tomb_capacity=slots))
+    staged = [
+        ((tuple(jax.device_put(np.asarray(c)) for c in counts),
+          ins, dels, mk, mp), widths, loop_slots)
+        for (counts, ins, dels, mk, mp), widths, loop_slots in captured
+    ]
+    tables = s._digest_tables(0, s._padded_docs)
+    row_mask = jnp.ones(s._padded_docs, bool)
+
+    def apply_chain():
+        st = state0
+        for (counts, ins, dels, mk, mp), widths, loop_slots in staged:
+            st = apply_batch_compact_jit(st, counts, ins, dels, mk, mp,
+                                         widths=widths,
+                                         insert_loop_slots=loop_slots)
+        return st
+
+    def digest_of(st):
+        _, per_doc = _resolve_block_digest_jit(
+            st, s.comment_capacity, row_mask, *tables)
+        return int(np.asarray(per_doc).sum(dtype=np.uint32))
+
+    # warm
+    st = apply_chain()
+    assert digest_of(st) == expected
+
+    apply_t, digest_t, total_t = [], [], []
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        st = apply_chain()
+        jax.block_until_ready(st.char)
+        t1 = time.perf_counter()
+        dg = digest_of(st)
+        t2 = time.perf_counter()
+        apply_t.append(t1 - t0)
+        digest_t.append(t2 - t1)
+        # combined single-sync (the bench row's definition)
+        t0 = time.perf_counter()
+        dg = digest_of(apply_chain())
+        total_t.append(time.perf_counter() - t0)
+    assert dg == expected
+
+    if profile_dir:
+        import jax.profiler
+        with jax.profiler.trace(profile_dir):
+            digest_of(apply_chain())
+
+    total_ops = sum(len(ch.ops) for w in workloads for log in w.values()
+                    for ch in log)
+    n_staged = len(staged)
+    return dict(docs=docs, rounds=rounds, staged_rounds=n_staged,
+                ops=total_ops,
+                apply_s=round(min(apply_t), 4),
+                apply_per_round_ms=round(1e3 * min(apply_t) / n_staged, 2),
+                digest_s=round(min(digest_t), 4),
+                total_s=round(min(total_t), 4),
+                ops_per_sec=round(total_ops / min(total_t), 1))
+
+
+if __name__ == "__main__":
+    shapes = [(2048, 4, 192)]
+    if "--sweep" in sys.argv:
+        shapes = [
+            (2048, 4, 192),   # the bench shape
+            (2048, 1, 192),   # one big round: all ops in a single apply
+            (2048, 2, 192),
+            (2048, 8, 192),
+            (2048, 16, 192),
+            (512, 4, 192),
+            (8192, 4, 192),
+        ]
+    prof = "--profile" in sys.argv
+    for docs, rounds, opd in shapes:
+        r = measure(docs, rounds, opd,
+                    profile_dir="/tmp/engine_trace" if prof else None)
+        print(r)

@@ -1,0 +1,122 @@
+"""Fine-grained engine attribution with HONEST syncs (np.asarray fetch;
+block_until_ready does not block on the axon platform).
+
+Measures: bare sync RTT, each staged round-apply individually, the chained
+applies, and the digest program — so the 0.35 s engine pass decomposes into
+launch/compute/sync terms instead of guesses.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+
+def main(docs=2048, rounds=4, opd=192):
+    import jax
+    import jax.numpy as jnp
+
+    from bench import build_arrival
+    from peritext_tpu.ops.kernel import apply_batch_compact_jit
+    from peritext_tpu.ops.packed import empty_docs
+    from peritext_tpu.parallel.streaming import (
+        StreamingMerge, _resolve_block_digest_jit,
+    )
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    workloads = generate_workload(seed=0, num_docs=docs, ops_per_doc=opd)
+    arrival, _ = build_arrival(workloads, rounds, 0)
+    captured = []
+    s = StreamingMerge(
+        num_docs=docs, actors=("doc1", "doc2", "doc3"),
+        slot_capacity=384, mark_capacity=96, tomb_capacity=384,
+        round_insert_capacity=256, round_delete_capacity=128,
+        round_mark_capacity=128,
+    )
+    s._capture_rounds = captured
+    for r in range(rounds):
+        s.ingest_frames((doc, b[r]) for doc, b in enumerate(arrival)
+                        if r < len(b))
+        s.drain()
+    expected = s.digest()
+
+    state0 = jax.device_put(
+        empty_docs(s._padded_docs, 384, 96, tomb_capacity=384))
+    staged = [
+        ((tuple(jax.device_put(np.asarray(c)) for c in counts),
+          ins, dels, mk, mp), widths, loop_slots)
+        for (counts, ins, dels, mk, mp), widths, loop_slots in captured
+    ]
+    print("round widths:", [(w, ls) for _, w, ls in staged])
+    tables = s._digest_tables(0, s._padded_docs)
+    row_mask = jnp.ones(s._padded_docs, bool)
+
+    def sync(st):
+        return np.asarray(st.num_slots if hasattr(st, "num_slots") else st)
+
+    # warm every executable
+    st = state0
+    for (c, i, dl, mk, mp), w, ls in staged:
+        st = apply_batch_compact_jit(st, c, i, dl, mk, mp, widths=w,
+                                     insert_loop_slots=ls)
+    sync(st)
+    resolved, per_doc = _resolve_block_digest_jit(
+        st, s.comment_capacity, row_mask, *tables)
+    assert int(np.asarray(per_doc).sum(dtype=np.uint32)) == expected
+
+    # bare sync RTT on an already-materialized tiny array
+    tiny = jax.jit(lambda x: x + 1)(jnp.zeros(8, jnp.int32))
+    np.asarray(tiny)
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(tiny)
+        rtts.append(time.perf_counter() - t0)
+    print(f"bare fetch of ready tiny array: {min(rtts)*1e3:.1f} ms")
+    rtts = []
+    for _ in range(5):
+        y = jax.jit(lambda x: x + 1)(tiny)
+        t0 = time.perf_counter()
+        np.asarray(y)
+        rtts.append(time.perf_counter() - t0)
+    print(f"dispatch+fetch tiny:            {min(rtts)*1e3:.1f} ms")
+
+    # each staged round individually, honest sync
+    for k, ((c, i, dl, mk, mp), w, ls) in enumerate(staged):
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = apply_batch_compact_jit(state0, c, i, dl, mk, mp, widths=w,
+                                          insert_loop_slots=ls)
+            sync(out)
+            ts.append(time.perf_counter() - t0)
+        print(f"round {k} apply (dispatch+sync): {min(ts)*1e3:7.1f} ms  "
+              f"widths={w}")
+
+    # chained applies, single sync
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        st = state0
+        for (c, i, dl, mk, mp), w, ls in staged:
+            st = apply_batch_compact_jit(st, c, i, dl, mk, mp, widths=w,
+                                         insert_loop_slots=ls)
+        sync(st)
+        ts.append(time.perf_counter() - t0)
+    print(f"chained {len(staged)} applies + sync:   {min(ts)*1e3:7.1f} ms")
+
+    # digest alone on the converged state
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _, per_doc = _resolve_block_digest_jit(
+            st, s.comment_capacity, row_mask, *tables)
+        np.asarray(per_doc)
+        ts.append(time.perf_counter() - t0)
+    print(f"digest (dispatch+sync):         {min(ts)*1e3:7.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

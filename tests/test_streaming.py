@@ -508,3 +508,42 @@ def test_block_chunked_apply_matches_whole_batch():
     assert chunked.read_all() == single.read_all()
     oracle = oracle_merge(workloads)
     assert single.read_all() == oracle
+
+
+def test_cum_ins_upper_bounds_device_occupancy():
+    """The host-side cumulative-insert plane must upper-bound every row's
+    device slot occupancy after any mix of rounds, duplicates and a
+    reshard — it feeds the pallas insert loop's static slot window
+    (kernel insert_loop_slots), where an under-bound would corrupt
+    inserts on TPU (round 5; CPU uses the lax path, so this pins the
+    INVARIANT, not the kernel)."""
+    import numpy as np
+
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.parallel.streaming import StreamingMerge
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    workloads = generate_workload(seed=13, num_docs=12, ops_per_doc=60)
+    s = StreamingMerge(
+        num_docs=12, actors=("doc1", "doc2", "doc3"),
+        slot_capacity=256, mark_capacity=96, tomb_capacity=96,
+        round_insert_capacity=32, round_delete_capacity=16,
+        round_mark_capacity=16,
+    )
+    for doc, w in enumerate(workloads):
+        ch = [c for log in w.values() for c in log]
+        s.ingest_frame(doc, encode_frame(ch[: len(ch) // 2]))
+        # duplicate delivery: dedup happens device-side, the bound may
+        # only over-count
+        s.ingest_frame(doc, encode_frame(ch[: len(ch) // 2]))
+    s.drain()
+    for doc, w in enumerate(workloads):
+        ch = [c for log in w.values() for c in log]
+        s.ingest_frame(doc, encode_frame(ch[len(ch) // 2:]))
+    s.drain()
+    slots = np.asarray(s.state.num_slots)
+    assert (s._cum_ins >= slots).all(), (s._cum_ins, slots)
+    s.reshard()
+    slots = np.asarray(s.state.num_slots)
+    assert (s._cum_ins >= slots).all(), "bound must ride the reshard permute"
+    assert s.pending_count() == 0
