@@ -220,8 +220,16 @@ def run(args) -> dict:
     sync(result)
     compile_time = time.perf_counter() - compile_start
 
-    # Single-call wall time includes the platform's fixed dispatch latency
-    # (~tens of ms through the axon tunnel); report it separately.
+    # single_call_seconds DEFINITION (stable across rounds; VERDICT r4 task
+    # 7): wall time of ONE whole-batch apply dispatch through to a host
+    # sync on a small output — i.e. per-op latency, = apply compute + the
+    # platform's fixed dispatch+sync round trip.  Through the axon tunnel
+    # that fixed term measured 0.08-0.11 s round 5 (scripts/
+    # engine_profile2.py, dispatch+fetch of an 8-int program), and it
+    # varies with tunnel load — so this field tracks LINK latency, while
+    # apply_seconds (back-to-back enqueue, one sync) tracks the chip.  The
+    # r2->r4 drift 0.032->0.149 s was the tunnel term, not a kernel
+    # regression: apply_seconds held 0.032->0.037 across the same rounds.
     t0 = time.perf_counter()
     sync(apply_jit(state0, ops_dev))
     single_call = time.perf_counter() - t0
@@ -581,24 +589,32 @@ def orchestrate(args, passthrough) -> int:
 
 
 def run_engine(args) -> dict:
-    """Engine-limit streaming measurement (round-3 VERDICT item 3).
+    """Engine-limit streaming measurement (round-3 VERDICT item 3; round-5
+    steady-state redefinition, VERDICT r4 task 2).
 
     The end-to-end streaming row is bounded by the host link (parse +
     transfer + dispatch latency); this mode measures the ENGINE itself: a
     real streaming session runs once with round capture enabled, recording
     every round's device-ready op streams, then the replay times pure
     device work — K chained apply programs plus the fused full-state digest
-    as the single sync — with zero host parse/schedule/transfer per round.
-    The gap between this row and the end-to-end row is, by construction,
-    host/link cost: the 'engine vs link' attribution the round-2 analysis
-    asserted but never measured."""
+    — with zero host parse/schedule/transfer per round.
+
+    Two numbers, mirroring the batch row's apply_seconds vs
+    single_call_seconds split: the HEADLINE is steady-state throughput
+    (several replay passes enqueued back-to-back, one sync — what a
+    continuously-fed engine sustains, the per-measurement tunnel round trip
+    ~0.1 s amortized away), and ``engine_pass_seconds`` is the single-pass
+    latency including that round trip (what one isolated
+    ingest->converge->digest costs).  Round-5 attribution measured the old
+    single-pass number as ~1/3 fixed tunnel RTT (scripts/engine_profile2
+    .py), which is a property of the link, not the engine."""
     import jax
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
 
-    from peritext_tpu.ops.kernel import apply_batch_compact_jit
+    from peritext_tpu.ops.kernel import apply_batch_compact_rounds_jit
     from peritext_tpu.ops.packed import empty_docs
     from peritext_tpu.parallel.streaming import (
         StreamingMerge, _resolve_block_digest_jit,
@@ -649,37 +665,67 @@ def run_engine(args) -> dict:
                         tomb_capacity=args.slots)
     state0 = jax.device_put(state0)
     staged = [
-        ((tuple(jax.device_put(np.asarray(c)) for c in counts), ins, dels, marks, maps), widths)
-        for (counts, ins, dels, marks, maps), widths in captured
+        ((tuple(jax.device_put(np.asarray(c)) for c in counts),
+          ins, dels, marks, maps), widths, loop_slots)
+        for (counts, ins, dels, marks, maps), widths, loop_slots in captured
     ]
     tables = s._digest_tables(0, s._padded_docs)
     row_mask = jnp.ones(s._padded_docs, bool)
 
-    def engine_pass():
+    def engine_pass_async():
+        """Dispatch one full replay (rounds fused in FUSE_MAX_ROUNDS
+        chunks, exactly as the live drain() fuses a deep queue, plus the
+        fused resolve/digest); returns the device per-doc hash vector
+        WITHOUT syncing."""
+        fmax = StreamingMerge.FUSE_MAX_ROUNDS
         st = state0
-        for (counts, ins, dels, marks, maps), widths in staged:
-            st = apply_batch_compact_jit(st, counts, ins, dels, marks, maps, widths=widths)
+        for lo in range(0, len(staged), fmax):
+            part = staged[lo:lo + fmax]
+            st = apply_batch_compact_rounds_jit(
+                st, [r[0] for r in part],
+                widths_seq=[r[1] for r in part],
+                loop_slots_seq=[r[2] for r in part],
+            )
         _, per_doc = _resolve_block_digest_jit(
             st, s.comment_capacity, row_mask, *tables
         )
-        # the single sync point (per-doc hash vector; block sum = digest)
+        return per_doc
+
+    def digest_of(per_doc):
+        # the sync point (per-doc hash vector; block sum = digest)
         return int(np.asarray(per_doc).sum(dtype=np.uint32))
 
-    warm = engine_pass()  # warmup + correctness
+    warm = digest_of(engine_pass_async())  # warmup + correctness
     assert warm == expected_digest, \
         f"engine replay digest {warm:#x} != live session {expected_digest:#x}"
-    times = []
+    # single-pass latency: dispatch -> converged digest on host, incl. the
+    # fixed per-measurement link round trip
+    lat_times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        digest = engine_pass()
-        times.append(time.perf_counter() - t0)
+        digest = digest_of(engine_pass_async())
+        lat_times.append(time.perf_counter() - t0)
     assert digest == expected_digest, "engine replay digest drifted across passes"
-    best = min(times)
+    latency = min(lat_times)
+
+    # steady-state: enqueue several independent replay passes back-to-back
+    # (the device executes queued programs serially) and sync ONLY the
+    # last pass inside the clock — it completes after all queued
+    # predecessors, so the timed region holds one link round trip, not
+    # one per pass; every pass's digest is verified after the clock stops
+    passes = max(2, int(args.iters) // 2)
+    t0 = time.perf_counter()
+    per_docs = [engine_pass_async() for _ in range(passes)]
+    last_digest = digest_of(per_docs[-1])
+    steady = (time.perf_counter() - t0) / passes
+    digests = [digest_of(p) for p in per_docs[:-1]] + [last_digest]
+    assert all(g == expected_digest for g in digests), \
+        "steady-state engine pass diverged"
 
     total_ops = sum(
         len(ch.ops) for w in workloads for log in w.values() for ch in log
     )
-    value = total_ops / best
+    value = total_ops / steady
     return {
         "metric": "engine_limit_streaming_ops_per_sec_per_chip",
         "value": round(value, 1),
@@ -687,10 +733,13 @@ def run_engine(args) -> dict:
         "vs_baseline": round(value / (total_ops / end_to_end), 2),
         "baseline_impl": "same session end-to-end (host parse + transfer + dispatch)",
         "end_to_end_ops_per_sec": round(total_ops / end_to_end, 1),
+        "single_pass_ops_per_sec": round(total_ops / latency, 1),
         "docs": d,
         "rounds": len(staged),
         "ops_per_doc": args.ops_per_doc,
-        "engine_wall_seconds": round(best, 3),
+        "steady_passes": passes,
+        "engine_wall_seconds": round(steady, 3),
+        "engine_pass_seconds": round(latency, 3),
         "end_to_end_wall_seconds": round(end_to_end, 3),
         "platform": jax.devices()[0].platform,
     }
@@ -818,6 +867,20 @@ def run_wire(args) -> dict:
                 assert dec.decode_frame(f) == b
                 link_bytes += len(f)
     variants["fifo_v4_host_link"] = round(link_bytes / total_ops, 2)
+    # per-doc links with the protocol preset dictionary (codec.WireSession
+    # preset=True): a fresh link's deflate window is primed so first frames
+    # back-reference the dictionary the way a warm link references its own
+    # window — the per-doc-link answer to the <=6 target (VERDICT r4 task 8)
+    preset_bytes = 0
+    for doc_batches in batches:
+        enc = _WS(compress=True, preset=True)
+        dec = _WS(compress=True, preset=True)
+        for b in doc_batches:
+            b = sorted(b, key=lambda c: (c.actor, c.seq))
+            f = enc.encode_frame(b)
+            assert dec.decode_frame(f) == b
+            preset_bytes += len(f)
+    variants["fifo_v4_preset"] = round(preset_bytes / total_ops, 2)
     shapes["bench_frames"] = {
         "bytes_per_op": variants["shuffle_v2"],   # r1-r3 continuity number
         "variants_bytes_per_op": variants,
