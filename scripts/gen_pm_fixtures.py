@@ -134,6 +134,69 @@ SCENARIOS = {
             {"sync": True},
         ],
     },
+    # replace-with-content ON a marked range (delete+insert through the
+    # bridge, reference src/bridge.ts:428-444) while the other side types
+    # inside the same bold span — round-4 review: this step shape appeared
+    # in only one fixture
+    "replace_marked_range": {
+        "initial": INITIAL,
+        "events": [
+            {"editor": "alice", "steps": [add_mark(1, 13, "strong")]},
+            {"sync": True},
+            {"editor": "bob",
+             "steps": [replace(4, 9, "plain")]},
+            *typing("alice", 6, "zz"),
+            {"sync": True},
+        ],
+    },
+    # removeMark whose range spans text a concurrent editor deleted — the
+    # anchors must resolve against the CRDT positions, not the PM indices
+    "removemark_spanning_deletion": {
+        "initial": INITIAL,
+        "events": [
+            {"editor": "alice", "steps": [add_mark(1, 16, "strong")]},
+            {"sync": True},
+            {"editor": "alice", "steps": [replace(5, 10, "")]},
+            {"editor": "bob", "steps": [remove_mark(3, 14, "strong")]},
+            {"sync": True},
+        ],
+    },
+}
+
+# External provenance per fixture (VERDICT r4 task 5): the step/doc JSON
+# SHAPES follow prosemirror-transform's published wire schema
+# (Step.toJSON: stepType/from/to + slice{content|openStart|openEnd} for
+# ReplaceStep, mark{type,attrs} for Add/RemoveMarkStep — documented in the
+# prosemirror-transform README and Step.fromJSON contract) and
+# prosemirror-model's Node.toJSON.  No network egress or node runtime
+# exists in this image, so upstream test FILES cannot be vendored; each
+# entry instead names the documented upstream construct the scenario
+# mirrors, and the expected documents are pinned by replaying the steps
+# through this repo's bridge (see README "What a browser would add").
+SOURCES = {
+    "typing": "prosemirror-transform ReplaceStep one-char insert shape "
+              "(tr.insertText -> Step.toJSON, PM ref manual); scenario: "
+              "reference two-editors demo typing loop",
+    "format_overlap": "AddMarkStep shape per prosemirror-transform "
+                      "Step.toJSON; scenario: Peritext paper fig. 'bold "
+                      "vs italic overlap' (reference essay.tsx)",
+    "link_conflict": "AddMarkStep with attrs per prosemirror-transform; "
+                     "scenario: Peritext paper link-conflict example "
+                     "(reference src/schema.ts link allowMultiple=false)",
+    "comments": "AddMark/RemoveMarkStep with id attrs; scenario: reference "
+                "comment sidebar (src/schema.ts comment allowMultiple)",
+    "replace_selection": "ReplaceStep select-and-type + pure-delete shapes "
+                         "(prosemirror-transform tr.replaceWith/tr.delete "
+                         "Step.toJSON)",
+    "unbold_while_typing": "RemoveMarkStep sub-range shape; scenario: "
+                           "Peritext paper unbold-while-typing example",
+    "typing_with_marks": "ReplaceStep slice with marks (PM storedMarks "
+                         "typing emits marked text nodes in the slice)",
+    "replace_marked_range": "ReplaceStep with content over a marked range "
+                            "(delete+insert, reference src/bridge.ts:"
+                            "428-444); round-4 review gap",
+    "removemark_spanning_deletion": "RemoveMarkStep spanning a concurrent "
+                                    "deletion; round-4 review gap",
 }
 
 
@@ -166,7 +229,7 @@ def main():
     FIXTURES.mkdir(exist_ok=True)
     for name, spec in SCENARIOS.items():
         expected_doc, expected_text = run_scenario(spec)
-        out = dict(spec)
+        out = {"source": SOURCES[name], **spec}
         out["expected_doc"] = expected_doc
         out["expected_text"] = expected_text
         path = FIXTURES / f"{name}.json"
