@@ -1,0 +1,52 @@
+"""Host-side attribution for the sweep build (VERDICT r4 task 3).
+
+cProfiles the build loop of the config-5b sweep at a reduced doc count so
+the dominant host term is measured, not guessed.
+Run:  python scripts/ingest_profile.py [docs]
+"""
+import cProfile
+import io
+import os
+import pstats
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def main(d=16384):
+    from bench import build_arrival  # noqa: F401  (import parity with bench)
+    from peritext_tpu.api.batch import _oracle_doc
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.parallel.streaming import StreamingMerge
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    w = generate_workload(seed=200, num_docs=1, ops_per_doc=220)[0]
+    changes = [ch for log in w.values() for ch in log]
+    half = len(changes) // 2
+    frames = [encode_frame(changes[:half]), encode_frame(changes[half:])]
+    total_ops = sum(len(c.ops) for c in changes) * d
+
+    sess = StreamingMerge(
+        num_docs=d, actors=("doc1", "doc2", "doc3"),
+        slot_capacity=512, mark_capacity=160, tomb_capacity=192,
+        round_insert_capacity=192, round_delete_capacity=96,
+        round_mark_capacity=96,
+    )
+    prof = cProfile.Profile()
+    t0 = time.perf_counter()
+    prof.enable()
+    for frame in frames:
+        sess.ingest_frames((doc, frame) for doc in range(d))
+        sess.drain()
+    prof.disable()
+    wall = time.perf_counter() - t0
+    print(f"docs={d} build={wall:.2f}s ops/s={total_ops / wall:,.0f}")
+    s = io.StringIO()
+    ps = pstats.Stats(prof, stream=s).sort_stats("cumulative")
+    ps.print_stats(30)
+    print(s.getvalue())
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 16384)

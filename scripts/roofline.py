@@ -1,0 +1,86 @@
+"""Memory-roofline measurement for apply/resolve (VERDICT r4 task 4).
+
+One run, one process, interleaved: (a) pure state-copy programs at three
+doc counts calibrate achievable HBM bandwidth THROUGH THIS PLATFORM and
+its per-dispatch floor; (b) the batch apply and resolve programs at the
+batch_8k shape measure bytes-moved/op and achieved GB/s against that
+calibration.  Emits the BASELINE.md table rows.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+
+def state_bytes(st):
+    return sum(int(np.prod(x.shape)) * x.dtype.itemsize for x in st)
+
+
+def steady(fn, arg, reps=8):
+    import jax
+
+    out = fn(arg)
+    np.asarray(out.num_slots if hasattr(out, "num_slots") else out[0])
+    t0 = time.perf_counter()
+    o = arg
+    for _ in range(reps):
+        o = fn(o)
+    np.asarray(o.num_slots if hasattr(o, "num_slots") else o[0])
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from peritext_tpu.ops.kernel import apply_batch_jit
+    from peritext_tpu.ops.packed import empty_docs
+    from peritext_tpu.ops.resolve import resolve_jit
+    from peritext_tpu.testing.synth import synth_streams, synth_total_ops
+
+    print(f"device: {jax.devices()[0]}")
+
+    # (a) copy calibration: how fast can ANY program move state bytes here?
+    copy = jax.jit(lambda st: type(st)(*(x + 1 if x.dtype != jnp.bool_
+                                         else x for x in st)))
+    for d in (2048, 8192, 32768):
+        st = jax.device_put(empty_docs(d, 384, 96, tomb_capacity=64))
+        b = state_bytes(st)
+        t = steady(copy, st)
+        print(f"copy d={d:6d}: {b/1e6:7.1f} MB state, {t*1e3:7.2f} ms/call, "
+              f"{2*b/t/1e9:6.1f} GB/s (r+w)")
+
+    # (b) batch_8k apply + resolve (bench --mode batch shapes)
+    d, k, s_cap, m = 8192, 256, 384, 96
+    ki, kd = int(k * 0.7), int(k * 0.15)
+    km = k - ki - kd
+    streams = synth_streams(d, inserts_per_doc=ki, deletes_per_doc=kd,
+                            marks_per_doc=km, seed=0)
+    total_ops = synth_total_ops(streams)
+    state0 = jax.device_put(empty_docs(d, s_cap, max(m, km),
+                                       tomb_capacity=max(kd, 8)))
+    ops_dev = jax.device_put(streams)
+    sb = state_bytes(state0)
+    stream_b = sum(int(np.prod(np.shape(x))) * 4 for x in jax.tree.leaves(streams))
+
+    t = steady(lambda st: apply_batch_jit(st, ops_dev, insert_loop_slots=ki),
+               state0)
+    moved = 2 * sb + stream_b  # state r+w, streams r — one pass each
+    print(f"apply batch_8k: {t*1e3:7.2f} ms, {total_ops/t/1e6:6.1f} M ops/s, "
+          f"{moved/1e6:6.1f} MB min-moved, {moved/t/1e9:6.1f} GB/s achieved, "
+          f"{moved/total_ops:5.1f} B/op")
+
+    applied = apply_batch_jit(state0, ops_dev, insert_loop_slots=ki)
+    np.asarray(applied.num_slots)
+    tr = steady(lambda st: resolve_jit(st, 32), applied)
+    # resolve reads state, writes (D, S) visible/fmt planes ~ 3 planes
+    rb = sb + 3 * d * s_cap * 4
+    print(f"resolve:        {tr*1e3:7.2f} ms, {rb/1e6:6.1f} MB min-moved, "
+          f"{rb/tr/1e9:6.1f} GB/s achieved, {rb/total_ops:5.1f} B/op")
+
+
+if __name__ == "__main__":
+    main()
