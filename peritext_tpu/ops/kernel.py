@@ -71,28 +71,26 @@ def _insert_loop(elem_id, char, n0, overflow0, ins_ref, ins_op, ins_char):
 
 
 def _append_rows(table, count, rows, rows_count):
-    """Append ``rows`` (dict or single array) into append-only ``table`` at
-    [count, count + rows_count); out-of-range rows drop.
+    """Masked scatter appending ``rows`` (dict or single array) into append-only
+    ``table`` at [count, count + rows_count); out-of-range writes drop.
 
-    Formulated as a GATHER over the capacity axis (each table slot j takes
-    rows[j - count] when j lands in the appended range, else keeps itself)
-    rather than a scatter into the table: the vmapped scatter lowered so
-    badly on TPU that the mark-phase append dominated the whole round-apply
-    program (68 ms of an ~18 ms-floor dispatch at km=128, round-5 phase
-    attribution, scripts/apply_phase_cost.py); the gather+select is a dense
-    vectorized op."""
+    Keep the SCATTER formulation: round 5 tried a gather+select over the
+    capacity axis (each table slot takes rows[j - count] when in range) on
+    the theory that the vmapped scatter lowered badly, and a same-process
+    A/B (scripts/append_ab.py) measured the gather 2.6x SLOWER on the
+    batch_8k shape (35.7 -> 95.3 ms/apply) — the batched dynamic gather is
+    what lowers badly on TPU, the batch-dim scatter is fine."""
     single = not isinstance(table, dict)
     tables = {"_": table} if single else table
     new_rows = {"_": rows} if single else rows
     cap = next(iter(tables.values())).shape[0]
     km = next(iter(new_rows.values())).shape[0]
-    j = jnp.arange(cap, dtype=jnp.int32)
-    rel = j - count
-    take = (rel >= 0) & (rel < rows_count)
-    safe = jnp.clip(rel, 0, km - 1)
+    src = jnp.arange(km, dtype=jnp.int32)
+    dst = count + src
+    valid = src < rows_count
+    dst = jnp.where(valid, dst, cap)
     out = {
-        col: jnp.where(take, new_rows[col][safe], tables[col])
-        for col in tables
+        col: tables[col].at[dst].set(new_rows[col], mode="drop") for col in tables
     }
     overflow = count + rows_count > cap
     new_count = jnp.minimum(count + rows_count, cap)

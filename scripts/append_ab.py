@@ -1,0 +1,73 @@
+"""Same-run A/B: scatter vs gather _append_rows inside the batch apply.
+
+Round 5 rewrote the mark/tomb append as gather+select; cross-run absolute
+timings moved (shared chip), so this pins the comparison in one process.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+
+def scatter_append(table, count, rows, rows_count):
+    import jax.numpy as jnp
+
+    single = not isinstance(table, dict)
+    tables = {"_": table} if single else table
+    new_rows = {"_": rows} if single else rows
+    cap = next(iter(tables.values())).shape[0]
+    km = next(iter(new_rows.values())).shape[0]
+    src = jnp.arange(km, dtype=jnp.int32)
+    dst = count + src
+    valid = src < rows_count
+    dst = jnp.where(valid, dst, cap)
+    out = {c: tables[c].at[dst].set(new_rows[c], mode="drop") for c in tables}
+    overflow = count + rows_count > cap
+    new_count = jnp.minimum(count + rows_count, cap)
+    if single:
+        return out["_"], new_count, overflow
+    return out, new_count, overflow
+
+
+def main():
+    import jax
+
+    from peritext_tpu.ops import kernel
+    from peritext_tpu.ops.packed import empty_docs
+    from peritext_tpu.testing.synth import synth_streams, synth_total_ops
+
+    d, k = 8192, 256
+    ki, kd = int(k * 0.7), int(k * 0.15)
+    km = k - ki - kd
+    streams = synth_streams(d, inserts_per_doc=ki, deletes_per_doc=kd,
+                            marks_per_doc=km, seed=0)
+    total = synth_total_ops(streams)
+    state0 = jax.device_put(empty_docs(d, 384, max(96, km),
+                                       tomb_capacity=max(kd, 8)))
+    ops_dev = jax.device_put(streams)
+    gather_append = kernel._append_rows
+
+    def timed(append_impl, reps=6):
+        kernel._append_rows = append_impl
+        fn = jax.jit(lambda st, ops: kernel.apply_batch(
+            st, ops, insert_impl="pallas", insert_loop_slots=ki))
+        out = fn(state0, ops_dev)
+        np.asarray(out.num_slots)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(state0, ops_dev)
+        np.asarray(out.num_slots)
+        return (time.perf_counter() - t0) / reps
+
+    for name, impl in (("gather", gather_append), ("scatter", scatter_append),
+                       ("gather2", gather_append), ("scatter2", scatter_append)):
+        t = timed(impl)
+        print(f"{name:8s}: {t*1e3:7.2f} ms/apply, {total/t/1e6:6.1f} M ops/s")
+    kernel._append_rows = gather_append
+
+
+if __name__ == "__main__":
+    main()

@@ -19,16 +19,16 @@ def state_bytes(st):
     return sum(int(np.prod(x.shape)) * x.dtype.itemsize for x in st)
 
 
-def steady(fn, arg, reps=8):
-    import jax
+def steady(fn, arg, reps=8, chain=True):
+    def sync(o):
+        np.asarray(o.num_slots if hasattr(o, "num_slots") else o.overflow)
 
-    out = fn(arg)
-    np.asarray(out.num_slots if hasattr(out, "num_slots") else out[0])
+    sync(fn(arg))
     t0 = time.perf_counter()
     o = arg
     for _ in range(reps):
-        o = fn(o)
-    np.asarray(o.num_slots if hasattr(o, "num_slots") else o[0])
+        o = fn(o) if chain else fn(arg)
+    sync(o)
     return (time.perf_counter() - t0) / reps
 
 
@@ -75,7 +75,7 @@ def main():
 
     applied = apply_batch_jit(state0, ops_dev, insert_loop_slots=ki)
     np.asarray(applied.num_slots)
-    tr = steady(lambda st: resolve_jit(st, 32), applied)
+    tr = steady(lambda st: resolve_jit(st, 32), applied, chain=False)
     # resolve reads state, writes (D, S) visible/fmt planes ~ 3 planes
     rb = sb + 3 * d * s_cap * 4
     print(f"resolve:        {tr*1e3:7.2f} ms, {rb/1e6:6.1f} MB min-moved, "
