@@ -350,23 +350,86 @@ def parse_frames_bulk(
             np.full(n_frames, FRAME_DEMOTE, np.int32),
         )
     buf = np.frombuffer(data, np.uint8)
-    n_changes, n_strings, n_ints, hdr_ok = frame_header_counts(buf, frame_off)
-    out = native.parse_frames(
-        buf,
-        frame_off,
-        (int(n_changes.sum()), int(n_strings.sum()), int(n_ints.sum())),
-        [actors.lookup(i) for i in range(1, len(actors))],
-        ACTOR_BITS,
-        MAX_CTR,
-    )
-    if out is None:  # pragma: no cover - available() checked above
-        return None
-    (f_status, f_ch_off, f_str_off, str_start, str_len,
-     ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops,
-     cnt_ins, cnt_del, cnt_mark, cnt_map) = out
-    status = f_status.astype(np.int32)
-
     n_frames = len(frame_off) - 1
+    actor_strings = [actors.lookup(i) for i in range(1, len(actors))]
+
+    # Broadcast fan-out dedup (round 5, VERDICT r4 task 3): a change
+    # broadcast to many docs arrives as byte-identical frames (the scale
+    # demo ships ONE session to 100K docs), and the varint parse is pure in
+    # the frame bytes — doc-specific logic (makeList adoption, comment-id
+    # interning, demotion) all runs AFTER the native call in this wrapper.
+    # So identical frames parse once and the raw parse replicates with
+    # numpy gathers; replicated op rows are real copies (the per-doc
+    # comment remap mutates them), while the string TABLE is shared
+    # (global ids point into the unique frames' bytes).
+    # cheap pre-screen: every duplicate shares a byte length, so more than
+    # n/2 distinct lengths rules dedup out without touching frame bytes —
+    # the all-unique pod-scale case pays O(F) ints, not O(wire bytes)
+    f_lens = np.diff(frame_off)
+    dedup = n_frames > 1 and len(np.unique(f_lens)) <= n_frames // 2
+    if dedup:
+        uniq_index: dict = {}
+        inv = np.empty(n_frames, np.int64)
+        uniq_frames: list = []
+        for i in range(n_frames):
+            fb = data[frame_off[i]:frame_off[i + 1]]
+            j = uniq_index.setdefault(fb, len(uniq_frames))
+            if j == len(uniq_frames):
+                uniq_frames.append(fb)
+            inv[i] = j
+        dedup = len(uniq_frames) <= n_frames // 2
+
+    if dedup:
+        s_bytes = b"".join(uniq_frames)
+        u_buf = s_buf = np.frombuffer(s_bytes, np.uint8)
+        u_off = np.concatenate(
+            [[0], np.cumsum([len(f) for f in uniq_frames], dtype=np.int64)]
+        ).astype(np.int64)
+        n_changes, n_strings, n_ints, u_hdr_ok = frame_header_counts(u_buf, u_off)
+        out = native.parse_frames(
+            u_buf, u_off,
+            (int(n_changes.sum()), int(n_strings.sum()), int(n_ints.sum())),
+            actor_strings, ACTOR_BITS, MAX_CTR,
+        )
+        if out is None:  # pragma: no cover - available() checked above
+            return None
+        (u_f_status, u_f_ch_off, _u_f_str_off, str_start, str_len,
+         u_ch_actor, u_ch_seq, u_dep_off, u_dep_actor, u_dep_seq,
+         u_ops_off, u_ops, u_ci, u_cd, u_cm, u_cp) = out
+
+        # replicate per original frame (then per change) by expanding each
+        # unique slice — _ragged_gather handles empty selections (a batch
+        # of duplicated zero-change/corrupt frames must reach the normal
+        # corrupt-frame handling, not a numpy broadcast error)
+        ch_src, f_ch_off = _ragged_gather(u_f_ch_off, inv)
+        ch_actor = u_ch_actor[ch_src]
+        ch_seq = u_ch_seq[ch_src]
+        cnt_ins, cnt_del = u_ci[ch_src], u_cd[ch_src]
+        cnt_mark, cnt_map = u_cm[ch_src], u_cp[ch_src]
+        dep_src, dep_off = _ragged_gather(u_dep_off, ch_src)
+        dep_actor = u_dep_actor[dep_src]
+        dep_seq = u_dep_seq[dep_src]
+        ops_src, ops_off = _ragged_gather(u_ops_off, ch_src)
+        ops = u_ops[ops_src]  # fancy indexing: already a fresh per-replica copy
+        f_status = u_f_status[inv]
+        hdr_ok = u_hdr_ok[inv]
+    else:
+        s_bytes, s_buf = data, buf
+        n_changes, n_strings, n_ints, hdr_ok = frame_header_counts(buf, frame_off)
+        out = native.parse_frames(
+            buf,
+            frame_off,
+            (int(n_changes.sum()), int(n_strings.sum()), int(n_ints.sum())),
+            actor_strings,
+            ACTOR_BITS,
+            MAX_CTR,
+        )
+        if out is None:  # pragma: no cover - available() checked above
+            return None
+        (f_status, f_ch_off, f_str_off, str_start, str_len,
+         ch_actor, ch_seq, dep_off, dep_actor, dep_seq, ops_off, ops,
+         cnt_ins, cnt_del, cnt_mark, cnt_map) = out
+    status = f_status.astype(np.int32)
     kinds = ops[:, 0]  # NOTE: a view — JSON->map conversion below mutates it
     native_map_rows = np.nonzero(kinds == KIND_MAP)[0]
 
@@ -379,8 +442,10 @@ def parse_frames_bulk(
     _decoded: dict = {}
 
     def string_at(gid: int) -> str:
+        # s_bytes: the buffer str_start indexes — the unique-frame concat
+        # under dedup, the original data otherwise
         start = int(str_start[gid])
-        raw = data[start : start + int(str_len[gid])]
+        raw = s_bytes[start : start + int(str_len[gid])]
         s = _decoded.get(raw)
         if s is None:
             s = raw.decode("utf-8")
@@ -430,7 +495,7 @@ def parse_frames_bulk(
             if ln == 0:
                 new_ids[sel] = table.intern("")
                 continue
-            content = buf[starts[sel][:, None] + np.arange(int(ln), dtype=np.int64)]
+            content = s_buf[starts[sel][:, None] + np.arange(int(ln), dtype=np.int64)]
             uniq_rows, inv = np.unique(content, axis=0, return_inverse=True)
             ids = np.empty(len(uniq_rows), np.int32)
             for j in range(len(uniq_rows)):

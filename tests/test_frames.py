@@ -449,3 +449,70 @@ def test_bulk_corrupt_frames_do_not_intern_comment_ids():
     assert table is None or len(table) == 1
     s.drain()
     assert "".join(sp["text"] for sp in s.read(0)) == "The Peritext editor"
+
+
+def test_bulk_dedup_broadcast_frames_match_oracle():
+    """Byte-identical frames fan-out (the scale demo ships one session to
+    every doc): the parse dedups to unique frames and replicates the raw
+    arrays (round 5).  Content, digest and per-doc comment interning must
+    be indistinguishable from parsing every copy."""
+    from peritext_tpu.api import oracle_merge
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.parallel.streaming import StreamingMerge
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    w = generate_workload(seed=31, num_docs=1, ops_per_doc=80)[0]
+    changes = [ch for log in w.values() for ch in log]
+    half = len(changes) // 2
+    frames = [encode_frame(changes[:half]), encode_frame(changes[half:])]
+
+    def build(docs):
+        s = StreamingMerge(num_docs=docs, actors=("doc1", "doc2", "doc3"),
+                           slot_capacity=256, mark_capacity=96,
+                           tomb_capacity=96)
+        for f in frames:
+            s.ingest_frames((d, f) for d in range(docs))
+            s.drain()
+        return s
+
+    many = build(12)  # 12 copies of 2 unique frames -> dedup path
+    one = build(1)    # single doc -> non-dedup path
+    assert not any(ds.fallback for ds in many.docs)
+    expected = oracle_merge([w])[0]
+    spans = many.read_all()
+    assert all(sp == expected for sp in spans)
+    assert many.read(5) == one.read(0)
+    # digest is a doc-sum; every replica hashes identically
+    many._digest_row_valid[:] = False
+    many._refresh_digest_rows()
+    assert (many._digest_plane[:12] == many._digest_plane[0]).all()
+
+
+def test_bulk_dedup_replicates_corrupt_status():
+    """A corrupt frame broadcast to many docs must surface EVERY replica
+    through the dedup replication's normal corrupt-frame handling — a
+    HEADER-corrupt unique frame parses to zero changes, which is exactly
+    the empty-selection replication case (review r5: the first dedup cut
+    crashed here with a numpy broadcast error instead of raising the
+    documented ValueError)."""
+    import pytest
+
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.parallel.streaming import StreamingMerge
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    w = generate_workload(seed=32, num_docs=1, ops_per_doc=40)[0]
+    changes = [ch for log in w.values() for ch in log]
+    good = encode_frame(changes)
+    bad = b"XXXF" + good[4:]  # corrupt magic: header-invalid, 0 changes
+    s = StreamingMerge(num_docs=8, actors=("doc1", "doc2", "doc3"),
+                       slot_capacity=256, mark_capacity=96, tomb_capacity=96)
+    with pytest.raises(ValueError) as exc:
+        s.ingest_frames([(d, bad) for d in range(8)])
+    assert "[0, 1, 2, 3, 4, 5, 6, 7]" in str(exc.value)
+    # good frames after the corrupt batch still ingest everywhere
+    s.ingest_frames([(d, good) for d in range(8)])
+    s.drain()
+    assert s.pending_count() == 0
+    assert not any(ds.fallback for ds in s.docs)
+    assert s.read(3) == s.read(7)
