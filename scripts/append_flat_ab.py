@@ -1,0 +1,90 @@
+"""Same-run A/B: batch-dim scatter vs FLATTENED 1-D scatter for the
+mark/tomb append phase (round 5 follow-up).
+
+The vmapped scatter costs ~25 ns/element on the round-apply's mark phase
+(apply_phase_cost.py).  Hypothesis: scattering into the flattened
+(D*cap,) table with globally-unique indices (doc*cap + count + src)
+lowers to a cheaper gather-scatter than the batched form.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+
+def flat_append(table, count, rows, rows_count):
+    """(D, cap) tables, (D,) count, (D, K) rows, (D,) rows_count —
+    flattened single scatter."""
+    import jax.numpy as jnp
+
+    single = not isinstance(table, dict)
+    tables = {"_": table} if single else table
+    new_rows = {"_": rows} if single else rows
+    t0 = next(iter(tables.values()))
+    d, cap = t0.shape
+    km = next(iter(new_rows.values())).shape[1]
+    src = jnp.arange(km, dtype=jnp.int32)[None, :]
+    dst_in = count[:, None] + src  # (D, K) in-table position
+    valid = (src < rows_count[:, None]) & (dst_in < cap)
+    base = (jnp.arange(d, dtype=jnp.int32) * cap)[:, None]
+    flat_dst = jnp.where(valid, base + dst_in, d * cap).reshape(-1)
+    out = {
+        c: tables[c].reshape(-1).at[flat_dst].set(
+            new_rows[c].reshape(-1), mode="drop").reshape(d, cap)
+        for c in tables
+    }
+    overflow = count + rows_count > cap
+    new_count = jnp.minimum(count + rows_count, cap)
+    if single:
+        return out["_"], new_count, overflow
+    return out, new_count, overflow
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from peritext_tpu.ops import kernel
+
+    docs, cap, km = 2048, 96, 128
+    rng = np.random.default_rng(0)
+    cols = [f"c{i}" for i in range(8)]
+    table = {c: jax.device_put(jnp.asarray(rng.integers(0, 1000, (docs, cap)),
+                                           jnp.int32)) for c in cols}
+    rows = {c: jax.device_put(jnp.asarray(rng.integers(0, 1000, (docs, km)),
+                                          jnp.int32)) for c in cols}
+    count = jax.device_put(jnp.asarray(rng.integers(0, 16, docs), jnp.int32))
+    rows_count = jax.device_put(
+        jnp.asarray(rng.integers(0, km // 2, docs), jnp.int32))
+
+    batched = jax.jit(jax.vmap(kernel._append_rows))
+    flat = jax.jit(flat_append)
+
+    o1 = batched(table, count, rows, rows_count)
+    o2 = flat(table, count, rows, rows_count)
+    for c in cols:
+        np.testing.assert_array_equal(np.asarray(o1[0][c]),
+                                      np.asarray(o2[0][c]))
+    np.testing.assert_array_equal(np.asarray(o1[1]), np.asarray(o2[1]))
+    np.testing.assert_array_equal(np.asarray(o1[2]), np.asarray(o2[2]))
+    print("equivalent outputs ok")
+
+    def steady(fn, reps=16):
+        out = fn(table, count, rows, rows_count)
+        np.asarray(out[1])
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(table, count, rows, rows_count)
+        np.asarray(out[1])
+        return (time.perf_counter() - t0) / reps
+
+    for _ in range(2):
+        for name, fn in (("batched", batched), ("flat", flat)):
+            print(f"{name}: {steady(fn)*1e3:7.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

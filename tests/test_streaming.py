@@ -547,3 +547,42 @@ def test_cum_ins_upper_bounds_device_occupancy():
     slots = np.asarray(s.state.num_slots)
     assert (s._cum_ins >= slots).all(), "bound must ride the reshard permute"
     assert s.pending_count() == 0
+
+
+def test_fused_drain_equals_stepwise_application():
+    """drain() commits queued rounds as ONE fused device program
+    (kernel.apply_batch_compact_rounds); public step() commits per round.
+    The two must be indistinguishable — state digest, spans, patches —
+    since fusion is the same apply sequence traced together (round 5)."""
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.parallel.streaming import StreamingMerge
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    workloads = generate_workload(seed=23, num_docs=16, ops_per_doc=96)
+
+    def build(use_drain):
+        s = StreamingMerge(
+            num_docs=16, actors=("doc1", "doc2", "doc3"),
+            slot_capacity=256, mark_capacity=96, tomb_capacity=128,
+            round_insert_capacity=32, round_delete_capacity=16,
+            round_mark_capacity=16,
+        )
+        for doc, w in enumerate(workloads):
+            ch = [c for log in w.values() for c in log]
+            half = len(ch) // 2
+            s.ingest_frame(doc, encode_frame(ch[:half]))
+            s.ingest_frame(doc, encode_frame(ch[half:]))
+        if use_drain:
+            s.drain()  # fused: multiple rounds per dispatch
+        else:
+            while s.step() > 0:  # per-round dispatch
+                pass
+        return s
+
+    fused, stepwise = build(True), build(False)
+    assert fused.rounds == stepwise.rounds
+    assert fused.digest() == stepwise.digest()
+    assert fused.read_all() == stepwise.read_all()
+    assert fused.read_patches_all() == stepwise.read_patches_all()
+    # low caps force several rounds, so the fused path actually fused
+    assert fused.rounds > 1
