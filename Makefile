@@ -5,7 +5,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test fuzz fuzz-differential fuzz-frames fuzz-crash weak-scaling \
+.PHONY: test fuzz fuzz-differential fuzz-frames fuzz-crash chaos weak-scaling \
 	bench bench-smoke bench-streaming entry dryrun lint clean
 
 test:
@@ -21,6 +21,11 @@ fuzz-differential:
 # crash-consistency: checkpoint mid-stream, kill, restore, repair
 fuzz-crash:
 	$(CPU_ENV) $(PY) -m peritext_tpu.testing.fuzz --crash-restore
+
+# composed-fault chaos soak: delivery + corruption + peer stalls + injected
+# device-round failures + crash-restore vs the byte-equality oracle
+chaos:
+	$(CPU_ENV) $(PY) scripts/chaos_soak.py --seeds 20
 
 # 1/2/4/8-device virtual-mesh scaling + digest-invariance evidence
 weak-scaling:

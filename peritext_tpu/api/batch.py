@@ -76,12 +76,19 @@ class DocBatch:
         map_capacity: int = 32,
         jit: bool = True,
         mesh=None,
+        guard: bool = False,
     ) -> None:
         self.slot_capacity = slot_capacity
         self.mark_capacity = mark_capacity
         self.comment_capacity = comment_capacity
         self.op_capacity = op_capacity
         self.map_capacity = map_capacity
+        #: fault-domain guard: a device-stage failure (XLA compile/runtime
+        #: error, device OOM) degrades the whole merge to the scalar oracle
+        #: — slower but byte-identical — instead of raising.  Off by default
+        #: so development surfaces device bugs loudly; the supervisor layer
+        #: turns it on for production serving.
+        self.guard = guard
         #: optional jax.sharding.Mesh; when set, the doc axis of every tensor
         #: is sharded across it (pure data parallelism; XLA adds collectives
         #: only for cross-doc reductions like the convergence digest).
@@ -147,18 +154,23 @@ class DocBatch:
         encoded = self.encode(workloads)
         stats.encode_seconds = time.perf_counter() - t0
 
-        t0 = time.perf_counter()
-        state = self.apply_encoded(encoded)
-        np.asarray(state.num_slots)  # host sync: time the apply honestly
-        stats.apply_seconds = time.perf_counter() - t0
+        try:
+            t0 = time.perf_counter()
+            state = self.apply_encoded(encoded)
+            np.asarray(state.num_slots)  # host sync: time the apply honestly
+            stats.apply_seconds = time.perf_counter() - t0
 
-        t0 = time.perf_counter()
-        resolved_dev = self._resolve(state, self.comment_capacity)
-        # One whole-array transfer per field, up front: decoding per doc on
-        # the raw (possibly mesh-sharded) arrays would do 5 device gathers
-        # per document.
-        resolved = type(resolved_dev)(*(np.asarray(x) for x in resolved_dev))
-        stats.resolve_seconds = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            resolved_dev = self._resolve(state, self.comment_capacity)
+            # One whole-array transfer per field, up front: decoding per doc
+            # on the raw (possibly mesh-sharded) arrays would do 5 device
+            # gathers per document.
+            resolved = type(resolved_dev)(*(np.asarray(x) for x in resolved_dev))
+            stats.resolve_seconds = time.perf_counter() - t0
+        except Exception as exc:
+            if not self.guard:
+                raise
+            return self._degraded_merge(workloads, cursors, stats, exc)
 
         overflow = np.asarray(resolved.overflow)
         fallback = set(encoded.fallback_docs) | {
@@ -241,6 +253,44 @@ class DocBatch:
             device_ops=device_ops,
             stats=stats,
             cursor_positions=cursor_positions,
+            roots=roots,
+        )
+
+    def _degraded_merge(
+        self, workloads, cursors, stats: MergeStats, exc: Exception
+    ) -> MergeReport:
+        """Guarded-merge degradation: the whole batch replays through the
+        scalar oracle (byte-identical spans/roots/cursors, no device).  The
+        failure is preserved as evidence in counters and ``stats.extras``."""
+        from ..ops.resolve import oracle_cursor_positions
+
+        GLOBAL_COUNTERS.add("merge.guarded_fallbacks")
+        spans: List[List[FormatSpan]] = []
+        roots: List[dict] = []
+        positions: Optional[List[List[int]]] = [] if cursors is not None else None
+        fallback_ops = 0
+        t0 = time.perf_counter()
+        for d, workload in enumerate(workloads):
+            doc = _oracle_doc(workload)
+            spans.append(doc.get_text_with_formatting(["text"]))
+            roots.append(doc.root)
+            fallback_ops += sum(
+                len(ch.ops) for log in workload.values() for ch in log
+            )
+            if positions is not None:
+                positions.append(oracle_cursor_positions(doc, cursors[d]))
+        stats.decode_seconds = time.perf_counter() - t0
+        stats.fallback_docs = len(workloads)
+        stats.device_docs = 0
+        stats.fallback_ops = fallback_ops
+        stats.extras["guarded_fallback"] = 1.0
+        stats.extras["guarded_error"] = repr(exc)
+        return MergeReport(
+            spans=spans,
+            fallback_docs=list(range(len(workloads))),
+            device_ops=0,
+            stats=stats,
+            cursor_positions=positions,
             roots=roots,
         )
 

@@ -209,6 +209,10 @@ def restore_session(directory: str | Path, mesh=None, drain: bool = True):
 
 _STEP_PREFIX = "step_"
 
+#: staging dirs older than this are crash leftovers; younger ones may be a
+#: concurrent saver's live staging (see CheckpointManager.__init__)
+_STAGING_STALE_SECONDS = 3600.0
+
 
 @dataclass
 class Checkpoint:
@@ -244,6 +248,21 @@ class CheckpointManager:
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         self.keep = keep
+        # a crash mid-save (kill -9 between mkdtemp and the atomic rename)
+        # leaves a staging dir behind; it was never published, so it is
+        # garbage — sweep it rather than leak one per crash.  Only STALE
+        # dirs are swept: a freshly-modified one may belong to a live saver
+        # on the same root (supervisor restart racing the old process's
+        # in-flight save), whose rename must not be sabotaged.
+        import time
+
+        cutoff = time.time() - _STAGING_STALE_SECONDS
+        for stale in self.root.glob(".staging_*"):
+            try:
+                if stale.stat().st_mtime < cutoff:
+                    shutil.rmtree(stale, ignore_errors=True)
+            except OSError:
+                pass  # raced with its owner's rename/cleanup
 
     def save(
         self,
@@ -280,10 +299,14 @@ class CheckpointManager:
         return final
 
     def steps(self) -> List[int]:
+        # only PUBLISHED checkpoints count: the atomic rename guarantees a
+        # step_* dir is complete, but a meta.json check keeps a manually
+        # damaged (or foreign) directory from masking the last good one
         return sorted(
             int(p.name[len(_STEP_PREFIX):])
             for p in self.root.iterdir()
             if p.is_dir() and p.name.startswith(_STEP_PREFIX)
+            and (p / "meta.json").exists()
         )
 
     def latest(self) -> Optional[Checkpoint]:

@@ -23,3 +23,26 @@ class MissingObject(PeritextError):
 class CapacityExceeded(PeritextError):
     """A packed device buffer (slots / mark table / op stream) overflowed its
     static capacity; callers should rebucket or fall back to the host path."""
+
+
+class DecodeError(PeritextError, ValueError):
+    """A wire frame failed decode or validation (truncated bytes, bit-flips,
+    malformed varints, out-of-range indices, bad checksum).  Subclasses
+    ValueError so every pre-existing ``except ValueError`` corrupt-frame
+    handler keeps working; fault-domain code catches the typed form to
+    quarantine the affected doc instead of failing the whole batch."""
+
+
+class TransportError(PeritextError, ConnectionError):
+    """A multihost transport operation failed after its timeout/retry budget
+    (connect refused, peer stalled past the socket deadline, connection torn
+    mid-message).  Subclasses ConnectionError so existing handlers keep
+    working; carries no protocol state — the store is append-only and
+    duplicate-tolerant, so the caller's next anti-entropy round repairs by
+    re-shipping whatever the peer is still missing."""
+
+
+class DeviceRoundError(PeritextError):
+    """A guarded device round failed or overran its wall-clock deadline.
+    The fault-domain supervisor translates this into a rollback to the last
+    good checkpoint plus scalar-fallback replay (degraded but correct)."""

@@ -66,6 +66,30 @@ class Counters:
 GLOBAL_COUNTERS = Counters()
 
 
+#: counter namespaces that make up the fault-domain health surface
+_HEALTH_PREFIXES = ("streaming.", "transport.", "supervisor.", "merge.")
+
+
+def health_snapshot(counters: Optional[Counters] = None, session=None) -> Dict[str, Any]:
+    """One structured dict for a fleet health endpoint: every fault-domain
+    counter (quarantines, corrupt frames, transport retries / behind peers,
+    supervisor rollbacks, guarded-merge fallbacks), plus — when a streaming
+    session or its :class:`~.parallel.supervisor.GuardedSession` is given —
+    that session's own ``health()`` (quarantine registry with typed reasons,
+    fallback/pending counts, rollback evidence)."""
+    counters = counters or GLOBAL_COUNTERS
+    out: Dict[str, Any] = {
+        "counters": {
+            k: v
+            for k, v in sorted(counters.snapshot().items())
+            if k.startswith(_HEALTH_PREFIXES)
+        },
+    }
+    if session is not None:
+        out["session"] = session.health()
+    return out
+
+
 class EventLog:
     """Append-only structured event stream.
 

@@ -4,10 +4,30 @@ and (device) mesh sharding of the document axis."""
 from .anti_entropy import ChangeStore, apply_changes, get_missing_changes, sync
 from .causal import causal_sort, causal_waves
 from .change_queue import ChangeQueue
-from .multihost import ReplicaServer, merge_changes, sync_with
+from .multihost import (
+    ReplicaServer,
+    RetryPolicy,
+    SyncOutcome,
+    merge_changes,
+    sync_with,
+    try_sync_with,
+)
 from .pubsub import Publisher
 
+
+def __getattr__(name):
+    # lazy: supervisor pulls in streaming (and through it the whole device
+    # stack), whose import chain re-enters this package — eager import here
+    # would be circular, and most transport users never need it
+    if name == "GuardedSession":
+        from .supervisor import GuardedSession
+
+        return GuardedSession
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
+    "GuardedSession",
     "ChangeStore",
     "apply_changes",
     "get_missing_changes",
@@ -17,6 +37,9 @@ __all__ = [
     "ChangeQueue",
     "Publisher",
     "ReplicaServer",
+    "RetryPolicy",
+    "SyncOutcome",
     "merge_changes",
     "sync_with",
+    "try_sync_with",
 ]

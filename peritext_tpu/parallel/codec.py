@@ -19,15 +19,17 @@ interoperates with the JSON wire format.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import struct
 import zlib
 from collections import ChainMap
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from .. import native
+from ..core.errors import DecodeError
 from ..core.opids import HEAD, ROOT
 from ..core.types import AFTER, BEFORE, Boundary, Change, END_OF_TEXT, Operation, START_OF_TEXT
 from ..schema import ALL_MARKS, MARK_INDEX
@@ -601,8 +603,34 @@ def _read_op(
     )
 
 
+@contextlib.contextmanager
+def _normalize_decode_errors(on_fail: "Optional[Callable[[], None]]" = None):
+    """THE corruption contract, defined once: every symptom a corrupt frame
+    can raise inside a decode path (wrong magic/length ValueError, index or
+    key misses, varint overflow, bad UTF-8, short struct reads) normalizes
+    to :class:`DecodeError`; ``on_fail`` runs before re-raising (e.g.
+    :class:`WireSession` breaking its link state)."""
+    try:
+        yield
+    except DecodeError:
+        if on_fail is not None:
+            on_fail()
+        raise
+    except ValueError as exc:
+        if on_fail is not None:
+            on_fail()
+        raise DecodeError(str(exc)) from exc
+    except (IndexError, KeyError, TypeError, OverflowError, UnicodeDecodeError,
+            struct.error) as exc:
+        if on_fail is not None:
+            on_fail()
+        raise DecodeError(f"corrupt frame: {exc!r}") from exc
+
+
 def decode_frame(data: bytes) -> List[Change]:
-    """Inverse of :func:`encode_frame`; raises ValueError on corrupt frames.
+    """Inverse of :func:`encode_frame`; raises :class:`DecodeError` (a
+    ValueError subclass, so pre-existing handlers keep working) on corrupt
+    frames.
 
     Returned ``Change.deps`` mappings must be treated as read-only: a run of
     changes with identical clocks (DEPS_SAME on the wire) shares one
@@ -610,17 +638,11 @@ def decode_frame(data: bytes) -> List[Change]:
     memory per change instead of N vector-clock copies.  Every consumer in
     the tree only reads deps (``causal.py``, ``doc.py:420``, ``to_json``
     copies)."""
-    try:
+    with _normalize_decode_errors():
         changes, end = _decode_frame(data)
         if end != len(data):
-            raise ValueError("trailing garbage after frame")
+            raise DecodeError("trailing garbage after frame")
         return changes
-    except ValueError:
-        raise
-    except (IndexError, KeyError, TypeError, OverflowError, UnicodeDecodeError,
-            struct.error) as exc:
-        # Normalize every corruption symptom to the documented contract.
-        raise ValueError(f"corrupt frame: {exc!r}") from exc
 
 
 def encode_frame_chunks(
@@ -765,7 +787,7 @@ class WireSession:
         session broken when a deflate stream exists, so a retry can never
         silently desync (review r4)."""
         if self._broken:
-            raise ValueError(
+            raise DecodeError(
                 "wire session broken by an earlier decode error — discard "
                 "the session and resync the link"
             )
@@ -778,20 +800,13 @@ class WireSession:
 
     def decode_frame(self, data: bytes) -> List[Change]:
         n0 = self._decode_guard()
-        try:
+        with _normalize_decode_errors(on_fail=lambda: self._decode_failed(n0)):
             changes, end = _decode_frame(
                 data, 0, session_strings=self._dec_strings, inflate=self._inflate
             )
             if end != len(data):
-                raise ValueError("trailing garbage after frame")
+                raise DecodeError("trailing garbage after frame")
             return changes
-        except ValueError:
-            self._decode_failed(n0)
-            raise
-        except (IndexError, KeyError, TypeError, OverflowError,
-                UnicodeDecodeError, struct.error) as exc:
-            self._decode_failed(n0)
-            raise ValueError(f"corrupt frame: {exc!r}") from exc
 
     def decode_frame_normalized(self, data: bytes):
         """(changes, self-contained v2 bytes) — for consumers that store or
@@ -814,18 +829,13 @@ def decode_frame_multi(data: bytes) -> List[Change]:
     changes: List[Change] = []
     pos = 0
     sess = WireSession()  # fresh table + inflate stream for the train
-    try:
+    with _normalize_decode_errors():
         while pos < len(data):
             part, pos = _decode_frame(
                 data, pos, session_strings=sess._dec_strings,
                 inflate=sess._inflate,
             )
             changes.extend(part)
-    except ValueError:
-        raise
-    except (IndexError, KeyError, TypeError, OverflowError, UnicodeDecodeError,
-            struct.error) as exc:
-        raise ValueError(f"corrupt frame: {exc!r}") from exc
     return changes
 
 
@@ -837,10 +847,10 @@ def iter_frames(data: bytes):
     pos = 0
     while pos < len(data):
         if len(data) - pos < _HEADER.size:
-            raise ValueError("frame too short")
+            raise DecodeError("frame too short")
         magic, version, _, n_strings, _, payload_len = _HEADER.unpack_from(data, pos)
         if magic != _MAGIC or version not in _DECODABLE_VERSIONS:
-            raise ValueError("bad frame magic/version")
+            raise DecodeError("bad frame magic/version")
         p = pos + _HEADER.size
         if version == 4:  # body is one deflate blob of payload_len bytes
             end = p + payload_len
@@ -849,7 +859,7 @@ def iter_frames(data: bytes):
                 _, p = _read_varint(data, p)
             end = _walk_string_table(data, p, n_strings) + payload_len
         if end > len(data):
-            raise ValueError("truncated payload")
+            raise DecodeError("truncated payload")
         yield data[pos:end]
         pos = end
 
@@ -859,12 +869,8 @@ def frame_parts(data: bytes):
     without materializing Change objects — the input to the native
     frame-ingest fast path (native.parse_changes).  Raises ValueError on
     corrupt frames."""
-    try:
+    with _normalize_decode_errors():
         return _frame_parts(data)[:4]
-    except ValueError:
-        raise
-    except (IndexError, OverflowError, UnicodeDecodeError, struct.error) as exc:
-        raise ValueError(f"corrupt frame: {exc!r}") from exc
 
 
 def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:

@@ -10,34 +10,53 @@ must survive:
 * **duplication** — redelivered changes must be idempotent;
 * **drop** — lost changes must be repaired by a later anti-entropy round
   (vector-clock diffs re-ship anything missing, so drops delay but never
-  prevent convergence).
+  prevent convergence);
+* **payload corruption** — truncated or bit-flipped wire frames must be
+  rejected at the codec (:class:`~..core.errors.DecodeError`) and contained
+  to the affected doc (per-doc quarantine), never applied as garbage.
 
-Two entry points: :func:`perturb_delivery` for harnesses that move changes by
-hand (the fuzzer's sync step), and :class:`FaultyPublisher`, a drop-in
-``Publisher`` that applies per-subscriber faults and records what it lost so
-tests can assert repair actually happened.
+Entry points: :func:`perturb_delivery` for harnesses that move changes by
+hand (the fuzzer's sync step), :func:`perturb_frame` for harnesses that move
+raw wire bytes (the chaos harness's codec-surface faults), and
+:class:`FaultyPublisher`, a drop-in ``Publisher`` that applies
+per-subscriber faults and records what it lost so tests can assert repair
+actually happened.
 """
 
 from __future__ import annotations
 
 import random
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
+from ..core.errors import DecodeError
 from ..core.types import Change
 from .pubsub import Publisher
 
 
 @dataclass(frozen=True)
 class FaultSpec:
-    """Probabilities for one delivery hop."""
+    """Probabilities for one delivery hop.
+
+    ``drop_p``/``dup_p``/``reorder`` act on whole changes (delivery faults);
+    ``truncate_p``/``bitflip_p`` act on the encoded FRAME BYTES (payload
+    faults) — they model a corrupting link or store, and exercise the codec's
+    :class:`DecodeError` surface rather than the causal layer."""
 
     drop_p: float = 0.0
     dup_p: float = 0.0
     reorder: bool = True
+    #: per-frame probability the frame arrives truncated at a random cut
+    truncate_p: float = 0.0
+    #: per-frame probability 1..4 random bits arrive flipped
+    bitflip_p: float = 0.0
 
     def any_faults(self) -> bool:
-        return self.drop_p > 0 or self.dup_p > 0 or self.reorder
+        return (self.drop_p > 0 or self.dup_p > 0 or self.reorder
+                or self.any_payload_faults())
+
+    def any_payload_faults(self) -> bool:
+        return self.truncate_p > 0 or self.bitflip_p > 0
 
 
 def perturb_delivery(
@@ -59,12 +78,65 @@ def perturb_delivery(
     return delivered
 
 
+def perturb_frame(data: bytes, rng: random.Random, spec: FaultSpec) -> bytes:
+    """Apply payload faults (truncation, bit flips) to one encoded wire
+    frame; returns the (possibly corrupted) bytes.  The result may or may
+    not decode — that is the point: the codec must reject corruption with
+    :class:`DecodeError`, and the ingest layer must quarantine the affected
+    doc without crashing.  With no payload faults configured (or an empty
+    frame) the bytes pass through untouched."""
+    if not data or not spec.any_payload_faults():
+        return data
+    out = data
+    if rng.random() < spec.truncate_p:
+        out = out[: rng.randrange(len(out))]
+    if out and rng.random() < spec.bitflip_p:
+        buf = bytearray(out)
+        for _ in range(rng.randint(1, 4)):
+            pos = rng.randrange(len(buf))
+            buf[pos] ^= 1 << rng.randrange(8)
+        out = bytes(buf)
+    return out
+
+
+def corrupt_detectably(
+    frame: bytes, rng: random.Random, spec: FaultSpec,
+) -> Optional[bytes]:
+    """Apply payload faults to one encoded frame and return the corrupted
+    bytes ONLY when the codec can detect the damage (:class:`DecodeError`);
+    returns None when no corruption fired or when the corruption is
+    UNDETECTABLE (the mutated frame still decodes — the wire format carries
+    no checksum).  Undetectable corruption models as clean delivery: link-
+    level integrity (TCP/TLS) is assumed to catch what application-level
+    validation cannot, and delivering decoded garbage would make replicas
+    diverge by design.  THE single definition of that policy — harnesses
+    (FaultyPublisher, testing/chaos.py) share it so a future wire-frame
+    checksum (ROADMAP) changes it in one place."""
+    from .codec import decode_frame
+
+    bad = perturb_frame(frame, rng, spec)
+    if bad is frame:
+        return None
+    try:
+        decode_frame(bad)
+    except DecodeError:
+        return bad
+    return None
+
+
 class FaultyPublisher(Publisher):
     """A ``Publisher`` whose deliveries suffer per-subscriber faults.
 
     Dropped updates are recorded per subscriber; :meth:`redeliver_lost`
     models the transport-level retransmission that a real deployment gets
     from anti-entropy, letting tests assert convergence-after-repair.
+
+    With payload faults configured (``truncate_p``/``bitflip_p``) every
+    delivery round-trips through the real wire codec — encode, corrupt the
+    bytes, decode — so the :class:`DecodeError` surface is exercised, not
+    just delivery ordering.  A batch whose corrupted frame fails decode is
+    counted as lost in full (the transport analog: a corrupt frame
+    contributes nothing, anti-entropy re-ships it later).
     """
 
     def __init__(self, spec: FaultSpec, seed: int = 0) -> None:
@@ -74,13 +146,39 @@ class FaultyPublisher(Publisher):
         self.lost: Dict[str, List[List[Change]]] = {}
         self.delivered_count = 0
         self.dropped_count = 0
+        #: deliveries whose frame failed decode after payload corruption
+        self.corrupt_count = 0
+
+    def _through_codec(self, batch: List[Change]) -> Optional[List[Change]]:
+        """Encode → corrupt → decode one delivery batch; None = frame lost
+        to DETECTABLE corruption (the whole batch, like a dropped TCP
+        message); undetectable corruption models as clean delivery (the
+        :func:`corrupt_detectably` policy)."""
+        from .codec import decode_frame, encode_frame
+
+        if not batch:
+            return batch
+        frame = encode_frame(batch)
+        if corrupt_detectably(frame, self.rng, self.spec) is not None:
+            return None
+        return decode_frame(frame)
 
     def publish(self, sender: str, update: List[Change]) -> None:
         for key, callback in list(self._subscribers.items()):
             if key == sender:
                 continue
             perturbed = perturb_delivery(list(update), self.rng, self.spec)
-            dropped = [c for c in update if c not in perturbed]
+            if self.spec.any_payload_faults():
+                decoded = self._through_codec(perturbed)
+                if decoded is None:
+                    self.corrupt_count += 1
+                    perturbed = []
+                else:
+                    perturbed = decoded
+            dropped = [
+                c for c in update
+                if not any(d.actor == c.actor and d.seq == c.seq for d in perturbed)
+            ]
             if dropped:
                 self.lost.setdefault(key, []).append(dropped)
                 self.dropped_count += len(dropped)

@@ -30,18 +30,33 @@ Both sides merge with :func:`merge_changes`, which tolerates duplicates and
 out-of-order arrival (per-actor seq ordering restores log order), so repeated
 or concurrent syncs against many peers are safe — the store is a CRDT of
 append-only logs.
+
+Fault domains (the supervisor layer): every socket operation runs under a
+per-socket deadline — a stalled peer raises :class:`TransportError` (via
+``socket.timeout``) instead of hanging ``_recv_exact`` forever.  The retry
+layer (:class:`RetryPolicy`) wraps one anti-entropy round in bounded
+exponential backoff with jitter; :func:`try_sync_with` absorbs terminal
+transport failures into a :class:`SyncOutcome` whose ``behind`` flag simply
+means "this peer's changes are still missing" — exactly the state a later
+anti-entropy round repairs, because the store is append-only and
+duplicate-tolerant.  Callers above the transport never need to see a
+transport exception to stay correct.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import socket
 import struct
 import threading
+import time
+from dataclasses import dataclass
 from typing import Callable, List, Optional, Tuple
 
-from ..core.errors import PeritextError
+from ..core.errors import DecodeError, PeritextError, TransportError
 from ..core.types import Change, Clock
+from ..observability import GLOBAL_COUNTERS
 from .anti_entropy import ChangeStore
 from .codec import (
     WireSession,
@@ -65,13 +80,65 @@ MSG_CHANGES = b"C"
 MSG_CHANGES_MULTI = b"M"
 
 
+# -- retry policy ------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry with exponential backoff + jitter for one transport leg.
+
+    ``attempts`` counts TOTAL tries (1 = no retry).  Delay before try k+1 is
+    ``min(max_delay, base_delay * 2**k)`` scaled by a uniform jitter in
+    ``[1, 1 + jitter]`` — jitter desynchronizes a fleet of peers retrying
+    against the same recovered host.  ``timeout`` is the per-SOCKET deadline
+    applied to connect and every send/recv of the attempt, so one stalled
+    peer costs at most ``attempts * timeout`` wall-clock, never forever."""
+
+    attempts: int = 3
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    jitter: float = 0.5
+    timeout: float = 30.0
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        base = min(self.max_delay, self.base_delay * (2 ** attempt))
+        return base * (1.0 + self.jitter * rng.random())
+
+
+#: single-attempt policy — the pre-supervisor behavior, minus the hangs
+NO_RETRY = RetryPolicy(attempts=1)
+
+
+@dataclass
+class SyncOutcome:
+    """Result of one :func:`try_sync_with` round.  ``behind=True`` means the
+    peer could not be reached within the retry budget: nothing was lost (the
+    store is untouched or merely partially ahead), the local frontier is
+    simply behind that peer until a later anti-entropy round succeeds."""
+
+    pulled: int = 0
+    pushed: int = 0
+    ok: bool = True
+    error: Optional[str] = None
+
+    @property
+    def behind(self) -> bool:
+        return not self.ok
+
+
 # -- framing ----------------------------------------------------------------
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout as exc:
+            raise TransportError(
+                f"peer stalled: recv deadline exceeded with {n - len(buf)} "
+                "bytes outstanding"
+            ) from exc
         if not chunk:
             raise ConnectionError("peer closed mid-message")
         buf.extend(chunk)
@@ -79,7 +146,10 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _send_message(sock: socket.socket, kind: bytes, body: bytes) -> None:
-    sock.sendall(_LEN.pack(len(body) + 1) + kind + body)
+    try:
+        sock.sendall(_LEN.pack(len(body) + 1) + kind + body)
+    except socket.timeout as exc:
+        raise TransportError("peer stalled: send deadline exceeded") from exc
 
 
 def _recv_message(sock: socket.socket) -> Tuple[bytes, bytes]:
@@ -96,17 +166,19 @@ def _send_frontier(sock: socket.socket, clock: Clock) -> None:
 
 def _parse_frontier(body: bytes) -> Clock:
     """Decode and validate a frontier message: must be ``{actor: seq}`` with
-    string keys and int seqs — anything else is a protocol error, normalized
-    to ValueError so both endpoints' error contracts stay uniform."""
+    string keys and int seqs — anything else is a protocol error, typed as
+    :class:`DecodeError` (a ValueError) so both endpoints' error contracts
+    stay uniform and ``try_sync_with`` can absorb a corrupt peer as a
+    ``behind`` outcome."""
     try:
         clock = json.loads(body)
     except json.JSONDecodeError as exc:
-        raise ValueError(f"bad frontier: {exc}") from exc
+        raise DecodeError(f"bad frontier: {exc}") from exc
     if not isinstance(clock, dict) or not all(
         isinstance(k, str) and isinstance(v, int) and not isinstance(v, bool)
         for k, v in clock.items()
     ):
-        raise ValueError("bad frontier: expected {actor: seq}")
+        raise DecodeError("bad frontier: expected {actor: seq}")
     return clock
 
 
@@ -196,15 +268,19 @@ class ReplicaServer:
         port: int = 0,
         on_changes: Optional[Callable[[List[Change]], None]] = None,
         on_frame: Optional[Callable[[bytes], None]] = None,
+        timeout: float = 30.0,
     ) -> None:
         """``on_changes`` receives each batch of newly-merged decoded
         changes; ``on_frame`` receives the RAW inbound frame bytes whenever
         it carried anything new — the zero-copy hook for feeding a device
         session's ``ingest_frame`` (frames are duplicate-tolerant, so
-        redelivered changes inside the frame are harmless)."""
+        redelivered changes inside the frame are harmless).  ``timeout`` is
+        the per-connection socket deadline: a peer that stalls mid-exchange
+        holds a handler thread for at most this long."""
         self.store = store
         self.on_changes = on_changes
         self.on_frame = on_frame
+        self.timeout = timeout
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -240,20 +316,34 @@ class ReplicaServer:
                 target=self._serve_one, args=(conn,), daemon=True
             ).start()
 
-    def sync_with(self, host: str, port: int, timeout: float = 30.0) -> Tuple[int, int]:
+    def sync_with(
+        self, host: str, port: int, timeout: Optional[float] = None,
+        retry: Optional[RetryPolicy] = None,
+    ) -> Tuple[int, int]:
         """Outbound anti-entropy round sharing this server's store lock, so a
         node that serves peers and pulls from peers concurrently stays
         consistent."""
         return sync_with(
             self.store, host, port,
             on_changes=self.on_changes, timeout=timeout, lock=self._lock,
-            on_frame=self.on_frame,
+            on_frame=self.on_frame, retry=retry,
+        )
+
+    def try_sync_with(
+        self, host: str, port: int, retry: Optional[RetryPolicy] = None,
+    ) -> SyncOutcome:
+        """Non-raising outbound round: terminal transport failure becomes a
+        ``behind`` outcome for the next anti-entropy pass."""
+        return try_sync_with(
+            self.store, host, port,
+            on_changes=self.on_changes, lock=self._lock,
+            on_frame=self.on_frame, retry=retry,
         )
 
     def _serve_one(self, conn: socket.socket) -> None:
         try:
             with conn:
-                conn.settimeout(30)
+                conn.settimeout(self.timeout)
                 peer_clock = _parse_frontier(_expect(conn, MSG_FRONTIER))
                 with self._lock:
                     my_clock = self.store.clock()
@@ -279,10 +369,49 @@ class ReplicaServer:
         except (ConnectionError, ValueError, OSError, PeritextError):
             # a bad peer (bad framing, corrupt frame, malformed frontier, or a
             # change batch with log gaps) must not take the server down
+            GLOBAL_COUNTERS.add("transport.server_errors")
             return
 
 
 # -- client -----------------------------------------------------------------
+
+
+def _sync_once(
+    store: ChangeStore,
+    host: str,
+    port: int,
+    timeout: float,
+    lock: threading.Lock,
+    want_frames: bool,
+) -> Tuple[List[Change], int, List[bytes]]:
+    """One attempt of the bidirectional exchange (see :func:`sync_with`).
+    The store mutates only AFTER the socket closes cleanly, so a failed
+    attempt is side-effect free and safe to retry.  Returns the freshly
+    merged changes, the pushed count, and the raw inbound frames —
+    on_frame/on_changes delivery happens in the CALLER, outside the retried
+    region: a callback failure after a successful merge is a local error,
+    and retrying it would skip the callbacks entirely (the reconnect pulls
+    only duplicates)."""
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.settimeout(timeout)  # per-socket deadline on every send/recv
+        with lock:
+            my_clock = store.clock()
+        _send_frontier(sock, my_clock)
+        inbound, frames = _recv_changes(sock, want_frames=want_frames)
+        peer_clock = _parse_frontier(_expect(sock, MSG_FRONTIER))
+        with lock:
+            outbound = store.missing_changes(store.clock(), peer_clock)
+        _send_changes(sock, outbound)
+    with lock:
+        fresh = merge_changes(store, inbound)
+    return fresh, len(outbound), frames
+
+
+#: what a retry may absorb: connect/stall/teardown (OSError family, incl.
+#: socket.timeout and our TransportError) and protocol corruption
+#: (ValueError, incl. DecodeError).  A CausalityError from merge_changes is
+#: NOT transport — a genuine log gap propagates to the caller.
+_RETRYABLE = (OSError, ValueError)
 
 
 def sync_with(
@@ -290,34 +419,108 @@ def sync_with(
     host: str,
     port: int,
     on_changes: Optional[Callable[[List[Change]], None]] = None,
-    timeout: float = 30.0,
+    timeout: Optional[float] = None,
     lock: Optional[threading.Lock] = None,
     on_frame: Optional[Callable[[bytes], None]] = None,
+    retry: Optional[RetryPolicy] = None,
 ) -> Tuple[int, int]:
     """One full bidirectional anti-entropy round against a peer.
 
-    Returns ``(pulled, pushed)`` change counts.  Raises ConnectionError /
-    ValueError on transport or frame corruption (the caller retries; the
-    store is never left partially inconsistent because logs are append-only
-    and merge_changes skips duplicates).  Pass ``lock`` when other threads
+    Returns ``(pulled, pushed)`` change counts.  Every socket operation runs
+    under a per-socket deadline — an explicitly-passed ``timeout`` wins,
+    else the retry policy's ``timeout`` (30 s with no policy) — so a stalled
+    peer raises :class:`TransportError` instead of hanging.  With a
+    :class:`RetryPolicy`, transport-level failures (connect refused, stall,
+    teardown, corrupt protocol bytes) retry with exponential backoff +
+    jitter; a terminal connect/stall/teardown failure raises
+    :class:`TransportError`, while terminal protocol corruption keeps its
+    typed :class:`~..core.errors.DecodeError`/ValueError surface (the
+    pre-retry contract).  Retrying is always safe: the store mutates only
+    after a complete exchange, logs are append-only, and merge_changes
+    skips duplicates.  ``on_frame``/``on_changes`` run once, after the
+    successful attempt — an exception they raise propagates unwrapped (it
+    is a local failure, not transport).  Pass ``lock`` when other threads
     (e.g. a ReplicaServer on the same store) mutate the store concurrently.
     """
     lock = lock or threading.Lock()
-    with socket.create_connection((host, port), timeout=timeout) as sock:
-        with lock:
-            my_clock = store.clock()
-        _send_frontier(sock, my_clock)
-        inbound, frames = _recv_changes(sock, want_frames=on_frame is not None)
-        peer_clock = _parse_frontier(_expect(sock, MSG_FRONTIER))
-        with lock:
-            outbound = store.missing_changes(store.clock(), peer_clock)
-        _send_changes(sock, outbound)
-    with lock:
-        fresh = merge_changes(store, inbound)
-    if fresh:
-        if on_frame is not None:  # before on_changes; see ReplicaServer
-            for one in frames:
-                on_frame(one)
-        if on_changes is not None:
-            on_changes(fresh)
-    return len(fresh), len(outbound)
+    policy = retry or NO_RETRY
+    deadline = timeout if timeout is not None else policy.timeout
+    rng = random.Random()
+    last: Optional[BaseException] = None
+    for attempt in range(max(1, policy.attempts)):
+        if attempt:
+            GLOBAL_COUNTERS.add("transport.retries")
+            time.sleep(policy.delay(attempt - 1, rng))
+        try:
+            fresh, pushed, frames = _sync_once(
+                store, host, port, deadline, lock, on_frame is not None
+            )
+        except _RETRYABLE as exc:
+            last = exc
+            continue
+        if fresh:
+            if on_frame is not None:  # before on_changes; see ReplicaServer
+                for one in frames:
+                    on_frame(one)
+            if on_changes is not None:
+                on_changes(fresh)
+        return len(fresh), pushed
+    if isinstance(last, ValueError) and not isinstance(last, OSError):
+        raise last  # protocol corruption: keep the typed DecodeError surface
+    raise TransportError(
+        f"sync with {host}:{port} failed after {max(1, policy.attempts)} "
+        f"attempt(s): {last!r}"
+    ) from last
+
+
+def try_sync_with(
+    store: ChangeStore,
+    host: str,
+    port: int,
+    on_changes: Optional[Callable[[List[Change]], None]] = None,
+    lock: Optional[threading.Lock] = None,
+    on_frame: Optional[Callable[[bytes], None]] = None,
+    retry: Optional[RetryPolicy] = None,
+) -> SyncOutcome:
+    """Anti-entropy round that NEVER raises on transport failure: a peer
+    that stays unreachable through the retry budget yields a ``behind``
+    :class:`SyncOutcome` — the local store is simply behind that peer's
+    frontier, and the next successful round repairs it (append-only,
+    duplicate-tolerant).  A peer shipping corrupt protocol bytes through
+    the retry budget (:class:`DecodeError`) is the same state — behind
+    until a clean round.  Non-transport errors (e.g. a genuine log gap, or
+    a failure inside the caller's own on_frame/on_changes callback) still
+    propagate: they indicate local problems a retry cannot fix."""
+    policy = retry or RetryPolicy()
+
+    # fence the caller's callbacks off from the exchange's own error space:
+    # a DecodeError raised INSIDE on_frame/on_changes is a local delivery
+    # failure (the store already merged the pull — "behind" would be a lie
+    # no later round repairs), so it must propagate, while the same type
+    # from the exchange itself is a corrupt peer and absorbs as behind
+    class _CallbackFailed(Exception):
+        pass
+
+    def _fenced(cb):
+        if cb is None:
+            return None
+
+        def run(arg):
+            try:
+                cb(arg)
+            except Exception as exc:
+                raise _CallbackFailed() from exc
+
+        return run
+
+    try:
+        pulled, pushed = sync_with(
+            store, host, port, on_changes=_fenced(on_changes),
+            lock=lock, on_frame=_fenced(on_frame), retry=policy,
+        )
+    except _CallbackFailed as exc:
+        raise exc.__cause__
+    except (TransportError, DecodeError) as exc:
+        GLOBAL_COUNTERS.add("transport.behind_peers")
+        return SyncOutcome(ok=False, error=str(exc))
+    return SyncOutcome(pulled=pulled, pushed=pushed)

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 
 from .. import native
 from ..core.doc import Doc
+from ..core.errors import DecodeError
 from ..core.types import Change, Clock, FormatSpan
 from ..observability import GLOBAL_COUNTERS
 from ..ops.decode import decode_doc_spans
@@ -374,6 +375,34 @@ _COMPACT_CACHE_BYTES = int(
 )
 
 
+#: quarantine reasons — the fault-domain vocabulary.  ``decode``: a wire
+#: frame failed codec decode/validation (the doc's log has a gap until
+#: anti-entropy re-ships it; device state is untouched).  ``capacity`` /
+#: ``schedule`` / ``encode``: the doc left the device path for scalar
+#: replay (degraded but correct).  ``device-round``: the supervisor rolled
+#: a failed guarded round back and demoted the doc's pending work to
+#: scalar replay.
+REASON_DECODE = "decode"
+REASON_CAPACITY = "capacity"
+REASON_SCHEDULE = "schedule"
+REASON_ENCODE = "encode"
+REASON_DEVICE_ROUND = "device-round"
+
+
+@dataclass
+class QuarantineRecord:
+    """Why one doc is quarantined (typed reason + free-form detail), and at
+    which session round the quarantine was imposed."""
+
+    reason: str
+    detail: str = ""
+    round: int = 0
+    #: a clean delivery for the doc has arrived since the corrupt one — the
+    #: first half of the ``decode`` re-admission condition (the second half
+    #: is the doc draining with no stuck work; see _sweep_decode_quarantine)
+    clean_delivery: bool = False
+
+
 @dataclass
 class _DocSession:
     encoder: Optional[DocEncoder] = None
@@ -466,6 +495,13 @@ class StreamingMerge:
             self._padded_docs if mesh is not None else max(1, min(read_chunk, max(num_docs, 1)))
         )
         self.docs = [_DocSession() for _ in range(num_docs)]
+        #: fault-domain registry: doc -> QuarantineRecord.  Quarantine is
+        #: health METADATA — it never changes read routing by itself (a
+        #: demoted doc is additionally in ``fallback``); a ``decode``
+        #: quarantine auto-lifts once a later clean delivery arrives for the
+        #: doc AND its pending work drains (anti-entropy repair; see
+        #: _sweep_decode_quarantine).
+        self._quarantine: Dict[int, QuarantineRecord] = {}
         self.rounds = 0
         #: cumulative wall seconds in the native wire parse (bench stage)
         self.host_parse_seconds = 0.0
@@ -560,15 +596,17 @@ class StreamingMerge:
         sess.pending.extend(changes)
         self._object_pending.add(doc_index)
 
-    def ingest_frame(self, doc_index: int, data: bytes) -> None:
+    def ingest_frame(self, doc_index: int, data: bytes,
+                     on_corrupt: str = "raise") -> None:
         """Queue one binary change frame (the wire format a peer host ships,
-        parallel/codec.py) for one document.  Raises ValueError on corrupt
-        frames (nothing is queued).  This is the single-frame convenience
+        parallel/codec.py) for one document.  Raises :class:`DecodeError`
+        (a ValueError) on corrupt frames (nothing is queued) unless
+        ``on_corrupt="quarantine"``.  This is the single-frame convenience
         form of :meth:`ingest_frames` — a host draining a DCN receive queue
         should hand the whole batch over at once."""
-        self.ingest_frames([(doc_index, data)])
+        self.ingest_frames([(doc_index, data)], on_corrupt=on_corrupt)
 
-    def ingest_frames(self, items: Iterable) -> None:
+    def ingest_frames(self, items: Iterable, on_corrupt: str = "raise") -> None:
         """Bulk-queue binary change frames, many docs per call — the native
         fast path at pod scale: ONE C++ call parses every frame (header,
         string tables, varint payload, packed identifiers) straight into flat
@@ -576,9 +614,19 @@ class StreamingMerge:
         leaves the fast path.
 
         ``items`` is an iterable of ``(doc_index, frame_bytes)``.  Frames are
-        processed in order; corrupt frames contribute nothing and raise one
-        ValueError (naming the affected docs) after all parseable frames have
-        been queued."""
+        processed in order; corrupt frames contribute nothing, quarantine
+        their doc (typed reason ``decode``), and — per-doc fault isolation —
+        never block the other docs' frames, which are all queued first.
+
+        ``on_corrupt`` picks the failure surface: ``"raise"`` (default, the
+        pre-supervisor contract) raises one :class:`DecodeError` naming the
+        affected docs after everything parseable has been queued;
+        ``"quarantine"`` absorbs the fault entirely — the quarantine registry
+        plus counters are the only signal, and the quarantine lifts
+        automatically once a later clean delivery for the doc arrives and
+        its pending work drains (anti-entropy repair)."""
+        if on_corrupt not in ("raise", "quarantine"):
+            raise ValueError(f"unknown on_corrupt mode: {on_corrupt!r}")
         items = list(items)
         fast: List = []
         corrupt: List[int] = []
@@ -597,8 +645,24 @@ class StreamingMerge:
                 fast.append((doc_index, data))
         if fast:
             corrupt.extend(self._ingest_frames_native(fast))
-        if corrupt:
-            raise ValueError(f"corrupt frame(s) for doc(s) {sorted(set(corrupt))}")
+        bad = set(corrupt)
+        # anti-entropy repair, first half: note which decode-quarantined docs
+        # saw a clean delivery; re-admission happens once the doc also drains
+        # with no stuck work (_sweep_decode_quarantine)
+        for d in {int(d) for d, _ in items} - bad:
+            rec = self._quarantine.get(int(d))
+            if rec is not None and rec.reason == REASON_DECODE:
+                rec.clean_delivery = True
+        if bad:
+            GLOBAL_COUNTERS.add("streaming.corrupt_frames", len(corrupt))
+            for d in bad:
+                self.quarantine_doc(
+                    int(d), REASON_DECODE, "corrupt wire frame discarded"
+                )
+            if on_corrupt == "raise":
+                raise DecodeError(
+                    f"corrupt frame(s) for doc(s) {sorted(bad)}"
+                )
 
     def _ingest_frames_native(self, items: List) -> List[int]:
         """Bulk-parse eligible frames; returns doc indices of corrupt frames."""
@@ -690,7 +754,10 @@ class StreamingMerge:
                     # semantics — contribute nothing, keep the doc's state
                     corrupt.append(d)
                     continue
-                self._demote_frame_doc(d, extra=extra)
+                self._demote_frame_doc(
+                    d, extra=extra, reason=REASON_SCHEDULE,
+                    detail="frame parseable but not device-expressible",
+                )
             else:
                 sess.frames.append(data)
                 sess.text_obj = text_objs[d]
@@ -707,10 +774,116 @@ class StreamingMerge:
                 self._pool.append((doc_of[sel], parsed.select(sel)))
         return corrupt
 
-    def _demote_frame_doc(self, doc_index: int, extra: List[Change] = ()) -> None:
+    # -- fault-domain quarantine -------------------------------------------
+
+    def quarantine_doc(self, doc_index: int, reason: str,
+                       detail: str = "") -> None:
+        """Quarantine one doc with a typed reason.  Idempotent per doc with
+        one escalation rule: a demotion-class reason OVERWRITES a ``decode``
+        record (the doc's routing really changed — a later clean frame must
+        not lift the record while the doc sits on the scalar path), while a
+        repeated fault never re-labels an existing same-class record."""
+        rec = self._quarantine.get(doc_index)
+        if rec is None:
+            self._quarantine[doc_index] = QuarantineRecord(
+                reason=reason, detail=detail, round=self.rounds
+            )
+            GLOBAL_COUNTERS.add("streaming.quarantined_docs")
+        elif rec.reason == REASON_DECODE and reason != REASON_DECODE:
+            self._quarantine[doc_index] = QuarantineRecord(
+                reason=reason, detail=detail, round=self.rounds
+            )
+        elif rec.reason == REASON_DECODE and reason == REASON_DECODE:
+            # a fresh corrupt frame invalidates any earlier repair evidence
+            rec.clean_delivery = False
+
+    def readmit(self, doc_index: int) -> bool:
+        """Lift a doc's quarantine (any reason); returns whether a record
+        was present.  Demotion-class reasons leave the doc on the scalar
+        path — re-admission clears the health flag, not the routing."""
+        if self._quarantine.pop(doc_index, None) is not None:
+            GLOBAL_COUNTERS.add("streaming.readmitted_docs")
+            return True
+        return False
+
+    def _sweep_decode_quarantine(self) -> None:
+        """Auto re-admission, second half: a ``decode``-quarantined doc
+        lifts once a clean delivery has arrived AND the doc has no pending
+        work left (a causal gap the corrupt frame tore keeps its dependents
+        pending, so a stuck doc stays quarantined until anti-entropy really
+        re-ships the missing changes).  Only ``decode`` records lift —
+        demotion-class records describe device-path state that a new frame
+        does not repair.  Note the limit: a gap with no local dependents is
+        locally undetectable (the wire format has no checksum — see ROADMAP
+        "Wire-frame checksum"); the frontier diff of the next anti-entropy
+        round is what closes that window."""
+        candidates = [
+            d for d, r in self._quarantine.items()
+            if r.reason == REASON_DECODE and r.clean_delivery
+        ]
+        if not candidates:
+            return
+        pending = self.pending_docs()
+        for d in candidates:
+            if d not in pending:
+                self.readmit(d)
+
+    def quarantined(self) -> Dict[int, QuarantineRecord]:
+        """Snapshot of the quarantine registry (doc -> record); sweeps any
+        ``decode`` record whose re-admission condition is now met, so the
+        snapshot never reports a repaired doc as sick."""
+        self._sweep_decode_quarantine()
+        return dict(self._quarantine)
+
+    def pending_docs(self) -> set:
+        """Docs with undelivered (pending or pooled) changes."""
+        out = {d for d, s in enumerate(self.docs) if s.pending}
+        for doc_of, _ in self._pool:
+            out.update(int(x) for x in np.unique(doc_of))
+        return out
+
+    def force_fallback(self, doc_index: int,
+                       reason: str = REASON_DEVICE_ROUND,
+                       detail: str = "") -> None:
+        """Demote one doc to scalar replay (degraded but correct) and
+        quarantine it with ``reason`` — the supervisor's containment move
+        after a failed guarded device round.  Frame docs replay their frame
+        history; object docs fold pending work into the replay log."""
+        sess = self.docs[doc_index]
+        if sess.frame_mode:
+            self._demote_frame_doc(doc_index, reason=reason, detail=detail)
+            return
+        if not sess.fallback:
+            sess.fallback = True
+            GLOBAL_COUNTERS.add("streaming.fallback_docs")
+        sess.log.extend(sess.pending)
+        sess.pending = []
+        self._object_pending.discard(doc_index)
+        self.quarantine_doc(doc_index, reason, detail)
+
+    def health(self) -> Dict:
+        """One structured snapshot of the session's fault-domain state —
+        what a fleet health endpoint would export per session."""
+        return {
+            "rounds": self.rounds,
+            "num_docs": self.num_docs,
+            "pending_changes": self.pending_count(),
+            "fallback_docs": sum(1 for s in self.docs if s.fallback),
+            "frame_docs": int(self._frame_mode.sum()),
+            "quarantined": {
+                d: {"reason": r.reason, "detail": r.detail, "round": r.round}
+                for d, r in sorted(self.quarantined().items())
+            },
+        }
+
+    def _demote_frame_doc(self, doc_index: int, extra: List[Change] = (),
+                          reason: str = REASON_CAPACITY,
+                          detail: str = "") -> None:
         """Leave the fast path: the doc becomes a scalar-replay fallback fed
         by its decoded frame history (its device rows may already hold applied
-        ops, so only the oracle path is still correct for it)."""
+        ops, so only the oracle path is still correct for it).  The doc is
+        quarantined with ``reason`` so health snapshots can attribute the
+        demotion."""
         sess = self.docs[doc_index]
         changes = [ch for f in sess.frames for ch in decode_frame(f)]
         changes.extend(extra)
@@ -728,6 +901,7 @@ class StreamingMerge:
         sess.text_obj = 0
         sess.fallback = True
         GLOBAL_COUNTERS.add("streaming.fallback_docs")
+        self.quarantine_doc(doc_index, reason, detail)
 
     # -- the incremental device round --------------------------------------
 
@@ -741,6 +915,7 @@ class StreamingMerge:
         enc, widths, scheduled = self._schedule_round()
         if scheduled:
             self._commit_rounds([(enc, widths)])
+        self._sweep_decode_quarantine()
         return scheduled
 
     def _schedule_round(self):
@@ -776,10 +951,16 @@ class StreamingMerge:
                 # scheduler does the same via its demote status
                 sess.fallback = True
                 GLOBAL_COUNTERS.add("streaming.fallback_docs")
+                self.quarantine_doc(
+                    i, REASON_CAPACITY, "change exceeds round stream widths"
+                )
             streams, ok = sess.encoder.encode_increment(admitted)
             if not ok:
                 sess.fallback = True
                 GLOBAL_COUNTERS.add("streaming.fallback_docs")
+                self.quarantine_doc(
+                    i, REASON_ENCODE, "change not device-expressible"
+                )
             else:
                 for ch in admitted:
                     sess.clock[ch.actor] = ch.seq
@@ -1175,7 +1356,11 @@ class StreamingMerge:
                 enc.mark_count[r] = 0
                 enc.map_count[r] = 0
                 enc.num_ops[r] = 0
-                self._demote_frame_doc(i)  # folds + zeroes the doc's clock row
+                # folds + zeroes the doc's clock row
+                self._demote_frame_doc(
+                    i, reason=REASON_SCHEDULE,
+                    detail="batched scheduler demoted the doc's round",
+                )
 
         defer = admitted == 0
         if demoted_docs is not None:
@@ -1222,7 +1407,10 @@ class StreamingMerge:
                 enc.ins_op[r] = 0
                 enc.ins_char[r] = 0
                 enc.del_target[r] = 0
-                self._demote_frame_doc(i)
+                self._demote_frame_doc(
+                    i, reason=REASON_SCHEDULE,
+                    detail="scalar scheduler rejected the doc's round",
+                )
                 continue
             if deferred.num_changes:
                 self._pool.append(
@@ -1256,6 +1444,7 @@ class StreamingMerge:
                 break
             self._commit_rounds(batch)
             rounds += len(batch)
+        self._sweep_decode_quarantine()
         return rounds
 
     @staticmethod

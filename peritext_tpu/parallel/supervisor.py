@@ -1,0 +1,313 @@
+"""Fault-domain supervisor: guarded device rounds over a streaming session.
+
+The streaming engine already contains faults to the smallest doc-level unit
+(per-doc quarantine, scalar-replay fallback) — this module supervises the
+one fault domain a doc cannot contain by itself: the DEVICE ROUND.  A hung
+XLA dispatch, a poisoned compiled program, or a runtime device error takes
+out the whole session's round, so the supervisor wraps every ``step`` in a
+wall-clock watchdog and, on deadline or device error, walks the degradation
+ladder (DESIGN.md "Fault domains & degradation ladder"):
+
+1. **guarded round** — ``step`` runs on a watchdog thread; a round that
+   overruns ``deadline`` seconds raises :class:`DeviceRoundError` instead of
+   wedging the caller (the stuck dispatch is abandoned with its session
+   object — JAX owns the thread, we own the state).
+2. **checkpoint rollback** — the session is rebuilt from the last good
+   checkpoint (``checkpoint.CheckpointManager``: atomic staging+rename, so
+   a crash mid-save can never corrupt it), and every frame ingested since
+   that checkpoint is replayed from the supervisor's journal (frames are
+   duplicate-tolerant, so journal/checkpoint overlap is harmless).
+3. **guarded re-drain** — the restored session drains on device under the
+   same watchdog; a transient fault (one bad round) fully recovers here.
+4. **scalar degradation** — if the device path is still failing, every doc
+   with pending work is demoted to scalar replay
+   (``StreamingMerge.force_fallback``) and quarantined with reason
+   ``device-round``: degraded throughput, byte-identical convergence.
+
+Callers above the ``ingest_frame``/``step`` boundary never see a device
+fault — ``step`` returns 0 for a rolled-back round, and the health snapshot
+carries the evidence (rollback count, quarantine registry).
+"""
+
+from __future__ import annotations
+
+import threading
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.errors import DeviceRoundError
+from ..observability import GLOBAL_COUNTERS
+from .streaming import REASON_DEVICE_ROUND, StreamingMerge
+
+
+class GuardedSession:
+    """A :class:`StreamingMerge` under fault-domain supervision.
+
+    ``factory`` builds a fresh, empty session (used at construction and as
+    the last-resort restore when no checkpoint exists yet).  All ingest must
+    flow through the supervisor so its journal stays complete; reads (and
+    any other method) pass through to ``self.session``.
+
+    ``deadline`` is the per-round wall-clock watchdog in seconds;
+    ``checkpoint_every`` counts successful guarded rounds between automatic
+    checkpoints (the rollback replay window is at most that many rounds of
+    journal).
+    """
+
+    def __init__(
+        self,
+        factory: Callable[[], StreamingMerge],
+        checkpoint_root: str | Path,
+        deadline: float = 30.0,
+        checkpoint_every: int = 8,
+        keep: int = 3,
+        mesh=None,
+    ) -> None:
+        from ..checkpoint import CheckpointManager
+
+        self._factory = factory
+        self.session = factory()
+        self.manager = CheckpointManager(checkpoint_root, keep=keep)
+        self.deadline = deadline
+        self.checkpoint_every = checkpoint_every
+        self.mesh = mesh
+        #: everything ingested since the last checkpoint, in order — the
+        #: rollback replay source (duplicate-tolerant, so overlap with the
+        #: checkpoint's own frame histories is safe).  Entries are
+        #: ``(doc, frame_bytes)`` or ``(doc, [Change, ...])`` — the object
+        #: path journals too, so no accepted ingest can vanish in a rollback
+        self._journal: List[Tuple[int, object]] = []
+        self._rounds_since_checkpoint = 0
+        # resume numbering above any existing checkpoint: starting at 0 over
+        # a pre-crash root would mint already-used low step numbers that
+        # retention immediately prunes, leaving latest() stuck on stale state
+        self._checkpoint_step = max(self.manager.steps(), default=0)
+        self.rollbacks = 0
+        self.checkpoints = 0
+        #: one-shot fault injection queues (chaos harness / tests)
+        self._inject_failures: List[Exception] = []
+        self._inject_delays: List[float] = []
+
+    # -- ingest (journalled) ------------------------------------------------
+
+    def ingest_frame(self, doc_index: int, data: bytes) -> None:
+        self.ingest_frames([(doc_index, data)])
+
+    def ingest_frames(self, items: Iterable) -> None:
+        """Journal + quarantine-mode ingest: corrupt frames are contained to
+        their doc (typed ``decode`` quarantine), never raised — the
+        supervisor's contract is that callers see no fault."""
+        items = list(items)
+        self._journal.extend(items)
+        self.session.ingest_frames(items, on_corrupt="quarantine")
+
+    def ingest(self, doc_index: int, changes: Iterable) -> None:
+        """Journalled object-change ingest (the editor/bridge surface) —
+        same completeness contract as frames: a rollback replays these too,
+        so changes the caller saw accepted can never silently vanish."""
+        changes = list(changes)
+        if not changes:
+            return
+        self._journal.append((doc_index, changes))
+        self.session.ingest(doc_index, changes)
+
+    # -- guarded rounds -----------------------------------------------------
+
+    def inject_failure(self, exc: Exception) -> None:
+        """Queue one device-round failure for the next :meth:`step` (chaos
+        harness hook — a real deployment gets these from XLA for free).
+        ``Exception`` only: step()'s containment handler deliberately lets
+        BaseException (KeyboardInterrupt, SystemExit) through."""
+        if not isinstance(exc, Exception):
+            raise TypeError(f"inject_failure wants an Exception, got {exc!r}")
+        self._inject_failures.append(exc)
+
+    def inject_delay(self, seconds: float) -> None:
+        """Queue one artificial round delay (deadline-path chaos hook)."""
+        self._inject_delays.append(seconds)
+
+    def _round(self) -> int:
+        # bind the session NOW: if the watchdog abandons this thread and the
+        # supervisor rolls back, a late-waking zombie must keep touching the
+        # abandoned session object, never the freshly restored one
+        session = self.session
+        if self._inject_delays:
+            import time
+
+            time.sleep(self._inject_delays.pop(0))
+        scheduled = session.step()
+        # Periodic guarded sync (a cheap device fetch), not per-round: step's
+        # async dispatch overlap is the streaming engine's whole throughput
+        # story, and containment doesn't need a sync every round — an async
+        # device error from round N surfaces inside round N+1's guarded
+        # dispatch (or here, before the next checkpoint), and rollback
+        # restores the same checkpoint+journal state either way.
+        if self._rounds_since_checkpoint + 1 >= self.checkpoint_every:
+            np.asarray(session.state.num_slots)
+        return scheduled
+
+    def _run_guarded(self, fn: Callable[[], int]) -> int:
+        box: Dict[str, object] = {}
+
+        def run() -> None:
+            try:
+                box["value"] = fn()
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                box["error"] = exc
+
+        worker = threading.Thread(target=run, daemon=True)
+        worker.start()
+        worker.join(self.deadline)
+        if worker.is_alive():
+            # the dispatch is wedged; abandon it (state is rebuilt from the
+            # checkpoint — the stuck thread can no longer corrupt anything
+            # the supervisor will use)
+            raise DeviceRoundError(
+                f"device round exceeded its {self.deadline}s deadline"
+            )
+        if "error" in box:
+            exc = box["error"]
+            if isinstance(exc, DeviceRoundError):
+                raise exc
+            raise DeviceRoundError(f"device round failed: {exc!r}") from exc
+        return int(box["value"])  # type: ignore[arg-type]
+
+    def step(self) -> int:
+        """One guarded device round.  Returns the changes scheduled, or 0
+        when the round was rolled back (the work is not lost: it recovered
+        on device during rollback, or was demoted to scalar replay)."""
+        try:
+            if self._inject_failures:
+                raise self._inject_failures.pop(0)
+            scheduled = self._run_guarded(self._round)
+        except Exception as exc:
+            self._rollback(exc)
+            return 0
+        self._rounds_since_checkpoint += 1
+        if self._rounds_since_checkpoint >= self.checkpoint_every:
+            try:
+                self.checkpoint()
+            except Exception:
+                # a failed save (disk full, permissions) must not breach the
+                # no-fault contract of step(); the journal was only truncated
+                # after a successful save, so rollback state stays complete —
+                # the next round simply retries the checkpoint
+                GLOBAL_COUNTERS.add("supervisor.checkpoint_failures")
+        return scheduled
+
+    def drain(self, max_rounds: int = 1000) -> int:
+        """Guarded drain: step until no admissible work remains (a rolled-
+        back round either recovered its work or demoted it, so the loop
+        always terminates)."""
+        rounds = 0
+        while rounds < max_rounds:
+            if self.step() == 0:
+                break
+            rounds += 1
+        return rounds
+
+    # -- checkpoint / rollback ---------------------------------------------
+
+    def checkpoint(self) -> Path:
+        """Persist the session (event-sourced frame histories) and truncate
+        the journal — this becomes the rollback target."""
+        self._checkpoint_step += 1
+        path = self.manager.save(step=self._checkpoint_step, session=self.session)
+        self._journal = []
+        self._rounds_since_checkpoint = 0
+        self.checkpoints += 1
+        GLOBAL_COUNTERS.add("supervisor.checkpoints")
+        return path
+
+    def _restore_base(self) -> StreamingMerge:
+        """Last good checkpoint (drain=False: draining happens under the
+        watchdog) + journal replay; a fresh session when no checkpoint
+        exists yet (the journal then holds the complete history)."""
+        latest = self.manager.latest()
+        restored: Optional[StreamingMerge] = None
+        if latest is not None:
+            restored = latest.session(mesh=self.mesh, drain=False)
+        if restored is None:
+            restored = self._factory()
+        # replay in journal order; consecutive frame entries batch through
+        # the native fast path, object entries replay via ingest so the
+        # doc keeps the routing mode the caller established
+        run: List[Tuple[int, bytes]] = []
+        for d, payload in self._journal:
+            if isinstance(payload, (bytes, bytearray)):
+                run.append((d, payload))
+                continue
+            if run:
+                restored.ingest_frames(run, on_corrupt="quarantine")
+                run = []
+            restored.ingest(d, list(payload))
+        if run:
+            restored.ingest_frames(run, on_corrupt="quarantine")
+        return restored
+
+    def _rollback(self, error: BaseException) -> None:
+        """Degradation ladder steps 2-4 (see module docstring)."""
+        self.rollbacks += 1
+        GLOBAL_COUNTERS.add("supervisor.rollbacks")
+        self.session = self._restore_base()
+        try:
+            self._run_guarded(self._drain_device)
+        except Exception as exc:
+            # the device path is still sick: rebuild once more from durable
+            # state (a deadline here may have left a zombie thread draining
+            # the object we just restored — abandon it too), then contain:
+            # every doc with pending work replays on the scalar path
+            restored = self._restore_base()
+            self.session = restored
+            for d in sorted(restored.pending_docs()):
+                restored.force_fallback(
+                    d, REASON_DEVICE_ROUND,
+                    detail=f"rollback after {error!r}; re-drain failed: {exc!r}",
+                )
+            GLOBAL_COUNTERS.add("supervisor.scalar_degradations")
+
+    def _drain_device(self) -> int:
+        session = self.session  # zombie-safety: see _round
+        rounds = 0
+        while session.drain() > 0:
+            rounds += 1
+        np.asarray(session.state.num_slots)
+        return rounds
+
+    # -- pass-throughs ------------------------------------------------------
+
+    def read(self, doc_index: int):
+        return self.session.read(doc_index)
+
+    def read_all(self):
+        return self.session.read_all()
+
+    def digest(self, **kw) -> int:
+        return self.session.digest(**kw)
+
+    def quarantined(self):
+        return self.session.quarantined()
+
+    def __getattr__(self, name: str):
+        # every other PUBLIC session method (read_patches, pending_count,
+        # frontier, ...) passes through; private names stay local so a
+        # half-constructed supervisor can never recurse here
+        session = self.__dict__.get("session")
+        if session is not None and not name.startswith("_"):
+            return getattr(session, name)
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}"
+        )
+
+    def health(self) -> Dict:
+        """Session health plus the supervisor's own fault evidence."""
+        out = self.session.health()
+        out.update(
+            rollbacks=self.rollbacks,
+            checkpoints=self.checkpoints,
+            journal_frames=len(self._journal),
+            deadline_seconds=self.deadline,
+        )
+        return out

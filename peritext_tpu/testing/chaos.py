@@ -1,0 +1,320 @@
+"""Chaos harness: every fault class composed against the byte-equality oracle.
+
+One :func:`run_chaos` campaign drives a supervised streaming session
+(:class:`~..parallel.supervisor.GuardedSession`) through the full fault
+space the fault-domain supervisor exists to absorb, in one seeded run:
+
+* **delivery faults** — per-frame drop / duplicate / reorder
+  (:class:`~..parallel.faults.FaultSpec`), repaired by redelivery;
+* **payload corruption** — truncated / bit-flipped frames
+  (:func:`~..parallel.faults.corrupt_detectably`) against a victim subset of
+  docs: the codec must reject them (:class:`DecodeError`), the session must
+  quarantine exactly those docs with reason ``decode`` and keep the healthy
+  docs converging (per-doc fault isolation, checked mid-run);
+* **injected device-round failures** — the supervisor's watchdog/rollback
+  path: roll back to the last good checkpoint and replay the journal;
+* **scalar degradation** — on some seeds one doc is force-demoted to scalar
+  replay mid-run (the ladder's last rung) and must still hash byte-equal;
+* **peer stall** — a bound-but-unresponsive TCP peer: the transport's
+  socket deadline + bounded retry must surface a ``behind``
+  :class:`SyncOutcome`, never a hang, and a real peer must then repair;
+* **crash-restore** — the supervised session is dropped mid-run and rebuilt
+  from its latest checkpoint, then repaired by overlapping redelivery.
+
+The oracle is BYTE EQUALITY: after a final full anti-entropy repair the
+chaos session's convergence digest must equal a fault-free session's digest
+bit-for-bit, every doc's spans must equal the scalar oracle's, no doc may
+remain decode-quarantined (auto re-admission), and nothing may remain
+pending.  Any unhandled exception fails the campaign.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import tempfile
+from dataclasses import asdict, dataclass
+from typing import Dict, List
+
+from ..api.batch import _oracle_doc
+from ..core.errors import DeviceRoundError
+from ..parallel.codec import encode_frame
+from ..parallel.faults import FaultSpec, corrupt_detectably
+from ..parallel.streaming import REASON_DECODE, REASON_DEVICE_ROUND
+from ..parallel.supervisor import GuardedSession
+from .fuzz import _campaign_session, generate_workload
+
+#: the composed fault mix one chaos campaign applies to victim docs
+CHAOS_SPEC = FaultSpec(
+    drop_p=0.15, dup_p=0.15, reorder=True, truncate_p=0.3, bitflip_p=0.3
+)
+
+
+@dataclass
+class ChaosReport:
+    """Evidence from one seeded chaos campaign (all oracles already held —
+    a violated oracle raises instead of returning)."""
+
+    seed: int
+    num_docs: int
+    delivered_frames: int = 0
+    corrupt_frames: int = 0
+    dropped_frames: int = 0
+    quarantined_peak: int = 0
+    rollbacks: int = 0
+    crash_restores: int = 0
+    transport_behind: int = 0
+    transport_repaired: bool = False
+    isolation_checked: bool = False
+    scalar_degraded_docs: int = 0
+    final_digest: int = 0
+
+    def to_json(self) -> Dict:
+        return asdict(self)
+
+
+class _StallingPeer:
+    """A TCP endpoint that accepts connections into its backlog and never
+    speaks: the client's connect and first send succeed, then every recv
+    stalls — exactly the peer failure `_recv_exact` used to hang on."""
+
+    def __init__(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.address = self._sock.getsockname()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _chaos_transport_episode(workload, report: ChaosReport) -> None:
+    """Peer-stall + repair: a stalled peer must yield a ``behind`` outcome
+    within the retry budget (no hang, no exception), and a healthy peer must
+    then converge the store."""
+    from ..parallel.anti_entropy import ChangeStore
+    from ..parallel.multihost import ReplicaServer, RetryPolicy, try_sync_with
+
+    full = ChangeStore()
+    for log in workload.values():
+        for change in log:
+            full.append(change)
+    local = ChangeStore()
+    policy = RetryPolicy(attempts=2, base_delay=0.01, max_delay=0.05,
+                         jitter=0.5, timeout=0.3)
+
+    stalled = _StallingPeer()
+    try:
+        outcome = try_sync_with(local, *stalled.address, retry=policy)
+        assert outcome.behind and not outcome.ok, (
+            "stalled peer must surface as a behind frontier"
+        )
+        report.transport_behind += 1
+    finally:
+        stalled.close()
+
+    server = ReplicaServer(full, timeout=5.0)
+    host, port = server.start()
+    try:
+        outcome = try_sync_with(local, host, port, retry=policy)
+        assert outcome.ok and outcome.pulled > 0
+    finally:
+        server.stop()
+    assert local.clock() == full.clock(), "repair round must converge the store"
+    report.transport_repaired = True
+
+
+def run_chaos(
+    seed: int,
+    num_docs: int = 6,
+    ops_per_doc: int = 40,
+    deadline: float = 60.0,
+    transport: bool = True,
+    crash: bool = True,
+    checkpoint_every: int = 4,
+) -> ChaosReport:
+    """One seeded chaos campaign (see module docstring).  Raises on any
+    oracle violation or unhandled fault; returns the evidence report."""
+    rng = random.Random(seed ^ 0xC4A05)
+    report = ChaosReport(seed=seed, num_docs=num_docs)
+
+    workloads = generate_workload(seed, num_docs=num_docs, ops_per_doc=ops_per_doc)
+    oracle_docs = [_oracle_doc(w) for w in workloads]
+
+    # fault-free reference session: the byte-equality digest anchor
+    clean = _campaign_session(num_docs, ops_per_doc)
+    plans: List[List[bytes]] = []
+    for d, w in enumerate(workloads):
+        changes = [ch for log in w.values() for ch in log]
+        rng.shuffle(changes)
+        chunk = rng.randrange(5, 12)
+        frames = [
+            encode_frame(changes[i:i + chunk])
+            for i in range(0, len(changes), chunk)
+        ]
+        plans.append(frames)
+        for f in frames:
+            clean.ingest_frame(d, f)
+    clean.drain()
+    clean_digest = clean.digest()
+
+    # the supervised chaos session
+    tmp = tempfile.TemporaryDirectory()
+    try:
+        factory = lambda: _campaign_session(num_docs, ops_per_doc)  # noqa: E731
+        guarded = GuardedSession(
+            factory, tmp.name, deadline=deadline,
+            checkpoint_every=checkpoint_every,
+        )
+        victims = set(rng.sample(range(num_docs),
+                                 max(1, num_docs // 3)))
+
+        # -- faulty delivery pass ------------------------------------------
+        device_faults = rng.randrange(1, 3)
+        for d, frames in enumerate(plans):
+            delivery = []
+            for f in frames:
+                if rng.random() < CHAOS_SPEC.drop_p:
+                    report.dropped_frames += 1
+                    continue
+                delivery.append(f)
+                if rng.random() < CHAOS_SPEC.dup_p:
+                    delivery.append(f)
+            rng.shuffle(delivery)
+            for f in delivery:
+                if d in victims:
+                    # detectable corruption only — the quarantine path's
+                    # whole fault domain; see faults.corrupt_detectably for
+                    # why undetectable damage models as clean delivery
+                    bad = corrupt_detectably(f, rng, CHAOS_SPEC)
+                    if bad is not None:
+                        f = bad
+                        report.corrupt_frames += 1
+                guarded.ingest_frame(d, f)
+                report.delivered_frames += 1
+                if rng.random() < 0.3:
+                    if device_faults and rng.random() < 0.15:
+                        guarded.inject_failure(
+                            DeviceRoundError("chaos: injected round failure")
+                            if rng.random() < 0.5
+                            else RuntimeError("chaos: injected XLA error")
+                        )
+                        device_faults -= 1
+                    guarded.step()
+        guarded.drain()
+        report.quarantined_peak = max(
+            report.quarantined_peak, len(guarded.quarantined())
+        )
+
+        # -- per-doc isolation oracle --------------------------------------
+        # while >=1 doc sits in quarantine, every healthy doc that received
+        # its full frame plan must already equal the oracle
+        if report.quarantined_peak:
+            quarantined_now = set(guarded.quarantined())
+            for d in range(num_docs):
+                if d in victims or d in quarantined_now:
+                    continue
+                # repair healthy docs' dropped frames first (clean redelivery)
+                guarded.ingest_frames([(d, f) for f in plans[d]])
+            guarded.drain()
+            still_quarantined = set(guarded.quarantined())
+            for d in range(num_docs):
+                if d in victims or d in still_quarantined:
+                    continue
+                expected = oracle_docs[d].get_text_with_formatting(["text"])
+                got = guarded.read(d)
+                assert got == expected, (
+                    f"seed={seed} doc={d}: healthy doc diverged while "
+                    f"{sorted(still_quarantined)} were quarantined"
+                )
+            report.isolation_checked = bool(still_quarantined)
+
+        # -- scalar-degradation rung (some seeds) --------------------------
+        if rng.random() < 0.5:
+            victim = rng.randrange(num_docs)
+            guarded.session.force_fallback(
+                victim, REASON_DEVICE_ROUND, "chaos: forced scalar replay"
+            )
+            report.scalar_degraded_docs = 1
+
+        # -- peer stall + transport repair ---------------------------------
+        if transport:
+            _chaos_transport_episode(workloads[rng.randrange(num_docs)], report)
+
+        # -- crash-restore -------------------------------------------------
+        if crash:
+            guarded.checkpoint()
+            # deliver a bit more that the crash will lose
+            for d, frames in enumerate(plans):
+                if frames and rng.random() < 0.5:
+                    guarded.ingest_frame(d, frames[rng.randrange(len(frames))])
+            guarded.step()
+            old_rollbacks = guarded.rollbacks
+            del guarded  # crash: the process state is gone
+            guarded = GuardedSession(
+                factory, tmp.name, deadline=deadline,
+                checkpoint_every=checkpoint_every,
+            )
+            restored = guarded.manager.latest()
+            assert restored is not None
+            guarded.session = restored.session(drain=True)
+            guarded.rollbacks = old_rollbacks
+            report.crash_restores += 1
+
+        # -- final anti-entropy repair + byte-equality oracle --------------
+        for d, frames in enumerate(plans):
+            guarded.ingest_frames([(d, f) for f in frames])
+        guarded.drain()
+        report.rollbacks = guarded.rollbacks
+
+        assert guarded.session.pending_count() == 0, (
+            f"seed={seed}: undelivered changes remain after repair"
+        )
+        decode_q = {
+            d: r for d, r in guarded.quarantined().items()
+            if r.reason == REASON_DECODE
+        }
+        assert not decode_q, (
+            f"seed={seed}: docs {sorted(decode_q)} still decode-quarantined "
+            "after clean redelivery (auto re-admission failed)"
+        )
+        final = guarded.digest()
+        assert final == clean_digest, (
+            f"seed={seed}: chaos digest {final:#010x} != fault-free digest "
+            f"{clean_digest:#010x}"
+        )
+        report.final_digest = final
+        for d in range(num_docs):
+            expected = oracle_docs[d].get_text_with_formatting(["text"])
+            got = guarded.read(d)
+            assert got == expected, (
+                f"seed={seed} doc={d}: spans diverge from oracle after repair"
+            )
+    finally:
+        tmp.cleanup()
+    return report
+
+
+def run_campaign(
+    seeds: range, num_docs: int = 6, ops_per_doc: int = 40,
+    verbose: bool = False, **kw,
+) -> List[ChaosReport]:
+    """Run one chaos campaign per seed; any oracle violation raises with the
+    seed in its message.  Returns all evidence reports."""
+    reports = []
+    for seed in seeds:
+        report = run_chaos(seed, num_docs=num_docs, ops_per_doc=ops_per_doc, **kw)
+        reports.append(report)
+        if verbose:
+            print(
+                f"seed {seed:4d}: frames={report.delivered_frames} "
+                f"corrupt={report.corrupt_frames} "
+                f"quarantine_peak={report.quarantined_peak} "
+                f"rollbacks={report.rollbacks} "
+                f"behind={report.transport_behind} "
+                f"digest={report.final_digest:#010x}"
+            )
+    return reports

@@ -1,0 +1,594 @@
+"""Fault-domain supervisor tests: per-doc quarantine, guarded device rounds,
+resilient transport, and the composed chaos harness (ISSUE 1).
+
+The long soak (20+ seeds) is ``slow``; a one-campaign smoke rides tier-1.
+"""
+
+import random
+
+import pytest
+
+from peritext_tpu.api.batch import _oracle_doc, oracle_merge
+from peritext_tpu.core.errors import (
+    DecodeError,
+    DeviceRoundError,
+    TransportError,
+)
+from peritext_tpu.parallel.codec import decode_frame, encode_frame
+from peritext_tpu.parallel.faults import (
+    FaultSpec,
+    corrupt_detectably,
+    perturb_frame,
+)
+from peritext_tpu.parallel.streaming import REASON_DECODE, REASON_DEVICE_ROUND
+from peritext_tpu.parallel.supervisor import GuardedSession
+from peritext_tpu.testing.chaos import _StallingPeer, run_campaign, run_chaos
+from peritext_tpu.testing.fuzz import _campaign_session, generate_workload
+
+DOCS, OPS = 4, 25
+
+
+def _frames_for(workload, rng, chunk=7):
+    changes = [ch for log in workload.values() for ch in log]
+    rng.shuffle(changes)
+    return [encode_frame(changes[i:i + chunk]) for i in range(0, len(changes), chunk)]
+
+
+# ---------------------------------------------------------------------------
+# codec surface: corruption is typed, contained, and never hangs
+# ---------------------------------------------------------------------------
+
+
+class TestCorruptFrames:
+    def test_decode_raises_only_decode_error(self):
+        workload = generate_workload(seed=11, num_docs=1, ops_per_doc=40)[0]
+        frame = encode_frame([c for log in workload.values() for c in log])
+        rng = random.Random(7)
+        spec = FaultSpec(truncate_p=0.5, bitflip_p=0.9)
+        rejected = 0
+        for _ in range(200):
+            bad = perturb_frame(frame, rng, spec)
+            try:
+                decode_frame(bad)
+            except DecodeError:
+                rejected += 1  # the one documented failure mode
+            # any other exception type fails the test by propagating
+        assert rejected > 50  # the mutator really does corrupt frames
+
+    def test_ingest_never_crashes_always_quarantines_with_reason(self):
+        """Fuzz: corrupted frames through ``ingest_frame`` must never raise
+        (quarantine mode), never hang, always tag the doc with a typed
+        ``decode`` reason, and never block the session's device rounds."""
+        rng = random.Random(13)
+        workloads = generate_workload(seed=13, num_docs=DOCS, ops_per_doc=OPS)
+        sess = _campaign_session(DOCS, OPS)
+        spec = FaultSpec(truncate_p=0.4, bitflip_p=0.8)
+        corrupted_docs = set()
+        for d, w in enumerate(workloads):
+            for frame in _frames_for(w, rng):
+                bad = perturb_frame(frame, rng, spec)
+                try:
+                    decode_frame(bad)
+                except ValueError:
+                    corrupted_docs.add(d)
+                sess.ingest_frame(d, bad, on_corrupt="quarantine")
+                if rng.random() < 0.3:
+                    sess.step()
+        assert corrupted_docs, "mutator produced no corruption; test is vacuous"
+        quarantined = sess.quarantined()
+        # every doc that received a corrupt frame (and no clean one after)
+        # is quarantined as decode; docs quarantine ONLY via typed reasons
+        for d, record in quarantined.items():
+            assert record.reason in (REASON_DECODE, "capacity", "schedule", "encode")
+        assert any(r.reason == REASON_DECODE for r in quarantined.values())
+        sess.drain()  # healthy docs' rounds proceed; no exception, no hang
+
+    def test_decode_quarantine_auto_readmits_after_clean_redelivery(self):
+        rng = random.Random(5)
+        workload = generate_workload(seed=5, num_docs=1, ops_per_doc=OPS)[0]
+        frames = _frames_for(workload, rng)
+        sess = _campaign_session(1, OPS)
+        sess.ingest_frame(0, frames[0][: len(frames[0]) // 2],
+                          on_corrupt="quarantine")
+        assert sess.quarantined()[0].reason == REASON_DECODE
+        # anti-entropy repair: the full clean history re-admits + converges —
+        # but only once the doc also DRAINS clean (a clean delivery alone is
+        # not proof the gap closed while work is still pending)
+        sess.ingest_frames([(0, f) for f in frames])
+        assert sess.quarantined()[0].clean_delivery
+        sess.drain()
+        assert 0 not in sess.quarantined()
+        expected = _oracle_doc(workload).get_text_with_formatting(["text"])
+        assert sess.read(0) == expected
+
+    def test_demotion_escalates_over_decode_quarantine(self):
+        """A demotion-class fault overwrites a ``decode`` record, so a later
+        clean delivery cannot lift the quarantine of a doc that is really
+        sitting on the scalar path for a device-round reason."""
+        sess = _campaign_session(1, OPS)
+        sess.ingest_frame(0, b"junkjunkjunk", on_corrupt="quarantine")
+        assert sess.quarantined()[0].reason == REASON_DECODE
+        sess.force_fallback(0, REASON_DEVICE_ROUND, "supervisor demotion")
+        assert sess.quarantined()[0].reason == REASON_DEVICE_ROUND
+        workload = generate_workload(seed=43, num_docs=1, ops_per_doc=10)[0]
+        frame = encode_frame([c for log in workload.values() for c in log])
+        sess.ingest_frame(0, frame, on_corrupt="quarantine")
+        assert sess.quarantined()[0].reason == REASON_DEVICE_ROUND
+        sess.drain()
+        expected = _oracle_doc(workload).get_text_with_formatting(["text"])
+        assert sess.read(0) == expected  # degraded, still correct
+
+    def test_faulty_publisher_exercises_codec_and_repairs(self):
+        """Payload faults route every delivery through the real wire codec;
+        detectably-corrupt messages are lost-and-recorded, and redelivery
+        (the anti-entropy analog) reconverges the editors."""
+        from peritext_tpu.bridge import create_editor, initialize_docs
+        from peritext_tpu.bridge.commands import type_text
+        from peritext_tpu.parallel.faults import FaultyPublisher
+
+        spec = FaultSpec(reorder=False, truncate_p=0.5, bitflip_p=0.9)
+        pub = FaultyPublisher(spec, seed=2)
+        alice = create_editor("alice", pub)
+        bob = create_editor("bob", pub)
+        initialize_docs([alice, bob], "base")
+        for _ in range(12):
+            type_text(alice, 1, "x")
+            alice.sync()
+        assert pub.corrupt_count > 0, "payload faults never fired; vacuous"
+        pub.redeliver_lost()
+        assert alice.view == bob.view
+
+    def test_raise_mode_still_queues_other_docs(self):
+        """Pre-supervisor contract: on_corrupt="raise" raises a typed
+        DecodeError naming the bad docs, AFTER queueing every clean frame —
+        fault isolation holds on both surfaces."""
+        rng = random.Random(3)
+        workloads = generate_workload(seed=3, num_docs=2, ops_per_doc=OPS)
+        sess = _campaign_session(2, OPS)
+        good = _frames_for(workloads[0], rng)
+        with pytest.raises(DecodeError):
+            sess.ingest_frames([(0, f) for f in good] + [(1, b"junkjunkjunk")])
+        sess.drain()
+        expected = _oracle_doc(workloads[0]).get_text_with_formatting(["text"])
+        assert sess.read(0) == expected
+        assert sess.quarantined()[1].reason == REASON_DECODE
+
+
+# ---------------------------------------------------------------------------
+# transport: deadlines, retry, behind-frontier absorption
+# ---------------------------------------------------------------------------
+
+
+class TestResilientTransport:
+    def test_stalled_peer_times_out_not_hangs(self):
+        from peritext_tpu.parallel import ChangeStore, RetryPolicy, sync_with
+
+        peer = _StallingPeer()
+        try:
+            with pytest.raises(TransportError):
+                sync_with(
+                    ChangeStore(), *peer.address,
+                    retry=RetryPolicy(attempts=2, base_delay=0.01,
+                                      max_delay=0.05, timeout=0.25),
+                )
+        finally:
+            peer.close()
+
+    def test_stalled_peer_surfaces_as_behind_outcome(self):
+        from peritext_tpu.observability import GLOBAL_COUNTERS
+        from peritext_tpu.parallel import ChangeStore, RetryPolicy, try_sync_with
+
+        before = GLOBAL_COUNTERS.get("transport.retries")
+        peer = _StallingPeer()
+        try:
+            outcome = try_sync_with(
+                ChangeStore(), *peer.address,
+                retry=RetryPolicy(attempts=3, base_delay=0.01,
+                                  max_delay=0.05, timeout=0.2),
+            )
+        finally:
+            peer.close()
+        assert outcome.behind and not outcome.ok
+        assert outcome.error is not None
+        assert GLOBAL_COUNTERS.get("transport.retries") >= before + 2
+
+    def test_corrupt_protocol_keeps_valueerror_surface(self):
+        """Terminal protocol corruption keeps the typed DecodeError /
+        ValueError surface (the pre-retry contract) instead of being
+        rewrapped as TransportError (a ConnectionError), so pre-existing
+        ``except ValueError`` corrupt-peer handlers still fire."""
+        import socket as socketlib
+        import struct
+        import threading
+
+        from peritext_tpu.parallel import ChangeStore, sync_with
+
+        srv = socketlib.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+
+        def speak_garbage():
+            conn, _ = srv.accept()
+            with conn:
+                conn.recv(65536)  # client frontier
+                body = b"C" + b"\xde\xad\xbe\xef"  # MSG_CHANGES, junk frame
+                conn.sendall(struct.pack(">I", len(body)) + body)
+
+        threading.Thread(target=speak_garbage, daemon=True).start()
+        try:
+            with pytest.raises(ValueError) as ei:
+                sync_with(ChangeStore(), *srv.getsockname(), timeout=2.0)
+            assert not isinstance(ei.value, ConnectionError)
+        finally:
+            srv.close()
+
+    def test_callback_decode_error_propagates_from_try_sync(self):
+        """A DecodeError raised by the caller's OWN on_changes callback is a
+        local delivery failure, not a corrupt peer: try_sync_with must let
+        it propagate instead of absorbing it as a (false) behind outcome —
+        the store already merged the pull, so no later round would repair."""
+        from peritext_tpu.parallel import (
+            ChangeStore, ReplicaServer, RetryPolicy, try_sync_with,
+        )
+
+        workload = generate_workload(seed=53, num_docs=1, ops_per_doc=30)[0]
+        full = ChangeStore()
+        for log in workload.values():
+            for ch in log:
+                full.append(ch)
+        server = ReplicaServer(full, timeout=5.0)
+        host, port = server.start()
+
+        def sink(changes):
+            raise DecodeError("downstream parser rejected the batch")
+
+        try:
+            with pytest.raises(DecodeError):
+                try_sync_with(
+                    ChangeStore(), host, port, on_changes=sink,
+                    retry=RetryPolicy(attempts=1, timeout=2.0),
+                )
+        finally:
+            server.stop()
+
+    def test_callback_failure_not_swallowed_by_retry(self):
+        """A failure in on_changes AFTER a successful pull propagates
+        unwrapped and is not retried: a retry would pull only duplicates,
+        skip the callbacks entirely, and report success."""
+        from peritext_tpu.parallel import (
+            ChangeStore, ReplicaServer, RetryPolicy, sync_with,
+        )
+
+        workload = generate_workload(seed=51, num_docs=1, ops_per_doc=30)[0]
+        full = ChangeStore()
+        for log in workload.values():
+            for ch in log:
+                full.append(ch)
+        server = ReplicaServer(full, timeout=5.0)
+        host, port = server.start()
+        calls = []
+
+        def sink(changes):
+            calls.append(len(changes))
+            raise OSError("downstream sink failed")
+
+        try:
+            with pytest.raises(OSError):
+                sync_with(
+                    ChangeStore(), host, port, on_changes=sink,
+                    retry=RetryPolicy(attempts=3, base_delay=0.01, timeout=2.0),
+                )
+        finally:
+            server.stop()
+        assert len(calls) == 1 and calls[0] > 0
+
+    def test_refused_connection_becomes_behind_then_repairs(self):
+        from peritext_tpu.parallel import (
+            ChangeStore, ReplicaServer, RetryPolicy, try_sync_with,
+        )
+
+        workload = generate_workload(seed=9, num_docs=1, ops_per_doc=60)[0]
+        full = ChangeStore()
+        for log in workload.values():
+            for ch in log:
+                full.append(ch)
+        local = ChangeStore()
+        # grab a port that refuses by binding without listening backlog use
+        dead = _StallingPeer()
+        dead_addr = dead.address
+        dead.close()  # now actively refused
+        policy = RetryPolicy(attempts=2, base_delay=0.01, max_delay=0.02,
+                             timeout=0.2)
+        outcome = try_sync_with(local, *dead_addr, retry=policy)
+        assert outcome.behind
+        # a later round against a live peer repairs the behind frontier
+        server = ReplicaServer(full, timeout=5.0)
+        host, port = server.start()
+        try:
+            repaired = try_sync_with(local, host, port, retry=policy)
+        finally:
+            server.stop()
+        assert repaired.ok and repaired.pulled > 0
+        assert local.clock() == full.clock()
+
+
+# ---------------------------------------------------------------------------
+# guarded device rounds: watchdog, rollback, scalar degradation
+# ---------------------------------------------------------------------------
+
+
+class TestGuardedSession:
+    def _converged(self, guarded, workloads):
+        for d, w in enumerate(workloads):
+            expected = _oracle_doc(w).get_text_with_formatting(["text"])
+            assert guarded.read(d) == expected, f"doc {d} diverged"
+
+    def test_injected_failures_roll_back_and_recover(self, tmp_path):
+        workloads = generate_workload(seed=17, num_docs=DOCS, ops_per_doc=OPS)
+        clean = _campaign_session(DOCS, OPS)
+        rng = random.Random(17)
+        plans = [_frames_for(w, rng) for w in workloads]
+        for d, frames in enumerate(plans):
+            for f in frames:
+                clean.ingest_frame(d, f)
+        clean.drain()
+
+        guarded = GuardedSession(
+            lambda: _campaign_session(DOCS, OPS), tmp_path, deadline=120.0,
+            checkpoint_every=2,
+        )
+        for d, frames in enumerate(plans):
+            for f in frames:
+                guarded.ingest_frame(d, f)
+                if rng.random() < 0.4:
+                    guarded.step()
+        guarded.inject_failure(DeviceRoundError("injected device fault"))
+        assert guarded.step() == 0  # absorbed, not raised
+        guarded.inject_failure(RuntimeError("injected XLA error"))
+        guarded.step()
+        guarded.drain()
+        assert guarded.rollbacks == 2
+        assert guarded.digest() == clean.digest()
+        self._converged(guarded, workloads)
+        health = guarded.health()
+        assert health["rollbacks"] == 2
+        assert health["pending_changes"] == 0
+        assert guarded.pending_count() == 0  # public pass-through surface
+
+    def test_deadline_watchdog_fires_and_session_recovers(self, tmp_path):
+        workloads = generate_workload(seed=23, num_docs=2, ops_per_doc=OPS)
+        rng = random.Random(23)
+        guarded = GuardedSession(
+            lambda: _campaign_session(2, OPS), tmp_path, deadline=120.0,
+            checkpoint_every=100,
+        )
+        for d, w in enumerate(workloads):
+            for f in _frames_for(w, rng):
+                guarded.ingest_frame(d, f)
+        guarded.step()  # warm: compile outside the tight deadline
+        guarded.deadline = 1.0
+        guarded.inject_delay(3.0)
+        assert guarded.step() == 0  # watchdog fired, round rolled back
+        assert guarded.rollbacks == 1
+        guarded.deadline = 120.0
+        guarded.drain()
+        self._converged(guarded, workloads)
+
+    def test_object_ingest_is_journalled_and_survives_rollback(self, tmp_path):
+        """The object-change ingest surface (editor/bridge path) journals
+        like frames do: a rollback replays it, so accepted changes can never
+        silently vanish from the restored session."""
+        workloads = generate_workload(seed=47, num_docs=2, ops_per_doc=OPS)
+        clean = _campaign_session(2, OPS)
+        for d, w in enumerate(workloads):
+            for log in w.values():
+                clean.ingest(d, list(log))
+        clean.drain()
+
+        guarded = GuardedSession(
+            lambda: _campaign_session(2, OPS), tmp_path, deadline=120.0,
+            checkpoint_every=100,
+        )
+        for d, w in enumerate(workloads):
+            for log in w.values():
+                guarded.ingest(d, list(log))
+        guarded.inject_failure(RuntimeError("injected device fault"))
+        assert guarded.step() == 0  # rollback: replay includes object ingests
+        guarded.drain()
+        assert guarded.rollbacks == 1
+        assert guarded.digest() == clean.digest()
+        self._converged(guarded, workloads)
+
+    def test_persistent_failure_degrades_to_scalar_replay(self, tmp_path, monkeypatch):
+        workloads = generate_workload(seed=29, num_docs=2, ops_per_doc=OPS)
+        rng = random.Random(29)
+        guarded = GuardedSession(
+            lambda: _campaign_session(2, OPS), tmp_path, deadline=120.0,
+            checkpoint_every=100,
+        )
+        for d, w in enumerate(workloads):
+            for f in _frames_for(w, rng):
+                guarded.ingest_frame(d, f)
+
+        def sick(self):
+            raise RuntimeError("device still failing")
+
+        monkeypatch.setattr(GuardedSession, "_drain_device", sick)
+        guarded.inject_failure(DeviceRoundError("first failure"))
+        assert guarded.step() == 0
+        monkeypatch.undo()
+        # the ladder's last rung: every pending doc demoted to scalar replay,
+        # quarantined with the device-round reason — and still correct
+        quarantined = guarded.quarantined()
+        assert quarantined, "persistent failure must quarantine the pending docs"
+        assert all(r.reason == REASON_DEVICE_ROUND for r in quarantined.values())
+        assert all(s.fallback for s in guarded.session.docs)
+        guarded.drain()
+        self._converged(guarded, workloads)
+
+
+# ---------------------------------------------------------------------------
+# crash-restore under fault schedules
+# ---------------------------------------------------------------------------
+
+
+class TestCrashRestore:
+    def test_mid_checkpoint_crash_staging_ignored(self, tmp_path):
+        from peritext_tpu.checkpoint import CheckpointManager
+
+        workloads = generate_workload(seed=31, num_docs=2, ops_per_doc=OPS)
+        rng = random.Random(31)
+        sess = _campaign_session(2, OPS)
+        for d, w in enumerate(workloads):
+            for f in _frames_for(w, rng):
+                sess.ingest_frame(d, f)
+        sess.drain()
+        manager = CheckpointManager(tmp_path / "ckpt", keep=3)
+        manager.save(step=1, session=sess)
+
+        # crash mid-save: a STALE staging dir with partial content, plus a
+        # torn (meta-less) step dir — neither may mask the good checkpoint.
+        # A FRESH staging dir may belong to a live concurrent saver and must
+        # survive the sweep.
+        import os
+        import time
+
+        staging = tmp_path / "ckpt" / ".staging_killed"
+        staging.mkdir()
+        (staging / "changes.jsonl").write_text("{ truncated")
+        old = time.time() - 7200
+        os.utime(staging, (old, old))
+        live = tmp_path / "ckpt" / ".staging_live"
+        live.mkdir()
+        torn = tmp_path / "ckpt" / "step_000000000002"
+        torn.mkdir()
+        (torn / "session").mkdir()
+
+        reopened = CheckpointManager(tmp_path / "ckpt", keep=3)
+        assert reopened.steps() == [1]
+        assert not staging.exists(), "stale staging must be swept on reopen"
+        assert live.exists(), "a live saver's fresh staging must survive"
+        restored = reopened.latest().session()
+        assert restored is not None
+        expected = _oracle_doc(workloads[0]).get_text_with_formatting(["text"])
+        assert restored.read(0) == expected
+        assert restored.digest() == sess.digest()
+
+    def test_crash_restore_under_corruption_schedule(self, tmp_path):
+        """Kill a supervised session mid-run while some docs are decode-
+        quarantined; restore; repair by clean redelivery; final digest must
+        be byte-equal to a fault-free run's."""
+        workloads = generate_workload(seed=37, num_docs=DOCS, ops_per_doc=OPS)
+        rng = random.Random(37)
+        plans = [_frames_for(w, rng) for w in workloads]
+        clean = _campaign_session(DOCS, OPS)
+        for d, frames in enumerate(plans):
+            for f in frames:
+                clean.ingest_frame(d, f)
+        clean.drain()
+
+        factory = lambda: _campaign_session(DOCS, OPS)  # noqa: E731
+        guarded = GuardedSession(factory, tmp_path, deadline=120.0,
+                                 checkpoint_every=3)
+        spec = FaultSpec(truncate_p=0.5, bitflip_p=0.5)
+        for d, frames in enumerate(plans):
+            for f in frames[:-1]:  # hold back a suffix: lost in the crash
+                if d == 0:
+                    bad = corrupt_detectably(f, rng, spec)
+                    if bad is not None:
+                        f = bad
+                guarded.ingest_frame(d, f)
+                if rng.random() < 0.3:
+                    guarded.step()
+        guarded.checkpoint()
+        del guarded  # crash
+
+        revived = GuardedSession(factory, tmp_path, deadline=120.0,
+                                 checkpoint_every=3)
+        latest = revived.manager.latest()
+        assert latest is not None
+        revived.session = latest.session(drain=True)
+        for d, frames in enumerate(plans):  # anti-entropy repair, clean
+            revived.ingest_frames([(d, f) for f in frames])
+        revived.drain()
+        assert revived.session.pending_count() == 0
+        assert not any(
+            r.reason == REASON_DECODE for r in revived.quarantined().values()
+        )
+        assert revived.digest() == clean.digest()
+        for d, w in enumerate(workloads):
+            expected = _oracle_doc(w).get_text_with_formatting(["text"])
+            assert revived.read(d) == expected
+
+
+# ---------------------------------------------------------------------------
+# guarded batch merge + health surface
+# ---------------------------------------------------------------------------
+
+
+class TestGuardedMergeAndHealth:
+    def test_guarded_docbatch_degrades_to_oracle(self, monkeypatch):
+        from peritext_tpu.api.batch import DocBatch
+
+        workloads = generate_workload(seed=41, num_docs=3, ops_per_doc=20)
+        batch = DocBatch(slot_capacity=256, mark_capacity=64, guard=True)
+
+        def boom(encoded):
+            raise RuntimeError("injected device failure")
+
+        monkeypatch.setattr(batch, "apply_encoded", boom)
+        report = batch.merge(workloads)
+        assert report.spans == oracle_merge(workloads)
+        assert report.fallback_docs == [0, 1, 2]
+        assert report.stats.extras["guarded_fallback"] == 1.0
+        # unguarded batches keep the loud-failure contract
+        strict = DocBatch(guard=False)
+        monkeypatch.setattr(strict, "apply_encoded", boom)
+        with pytest.raises(RuntimeError):
+            strict.merge(workloads)
+
+    def test_health_snapshot_shape(self, tmp_path):
+        from peritext_tpu.observability import health_snapshot
+
+        guarded = GuardedSession(
+            lambda: _campaign_session(1, OPS), tmp_path, deadline=120.0
+        )
+        guarded.ingest_frame(0, b"garbage", )
+        snap = health_snapshot(session=guarded)
+        assert "counters" in snap
+        assert all(
+            k.split(".")[0] in ("streaming", "transport", "supervisor", "merge")
+            for k in snap["counters"]
+        )
+        q = snap["session"]["quarantined"]
+        assert q[0]["reason"] == REASON_DECODE
+        assert snap["session"]["rollbacks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the composed chaos harness
+# ---------------------------------------------------------------------------
+
+
+class TestChaosHarness:
+    def test_chaos_smoke(self):
+        """One composed campaign rides tier-1: delivery + corruption +
+        injected device faults + peer stall + crash-restore, all oracles."""
+        report = run_chaos(0, num_docs=DOCS, ops_per_doc=OPS)
+        assert report.delivered_frames > 0
+        assert report.transport_repaired
+        assert report.crash_restores == 1
+
+    @pytest.mark.slow
+    def test_chaos_soak_twenty_seeds(self):
+        """Acceptance criterion: >=20 seeded composed-fault campaigns all
+        reach byte-equal digests vs the fault-free oracle with zero
+        unhandled exceptions (any violation raises inside run_chaos)."""
+        reports = run_campaign(range(20), num_docs=6, ops_per_doc=40)
+        assert len(reports) == 20
+        # the fault space was actually exercised across the soak
+        assert sum(r.corrupt_frames for r in reports) > 0
+        assert sum(r.rollbacks for r in reports) > 0
+        assert sum(r.transport_behind for r in reports) == 20
+        assert sum(r.crash_restores for r in reports) == 20
+        assert any(r.isolation_checked for r in reports)
