@@ -6,7 +6,7 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test fuzz fuzz-differential fuzz-frames fuzz-crash chaos weak-scaling \
-	bench bench-smoke bench-streaming entry dryrun lint clean
+	bench bench-smoke bench-streaming entry dryrun lint lint-baseline clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -56,10 +56,18 @@ dryrun:
 	$(CPU_ENV) $(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); \
 	import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-# No linter is baked into the image; syntax-compile everything as a floor.
-# CI runs ruff with the config in pyproject.toml.
+# Lint = syntax floor (compileall) + graftlint, the project's determinism &
+# tracer-safety suite (rules PTL001-PTL006; see DESIGN.md "Determinism
+# contract").  Known intentional violations are attributed in
+# graftlint_baseline.json; anything new fails here and in CI.
+# CI additionally runs ruff with the config in pyproject.toml.
 lint:
 	$(PY) -m compileall -q peritext_tpu tests demos scripts bench.py __graft_entry__.py
+	$(PY) -m peritext_tpu.analysis peritext_tpu
+
+# regenerate the graftlint baseline (justify any new TODO entries by hand)
+lint-baseline:
+	$(PY) -m peritext_tpu.analysis peritext_tpu --update-baseline --baseline graftlint_baseline.json
 
 clean:
 	rm -rf peritext_tpu/native/_build .pytest_cache

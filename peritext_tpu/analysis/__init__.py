@@ -1,0 +1,46 @@
+"""graftlint — determinism & tracer-safety static analysis for peritext-tpu.
+
+The north-star contract (byte-equality convergence at TPU speed) rests on
+invariants that unit tests only probe after the fact:
+
+* merge/convergence code must never let *iteration order of unordered
+  containers* leak into digests or delivery order (PTL001);
+* jit-traced code must never branch Python-side on a tracer (PTL002), sync
+  to the host mid-program (PTL003), or mint per-doc shapes that recompile
+  the session program (PTL004);
+* fault handling must use the typed errors from ``core/errors.py`` unless a
+  boundary is explicitly declared (PTL005);
+* deterministic merge regions must not read wall clocks or unseeded RNGs
+  (PTL006).
+
+This package machine-checks those invariants over the AST — no imports of
+the scanned code, no jax dependency — and pairs them with a runtime
+recompile sentinel (:class:`peritext_tpu.observability.RecompileSentinel`)
+that counts per-jit-site XLA compilations so steady-state streaming rounds
+can assert **zero** recompiles.
+
+Run it::
+
+    python -m peritext_tpu.analysis peritext_tpu/
+
+Pre-existing, intentional violations are attributed (with a justification
+each) in ``graftlint_baseline.json`` at the repo root; anything new fails
+``make lint`` and CI.  Inline escapes: ``# graftlint: disable=PTL00X`` on
+the offending line, or ``# graftlint: boundary(reason)`` to declare a fault
+boundary (satisfies PTL005).
+"""
+
+from .engine import (  # noqa: F401
+    Finding,
+    LintConfig,
+    all_rule_ids,
+    rule_table,
+    scan_paths,
+)
+from .baseline import (  # noqa: F401
+    BASELINE_NAME,
+    apply_baseline,
+    find_default_baseline,
+    load_baseline,
+    update_baseline,
+)

@@ -1,0 +1,214 @@
+"""Shared AST helpers for graftlint rules (pure stdlib — never imports the
+scanned code, never imports jax)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, NamedTuple, Optional, Set, Tuple
+
+#: names that produce a jit-compiled callable
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+PARTIAL_NAMES = {"partial", "functools.partial"}
+
+#: attribute reads on a traced array that are static at trace time
+STATIC_TRACER_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+class JitSpec(NamedTuple):
+    """Static-argument declaration of one jit wrapping."""
+
+    static_argnums: frozenset
+    static_argnames: frozenset
+
+
+def _const_ints(node: ast.AST) -> Set[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for elt in node.elts:
+            out |= _const_ints(elt)
+        return out
+    return set()
+
+
+def _const_strs(node: ast.AST) -> Set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for elt in node.elts:
+            out |= _const_strs(elt)
+        return out
+    return set()
+
+
+def jit_call_spec(call: ast.Call) -> Optional[JitSpec]:
+    """JitSpec if ``call`` is ``jax.jit(...)`` or ``partial(jax.jit, ...)``."""
+    name = dotted_name(call.func)
+    if name in JIT_NAMES:
+        pass
+    elif (
+        name in PARTIAL_NAMES
+        and call.args
+        and dotted_name(call.args[0]) in JIT_NAMES
+    ):
+        pass
+    else:
+        return None
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums |= _const_ints(kw.value)
+        elif kw.arg == "static_argnames":
+            names |= _const_strs(kw.value)
+    return JitSpec(frozenset(nums), frozenset(names))
+
+
+def jit_decoration(fn: ast.AST) -> Optional[JitSpec]:
+    """JitSpec if the function def carries a jit decorator."""
+    for dec in getattr(fn, "decorator_list", []):
+        if dotted_name(dec) in JIT_NAMES:
+            return JitSpec(frozenset(), frozenset())
+        if isinstance(dec, ast.Call):
+            spec = jit_call_spec(dec)
+            if spec is not None:
+                return spec
+    return None
+
+
+def module_defs(tree: ast.Module) -> Dict[str, ast.AST]:
+    """Every function def in the file by bare name (methods included; last
+    definition of a name wins — good enough for file-local reachability)."""
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    return defs
+
+
+def jit_roots(tree: ast.Module) -> Tuple[Dict[str, JitSpec], Dict[int, JitSpec]]:
+    """``(callables, root_defs)``:
+
+    * ``callables`` — names that, when *called*, dispatch a jitted program
+      (decorated defs plus ``g = jax.jit(f, ...)`` module assignments);
+    * ``root_defs`` — ``id(def-node) -> JitSpec`` for every function body
+      that executes under trace (decorated, or wrapped by an assignment).
+    """
+    defs = module_defs(tree)
+    callables: Dict[str, JitSpec] = {}
+    root_defs: Dict[int, JitSpec] = {}
+    for name, node in defs.items():
+        spec = jit_decoration(node)
+        if spec is not None:
+            callables[name] = spec
+            root_defs[id(node)] = spec
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        if dotted_name(node.value.func) not in JIT_NAMES:
+            continue
+        spec = jit_call_spec(node.value)
+        if spec is None:
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                callables[target.id] = spec
+        if node.value.args:
+            wrapped = dotted_name(node.value.args[0])
+            if wrapped in defs:
+                root_defs[id(defs[wrapped])] = spec
+    return callables, root_defs
+
+
+def traced_params(fn: ast.AST, spec: JitSpec) -> Set[str]:
+    """Parameter names that arrive as tracers (static args excluded)."""
+    args = fn.args
+    ordered = [a.arg for a in args.posonlyargs + args.args]
+    traced: Set[str] = set()
+    for i, name in enumerate(ordered):
+        if i in spec.static_argnums or name in spec.static_argnames:
+            continue
+        if name in ("self", "cls"):
+            continue
+        traced.add(name)
+    traced |= {
+        a.arg for a in args.kwonlyargs if a.arg not in spec.static_argnames
+    }
+    return traced
+
+
+def called_local_names(fn: ast.AST) -> Set[str]:
+    """Bare and ``self.x(...)`` callee names inside a function body — the
+    edges of the file-local call graph."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name):
+            out.add(func.id)
+        elif (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("self", "cls")
+        ):
+            out.add(func.attr)
+    return out
+
+
+def import_maps(tree: ast.Module) -> Tuple[Dict[str, str], Dict[str, str]]:
+    """``(module_aliases, from_imports)``: ``np -> numpy`` and
+    ``perf_counter -> time.perf_counter`` style maps for name resolution."""
+    aliases: Dict[str, str] = {}
+    from_imports: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                aliases[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for alias in node.names:
+                from_imports[alias.asname or alias.name] = (
+                    f"{node.module}.{alias.name}"
+                )
+    return aliases, from_imports
+
+
+def resolve_name(name: str, aliases: Dict[str, str], from_imports: Dict[str, str]) -> str:
+    """Expand the leading segment of a dotted name through the file's
+    imports: ``np.asarray -> numpy.asarray``, ``Random -> random.Random``."""
+    head, _, rest = name.partition(".")
+    if head in from_imports:
+        full = from_imports[head]
+        return f"{full}.{rest}" if rest else full
+    if head in aliases:
+        return f"{aliases[head]}.{rest}" if rest else aliases[head]
+    return name
+
+
+def iteration_sites(tree: ast.Module) -> Iterator[Tuple[ast.AST, ast.AST]]:
+    """Yield ``(iter_expr, anchor_node)`` for every for-loop and
+    comprehension generator in the file."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            yield node.iter, node
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            for gen in node.generators:
+                yield gen.iter, node

@@ -1,0 +1,25 @@
+"""graftlint rule registry — one module per rule, registered by import."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..engine import Rule
+from .ptl001_unordered_iteration import UnorderedIterationRule
+from .ptl002_tracer_control_flow import TracerControlFlowRule
+from .ptl003_host_sync import HostSyncRule
+from .ptl004_recompile_hazard import RecompileHazardRule
+from .ptl005_broad_except import BroadExceptRule
+from .ptl006_nondeterminism import NondeterminismRule
+
+ALL_RULES: Dict[str, Rule] = {
+    rule.rule_id: rule
+    for rule in (
+        UnorderedIterationRule(),
+        TracerControlFlowRule(),
+        HostSyncRule(),
+        RecompileHazardRule(),
+        BroadExceptRule(),
+        NondeterminismRule(),
+    )
+}

@@ -1,0 +1,174 @@
+"""PTL002 — Python control flow on jit-traced values.
+
+``if``/``while``/``assert`` on a tracer either raises a
+ConcretizationTypeError or — worse, via weak shortcuts like
+``bool(np.asarray(x))`` — silently burns a host round-trip per call.
+Structural reads (``x.shape``, ``x.ndim``, ``x.dtype``, ``len(x)``) are
+static at trace time and stay allowed; value branches must go through
+``jnp.where`` / ``lax.cond`` / ``lax.fori_loop`` or be declared static.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from .. import astutil
+from ..engine import FileContext, Finding, Rule
+
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr"}
+
+
+class TracerControlFlowRule(Rule):
+    rule_id = "PTL002"
+    scope = "all"
+    summary = "Python control flow branching on a jit-traced value"
+    rationale = (
+        "tracers have no runtime truth value; branch device-side "
+        "(jnp.where/lax.cond) or mark the argument static"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        _, root_defs = astutil.jit_roots(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            spec = root_defs.get(id(node))
+            if spec is None:
+                continue
+            tainted = astutil.traced_params(node, spec)
+            yield from self._check_body(ctx, node, node.body, set(tainted))
+
+    def _check_body(
+        self, ctx: FileContext, fn: ast.AST, body: List[ast.stmt], tainted: Set[str]
+    ) -> Iterator[Finding]:
+        for stmt in body:
+            yield from self._check_stmt(ctx, fn, stmt, tainted)
+
+    def _check_stmt(
+        self, ctx: FileContext, fn: ast.AST, stmt: ast.stmt, tainted: Set[str]
+    ) -> Iterator[Finding]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs capture the closure; params shadow outer taint
+            inner = tainted - {
+                a.arg
+                for a in stmt.args.posonlyargs + stmt.args.args + stmt.args.kwonlyargs
+            }
+            yield from self._check_body(ctx, fn, stmt.body, inner)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = stmt.value
+            if value is not None:
+                yield from self._check_ifexp(ctx, fn, value, tainted)
+            if value is not None and self._traced_ref(ctx, value, tainted):
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                )
+                for target in targets:
+                    for name in ast.walk(target):
+                        if isinstance(name, ast.Name):
+                            tainted.add(name.id)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            name = self._traced_ref(ctx, stmt.test, tainted)
+            if name:
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                yield ctx.finding(
+                    self.rule_id,
+                    stmt,
+                    f"'{kind}' condition reads traced value '{name}' inside "
+                    f"@jax.jit '{getattr(fn, 'name', '<fn>')}' — use "
+                    "jnp.where/lax.cond or mark it static",
+                )
+            yield from self._check_body(ctx, fn, stmt.body, tainted)
+            yield from self._check_body(ctx, fn, stmt.orelse, tainted)
+            return
+        if isinstance(stmt, ast.Assert):
+            name = self._traced_ref(ctx, stmt.test, tainted)
+            if name:
+                yield ctx.finding(
+                    self.rule_id,
+                    stmt,
+                    f"assert on traced value '{name}' inside @jax.jit "
+                    f"'{getattr(fn, 'name', '<fn>')}' — use "
+                    "checkify or a host-side precondition",
+                )
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            it = stmt.iter
+            if isinstance(it, ast.Call) and astutil.call_name(it) == "range":
+                name = self._traced_ref(ctx, it, tainted)
+                if name:
+                    yield ctx.finding(
+                        self.rule_id,
+                        stmt,
+                        f"loop bound reads traced value '{name}' inside "
+                        f"@jax.jit '{getattr(fn, 'name', '<fn>')}' — use "
+                        "lax.fori_loop/lax.scan",
+                    )
+            yield from self._check_body(ctx, fn, stmt.body, tainted)
+            yield from self._check_body(ctx, fn, stmt.orelse, tainted)
+            return
+        # descend into remaining compound statements (with/try) and pick up
+        # IfExp value-branches anywhere in expressions
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                yield from self._check_stmt(ctx, fn, child, tainted)
+            elif isinstance(child, ast.ExceptHandler):
+                yield from self._check_body(ctx, fn, child.body, tainted)
+            elif isinstance(child, ast.expr):
+                yield from self._check_ifexp(ctx, fn, child, tainted)
+
+    def _check_ifexp(
+        self, ctx: FileContext, fn: ast.AST, expr: ast.expr, tainted: Set[str]
+    ) -> Iterator[Finding]:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.IfExp):
+                name = self._traced_ref(ctx, node.test, tainted)
+                if name:
+                    yield ctx.finding(
+                        self.rule_id,
+                        node,
+                        f"ternary condition reads traced value '{name}' inside "
+                        f"@jax.jit '{getattr(fn, 'name', '<fn>')}' — use jnp.where",
+                    )
+
+    def _traced_ref(
+        self, ctx: FileContext, expr: ast.expr, tainted: Set[str]
+    ) -> Optional[str]:
+        """Name of a tainted reference in ``expr`` that is NOT behind a
+        static read (.shape/.ndim/.dtype/len/isinstance), else None."""
+        for node in ast.walk(expr):
+            if not (isinstance(node, ast.Name) and node.id in tainted):
+                continue
+            if self._static_read(ctx, node):
+                continue
+            return node.id
+        return None
+
+    def _static_read(self, ctx: FileContext, node: ast.Name) -> bool:
+        """True when the tainted name only feeds a trace-time-static read:
+        an attribute chain ending in .shape/.ndim/.dtype, ``len(x)``,
+        ``isinstance(x, ...)``, or an ``is (not) None`` structure check."""
+        cur: ast.AST = node
+        parent = ctx.parent(cur)
+        while isinstance(parent, ast.Attribute):
+            if parent.attr in astutil.STATIC_TRACER_ATTRS:
+                return True
+            cur = parent
+            parent = ctx.parent(cur)
+        if (
+            isinstance(parent, ast.Call)
+            and astutil.call_name(parent) in _STATIC_CALLS
+            and cur in parent.args
+        ):
+            return True
+        if isinstance(parent, ast.Compare):
+            operands = [parent.left, *parent.comparators]
+            if (
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops)
+                and any(
+                    isinstance(o, ast.Constant) and o.value is None
+                    for o in operands
+                )
+            ):
+                return True
+        return False

@@ -1,0 +1,131 @@
+"""PTL003 — host synchronization reachable from a ``@jax.jit`` function.
+
+``.item()``, ``jax.device_get``, ``np.asarray``, ``.block_until_ready()``
+inside traced code either fail outright on a tracer or (when they sneak
+through on concrete aux values) serialize the async dispatch pipeline — the
+FusionStitching defect class: a fusion-breaking host sync in the middle of
+a device program.  Reachability is file-local: a helper called (by bare
+name or ``self.method``) from a jit root is scanned too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Set
+
+from .. import astutil
+from ..engine import FileContext, Finding, Rule
+
+#: fully-resolved call names that force a host sync
+_SYNC_CALLS = {
+    "jax.device_get",
+    "jax.block_until_ready",
+    "numpy.asarray",
+    "numpy.array",
+}
+#: method attributes that force a host sync on an array receiver
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+#: explicit escape hatches — syncs inside these callbacks are intentional
+_CALLBACK_HOSTS = {
+    "jax.pure_callback",
+    "jax.experimental.io_callback",
+    "jax.debug.callback",
+    "jax.debug.print",
+}
+_CASTS = {"float", "int", "bool", "complex"}
+
+
+class HostSyncRule(Rule):
+    rule_id = "PTL003"
+    scope = "all"
+    summary = "host sync reachable from a @jax.jit function"
+    rationale = (
+        "host syncs break XLA fusion and the async dispatch overlap the "
+        "streaming engine depends on; keep device programs pure"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        _, root_defs = astutil.jit_roots(ctx.tree)
+        if not root_defs:
+            return
+        defs = astutil.module_defs(ctx.tree)
+        # file-local reachability closure from the jit roots
+        reachable: Dict[int, str] = {}  # id(def) -> root chain label
+        frontier = [
+            (node, getattr(node, "name", "<fn>"))
+            for node in defs.values()
+            if id(node) in root_defs
+        ]
+        for node, chain in frontier:
+            reachable[id(node)] = chain
+        while frontier:
+            node, chain = frontier.pop()
+            for callee in sorted(astutil.called_local_names(node)):
+                target = defs.get(callee)
+                if target is None or id(target) in reachable:
+                    continue
+                label = f"{chain} -> {callee}"
+                reachable[id(target)] = label
+                frontier.append((target, label))
+        for node in defs.values():
+            chain = reachable.get(id(node))
+            if chain is None:
+                continue
+            spec = root_defs.get(id(node))
+            tainted = astutil.traced_params(node, spec) if spec else set()
+            yield from self._scan_fn(ctx, node, chain, tainted)
+
+    def _scan_fn(
+        self, ctx: FileContext, fn: ast.AST, chain: str, tainted: Set[str]
+    ) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._inside_callback(ctx, node):
+                continue
+            name = astutil.call_name(node)
+            resolved = ctx.resolve(name) if name else None
+            if resolved in _SYNC_CALLS:
+                yield ctx.finding(
+                    self.rule_id,
+                    node,
+                    f"host sync '{resolved}' reachable from @jax.jit "
+                    f"(via {chain}) — keep the device program pure or move "
+                    "the sync outside the jit boundary",
+                )
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _SYNC_METHODS
+                and not node.args
+            ):
+                yield ctx.finding(
+                    self.rule_id,
+                    node,
+                    f"host sync '.{func.attr}()' reachable from @jax.jit "
+                    f"(via {chain}) — device values must stay on device "
+                    "inside traced code",
+                )
+                continue
+            if (
+                name in _CASTS
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in tainted
+            ):
+                yield ctx.finding(
+                    self.rule_id,
+                    node,
+                    f"'{name}()' concretizes traced value "
+                    f"'{node.args[0].id}' inside @jax.jit (via {chain}) — "
+                    "this is a host sync; keep it as an array",
+                )
+
+    def _inside_callback(self, ctx: FileContext, node: ast.AST) -> bool:
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.Call):
+                name = astutil.call_name(anc)
+                if name and ctx.resolve(name) in _CALLBACK_HOSTS:
+                    return True
+        return False

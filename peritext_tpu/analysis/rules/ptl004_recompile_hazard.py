@@ -1,0 +1,146 @@
+"""PTL004 — recompile hazards at jit callsites and shape construction.
+
+XLA compiles one executable per (static args, input shapes) signature.  Two
+patterns silently turn "compile once, dispatch forever" into
+"compile-per-doc" (the hazard `parallel/streaming.py` guards with width
+buckets — Ragged Paged Attention makes the same move kernel-side):
+
+* a *static* jit argument fed a per-call shape-derived scalar
+  (``len(...)``, ``x.shape[i]``) — every distinct value mints a fresh
+  executable;
+* a device-array constructor whose shape embeds a raw ``len(...)`` /
+  ``.shape`` read instead of routing through the padded-shape tables
+  (``_width_bucket``) — every new doc population mints a fresh input shape;
+* a variable-length list built inline at a jit callsite — every length is a
+  new pytree structure, i.e. a new signature.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .. import astutil
+from ..engine import FileContext, Finding, Rule
+
+#: device-array constructors only — host-side np buffers get their shapes
+#: managed at the jit boundary (padding/bucketing) and are not themselves
+#: compile inputs
+_CONSTRUCTORS = {
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.empty", "jax.numpy.full",
+}
+
+
+class RecompileHazardRule(Rule):
+    rule_id = "PTL004"
+    scope = "all"
+    summary = "jit callsite / array shape that recompiles per distinct value"
+    rationale = (
+        "one compiled program per session is the streaming contract; "
+        "per-doc scalars and unbucketed shapes mint executables per doc"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        jitted, _ = astutil.jit_roots(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.call_name(node)
+            if name is None:
+                continue
+            spec = jitted.get(name) or jitted.get(name.rpartition(".")[2])
+            if spec is not None:
+                yield from self._check_jit_callsite(ctx, node, name, spec)
+            resolved = ctx.resolve(name)
+            if resolved in _CONSTRUCTORS and ctx.in_merge_scope:
+                yield from self._check_constructor(ctx, node, resolved)
+
+    # -- jit callsites --------------------------------------------------------
+
+    def _check_jit_callsite(
+        self, ctx: FileContext, call: ast.Call, name: str, spec: astutil.JitSpec
+    ) -> Iterator[Finding]:
+        for i, arg in enumerate(call.args):
+            if i in spec.static_argnums:
+                culprit = self._shape_derived(ctx, arg)
+                if culprit:
+                    yield ctx.finding(
+                        self.rule_id,
+                        arg,
+                        f"static arg {i} of jit callsite '{name}' is "
+                        f"shape-derived ({culprit}) — every distinct value "
+                        "recompiles; route it through the padded-shape tables",
+                    )
+            if self._varlen_pytree(arg):
+                yield ctx.finding(
+                    self.rule_id,
+                    arg,
+                    f"variable-length sequence built inline at jit callsite "
+                    f"'{name}' — each length is a new pytree signature; pass "
+                    "a padded array",
+                )
+        for kw in call.keywords:
+            if kw.arg in spec.static_argnames:
+                culprit = self._shape_derived(ctx, kw.value)
+                if culprit:
+                    yield ctx.finding(
+                        self.rule_id,
+                        kw.value,
+                        f"static kwarg '{kw.arg}' of jit callsite '{name}' is "
+                        f"shape-derived ({culprit}) — every distinct value "
+                        "recompiles; route it through the padded-shape tables",
+                    )
+
+    # -- array constructors ---------------------------------------------------
+
+    def _check_constructor(
+        self, ctx: FileContext, call: ast.Call, resolved: str
+    ) -> Iterator[Finding]:
+        shape_args = list(call.args[:1]) + [
+            kw.value for kw in call.keywords if kw.arg == "shape"
+        ]
+        for shape in shape_args:
+            culprit = self._shape_derived(ctx, shape, stop_at=call)
+            if culprit:
+                yield ctx.finding(
+                    self.rule_id,
+                    shape,
+                    f"'{resolved}' shape embeds raw {culprit} — per-doc "
+                    "sizes must route through a width bucket "
+                    f"({'/'.join(sorted(ctx.config.bucket_fns))}) so shapes "
+                    "stay stable across rounds",
+                )
+
+    # -- helpers --------------------------------------------------------------
+
+    def _varlen_pytree(self, arg: ast.AST) -> bool:
+        if isinstance(arg, (ast.ListComp, ast.GeneratorExp)):
+            return True
+        return isinstance(arg, ast.Call) and astutil.call_name(arg) == "list"
+
+    def _shape_derived(
+        self, ctx: FileContext, expr: ast.AST, stop_at: Optional[ast.AST] = None
+    ) -> Optional[str]:
+        """Raw ``len(...)`` read inside ``expr`` that is not wrapped by a
+        bucket function; returns a description or None.  (``x.shape`` reads
+        are shape-*preserving* — stable per compiled signature — and stay
+        allowed.)"""
+        for node in ast.walk(expr):
+            if not (isinstance(node, ast.Call) and astutil.call_name(node) == "len"):
+                continue
+            if self._bucketed(ctx, node, stop_at):
+                continue
+            return "len(...)"
+        return None
+
+    def _bucketed(
+        self, ctx: FileContext, node: ast.AST, stop_at: Optional[ast.AST]
+    ) -> bool:
+        for anc in ctx.ancestors(node):
+            if anc is stop_at:
+                return False
+            if isinstance(anc, ast.Call):
+                name = astutil.call_name(anc)
+                if name and name.rpartition(".")[2] in ctx.config.bucket_fns:
+                    return True
+        return False

@@ -167,7 +167,7 @@ class DocBatch:
             # gathers per document.
             resolved = type(resolved_dev)(*(np.asarray(x) for x in resolved_dev))
             stats.resolve_seconds = time.perf_counter() - t0
-        except Exception as exc:
+        except Exception as exc:  # graftlint: boundary(guarded merge: ANY device-path failure degrades to the scalar oracle; re-raised when unguarded)
             if not self.guard:
                 raise
             return self._degraded_merge(workloads, cursors, stats, exc)

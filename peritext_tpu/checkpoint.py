@@ -292,7 +292,7 @@ class CheckpointManager:
             if final.exists():
                 shutil.rmtree(final)
             os.rename(staging, final)
-        except BaseException:
+        except BaseException:  # graftlint: boundary(staging cleanup then re-raise; KeyboardInterrupt must not leak a half-written checkpoint)
             shutil.rmtree(staging, ignore_errors=True)
             raise
         self._prune()

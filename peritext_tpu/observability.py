@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
+import re
 import threading
 import time
 from collections import defaultdict
@@ -67,16 +69,22 @@ GLOBAL_COUNTERS = Counters()
 
 
 #: counter namespaces that make up the fault-domain health surface
-_HEALTH_PREFIXES = ("streaming.", "transport.", "supervisor.", "merge.")
+_HEALTH_PREFIXES = ("streaming.", "transport.", "supervisor.", "merge.", "jit.")
 
 
-def health_snapshot(counters: Optional[Counters] = None, session=None) -> Dict[str, Any]:
+def health_snapshot(
+    counters: Optional[Counters] = None, session=None, sentinel=None
+) -> Dict[str, Any]:
     """One structured dict for a fleet health endpoint: every fault-domain
     counter (quarantines, corrupt frames, transport retries / behind peers,
-    supervisor rollbacks, guarded-merge fallbacks), plus — when a streaming
-    session or its :class:`~.parallel.supervisor.GuardedSession` is given —
-    that session's own ``health()`` (quarantine registry with typed reasons,
-    fallback/pending counts, rollback evidence)."""
+    supervisor rollbacks, guarded-merge fallbacks, per-jit-site compile
+    counts), plus — when a streaming session or its
+    :class:`~.parallel.supervisor.GuardedSession` is given — that session's
+    own ``health()`` (quarantine registry with typed reasons,
+    fallback/pending counts, rollback evidence).  With a
+    :class:`RecompileSentinel` attached, its per-site compile counts appear
+    under ``recompiles`` (the counter form lands under ``counters`` as
+    ``jit.compiles.*`` either way)."""
     counters = counters or GLOBAL_COUNTERS
     out: Dict[str, Any] = {
         "counters": {
@@ -87,7 +95,127 @@ def health_snapshot(counters: Optional[Counters] = None, session=None) -> Dict[s
     }
     if session is not None:
         out["session"] = session.health()
+    if sentinel is not None:
+        out["recompiles"] = {
+            "sites": dict(sorted(sentinel.counts.items())),
+            "total": sentinel.total,
+        }
     return out
+
+
+#: jax's log_compiles emission: "Compiling <site> with global shapes and
+#: types ..." (pxla) / "Compiling <site> for ..." (older dispatch paths)
+_COMPILE_MSG_RE = re.compile(r"^Compiling (\S+)")
+
+
+class RecompileSentinel(logging.Handler):
+    """Runtime guard for the compile-shape discipline (DESIGN.md "compile-
+    shape discipline", graftlint PTL004): counts XLA compilations **per jit
+    site** so steady-state streaming rounds can assert *zero* recompiles.
+
+    Backed by ``jax_log_compiles``: while active, jax logs one
+    ``Compiling <site> ...`` record per executable built, and this handler
+    (attached to the ``"jax"`` logger) tallies it — no private APIs, no
+    tracing overhead beyond the log call.  Counts land three ways:
+
+    * :attr:`counts` — ``{site: compiles}`` on the sentinel itself;
+    * ``jit.compiles.<site>`` / ``jit.compiles_total`` on the target
+      :class:`Counters` (default :data:`GLOBAL_COUNTERS`), which
+      :func:`health_snapshot` exports;
+    * ``health_snapshot(sentinel=s)`` embeds the per-site dict directly.
+
+    Use as a context manager; :meth:`mark` + :meth:`assert_steady_state`
+    express the invariant tests care about::
+
+        with RecompileSentinel() as s:
+            warmup_rounds(session)
+            s.mark()
+            steady_rounds(session)
+            s.assert_steady_state("steady-state streaming rounds")
+    """
+
+    def __init__(self, counters: Optional[Counters] = None, logger: str = "jax"):
+        super().__init__(level=logging.DEBUG)
+        self.counts: Dict[str, int] = {}
+        self._marked: Dict[str, int] = {}
+        self._counters = counters if counters is not None else GLOBAL_COUNTERS
+        self._logger = logging.getLogger(logger)
+        self._prev_log_compiles: Optional[bool] = None
+        self._active = False
+
+    # -- logging.Handler ------------------------------------------------------
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            message = record.getMessage()
+        except Exception:  # graftlint: boundary(malformed foreign log records are ignored, never raised into the workload)
+            return
+        m = _COMPILE_MSG_RE.match(message)
+        if m is None:
+            return
+        site = m.group(1)
+        self.counts[site] = self.counts.get(site, 0) + 1
+        self._counters.add(f"jit.compiles.{site}")
+        self._counters.add("jit.compiles_total")
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "RecompileSentinel":
+        if self._active:
+            return self
+        import jax
+
+        self._prev_log_compiles = bool(jax.config.jax_log_compiles)
+        jax.config.update("jax_log_compiles", True)
+        self._logger.addHandler(self)
+        self._active = True
+        return self
+
+    def stop(self) -> None:
+        if not self._active:
+            return
+        self._logger.removeHandler(self)
+        try:
+            import jax
+
+            jax.config.update("jax_log_compiles", self._prev_log_compiles)
+        except Exception:  # graftlint: boundary(best-effort config restore on teardown; the counts already collected stay valid)
+            pass
+        self._active = False
+
+    def __enter__(self) -> "RecompileSentinel":
+        return self.start()
+
+    def __exit__(self, *exc_info) -> None:
+        self.stop()
+
+    # -- assertions -----------------------------------------------------------
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    def mark(self) -> None:
+        """Snapshot the current counts; :meth:`since_mark` and
+        :meth:`assert_steady_state` measure growth from here."""
+        self._marked = dict(self.counts)
+
+    def since_mark(self) -> Dict[str, int]:
+        """Per-site compiles since :meth:`mark` (empty dict = steady state)."""
+        return {
+            site: n - self._marked.get(site, 0)
+            for site, n in sorted(self.counts.items())
+            if n > self._marked.get(site, 0)
+        }
+
+    def assert_steady_state(self, what: str = "steady-state rounds") -> None:
+        fresh = self.since_mark()
+        if fresh:
+            raise AssertionError(
+                f"{what} triggered {sum(fresh.values())} recompile(s): {fresh} "
+                "— a per-round shape escaped the padded-shape tables "
+                "(see DESIGN.md compile-shape discipline / graftlint PTL004)"
+            )
 
 
 class EventLog:
@@ -148,7 +276,7 @@ def profile_trace(log_dir: str | Path, enabled: bool = True) -> Iterator[None]:
 
         jax.profiler.start_trace(str(log_dir))
         started = True
-    except Exception:
+    except Exception:  # graftlint: boundary(profiler availability is platform-defined; tracing must never fail the traced workload)
         started = False
     try:
         yield
@@ -156,7 +284,7 @@ def profile_trace(log_dir: str | Path, enabled: bool = True) -> Iterator[None]:
         if started:
             try:
                 jax.profiler.stop_trace()
-            except Exception:
+            except Exception:  # graftlint: boundary(stop mirrors start: a torn trace is dropped, never raised into the workload)
                 pass
 
 

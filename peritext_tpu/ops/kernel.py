@@ -397,14 +397,14 @@ def encoded_arrays_of(encoded: EncodedBatch):
         jnp.asarray(encoded.ins_op),
         jnp.asarray(encoded.ins_char),
         jnp.asarray(encoded.del_target),
-        {col: jnp.asarray(arr) for col, arr in encoded.marks.items()},
+        {col: jnp.asarray(arr) for col, arr in sorted(encoded.marks.items())},
         jnp.asarray(encoded.mark_count),
     )
     map_ops = getattr(encoded, "map_ops", None)
     if map_ops is None:
         return base
     return base + (
-        {col: jnp.asarray(arr) for col, arr in map_ops.items()},
+        {col: jnp.asarray(arr) for col, arr in sorted(map_ops.items())},
         jnp.asarray(encoded.map_count),
     )
 

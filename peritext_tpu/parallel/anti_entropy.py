@@ -43,7 +43,9 @@ class ChangeStore:
         return list(self._logs.keys())
 
     def clock(self) -> Clock:
-        return {actor: len(log) for actor, log in self._logs.items()}
+        # sorted so the clock's key order (which reaches wire frames) is a
+        # function of the actor set, not of arrival order (PTL001)
+        return {actor: len(log) for actor, log in sorted(self._logs.items())}
 
     def missing_changes(self, source_clock: Clock, target_clock: Clock) -> List[Change]:
         """Changes known to ``source`` but not ``target`` (reference

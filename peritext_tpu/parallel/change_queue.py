@@ -49,7 +49,7 @@ class ChangeQueue:
             return
         try:
             self._handle_flush(batch)
-        except Exception:
+        except Exception:  # graftlint: boundary(requeue-then-reraise: the batch must survive ANY flush failure; the exception propagates unchanged)
             with self._lock:  # requeue at the front; nothing is dropped
                 self._changes = batch + self._changes
             raise

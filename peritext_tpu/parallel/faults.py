@@ -164,7 +164,10 @@ class FaultyPublisher(Publisher):
         return decode_frame(frame)
 
     def publish(self, sender: str, update: List[Change]) -> None:
-        for key, callback in list(self._subscribers.items()):
+        # sorted, not subscription order: fault draws consume the rng in
+        # subscriber-key order, so a run is reproducible from (seed, spec)
+        # alone regardless of subscription timing (PTL001)
+        for key, callback in sorted(self._subscribers.items()):
             if key == sender:
                 continue
             perturbed = perturb_delivery(list(update), self.rng, self.spec)
@@ -190,7 +193,7 @@ class FaultyPublisher(Publisher):
         """Re-deliver every recorded drop (faithfully, no new faults);
         returns how many changes were retransmitted."""
         count = 0
-        for key, batches in list(self.lost.items()):
+        for key, batches in sorted(self.lost.items()):  # deterministic repair order
             callback = self._subscribers.get(key)
             if callback is None:
                 continue

@@ -508,7 +508,7 @@ def try_sync_with(
         def run(arg):
             try:
                 cb(arg)
-            except Exception as exc:
+            except Exception as exc:  # graftlint: boundary(fences caller callbacks out of the exchange's retry/error space; rewrapped and re-raised)
                 raise _CallbackFailed() from exc
 
         return run

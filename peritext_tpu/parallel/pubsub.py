@@ -28,6 +28,8 @@ class Publisher(Generic[T]):
         del self._subscribers[key]
 
     def publish(self, sender: str, update: T) -> None:
-        for key, callback in list(self._subscribers.items()):
+        # deterministic fan-out order: subscription (arrival) order is
+        # replica-local history and must not drive delivery (PTL001)
+        for key, callback in sorted(self._subscribers.items()):
             if key != sender:
                 callback(update)

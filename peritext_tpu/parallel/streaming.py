@@ -655,7 +655,7 @@ class StreamingMerge:
                 rec.clean_delivery = True
         if bad:
             GLOBAL_COUNTERS.add("streaming.corrupt_frames", len(corrupt))
-            for d in bad:
+            for d in sorted(bad):  # deterministic quarantine-registry order
                 self.quarantine_doc(
                     int(d), REASON_DECODE, "corrupt wire frame discarded"
                 )
@@ -818,7 +818,7 @@ class StreamingMerge:
         "Wire-frame checksum"); the frontier diff of the next anti-entropy
         round is what closes that window."""
         candidates = [
-            d for d, r in self._quarantine.items()
+            d for d, r in sorted(self._quarantine.items())  # readmit in doc order
             if r.reason == REASON_DECODE and r.clean_delivery
         ]
         if not candidates:
@@ -1394,14 +1394,14 @@ class StreamingMerge:
                     (ki, kd, km, kp),
                     (enc.ins_ref[r], enc.ins_op[r], enc.ins_char[r]),
                     enc.del_target[r],
-                    {col: enc.marks[col][r] for col in enc.marks},
-                    {col: enc.map_ops[col][r] for col in enc.map_ops},
+                    {col: enc.marks[col][r] for col in sorted(enc.marks)},
+                    {col: enc.map_ops[col][r] for col in sorted(enc.map_ops)},
                     len(self._actor_table),
                 )
             except FrameIngestError:
-                for col in enc.marks:  # discard any partial row writes
+                for col in sorted(enc.marks):  # discard any partial row writes
                     enc.marks[col][r] = 0
-                for col in enc.map_ops:
+                for col in sorted(enc.map_ops):
                     enc.map_ops[col][r] = 0
                 enc.ins_ref[r] = 0
                 enc.ins_op[r] = 0
@@ -2026,7 +2026,10 @@ class StreamingMerge:
         obj_attr = np.zeros((_width_bucket(len(enc)) if enc else 0, a_w), np.uint32)
         obj_key = np.zeros((obj_attr.shape[0], k_w), np.uint32)
         comment_hash = np.zeros((k, c_w), np.uint32)
-        for j, (i, e) in enumerate(enc.items()):
+        # sorted: override-row assignment order must be a function of the
+        # row set (it feeds row_map and the digest tables), never of dict
+        # insertion history
+        for j, (i, e) in enumerate(sorted(enc.items())):
             ah = e.attrs.content_hashes()
             kh = e.keys.content_hashes()
             row_map[i] = j
@@ -2262,7 +2265,7 @@ class StreamingMerge:
         key = (
             len(sess_attr), len(sess_keys), self._placement_epoch,
             tuple((row, len(e.attrs.content_hashes()), len(e.keys.content_hashes()))
-                  for row, e in enc.items()),
+                  for row, e in sorted(enc.items())),
             tuple(sorted(
                 (d, len(t)) for d, t in self._doc_comment_ids.items()
                 if lo <= int(self._row_of[d]) < hi and self.docs[d].frame_mode
@@ -2289,7 +2292,10 @@ class StreamingMerge:
         obj_attr = np.zeros((n_obj_w, a_w), np.uint32)
         obj_key = np.zeros((n_obj_w, k_w), np.uint32)
         comment_hash = np.zeros((d_block, c_w), np.uint32)
-        for i, (row, e) in enumerate(enc.items()):
+        # sorted for the same reason as the cache key above: the override
+        # matrix row order (and therefore row_map) must depend only on
+        # which rows hold object docs
+        for i, (row, e) in enumerate(sorted(enc.items())):
             ah = e.attrs.content_hashes()
             kh = e.keys.content_hashes()
             row_map[row - lo] = i
@@ -2297,7 +2303,7 @@ class StreamingMerge:
             obj_key[i, : len(kh)] = kh
             # object-path comment marks index the same per-doc attr interner
             comment_hash[row - lo, : min(c_w, len(ah))] = ah[:min(c_w, len(ah))]
-        for d, table in self._doc_comment_ids.items():
+        for d, table in sorted(self._doc_comment_ids.items()):
             row = int(self._row_of[d])
             if lo <= row < hi and self.docs[d].frame_mode:
                 ch = table.content_hashes()
@@ -2363,9 +2369,13 @@ class StreamingMerge:
             for idx in np.nonzero(col_max)[0]:
                 merged[self._actor_table.lookup(int(idx))] = int(col_max[idx])
         for sess in self.docs:
-            for actor, seq in sess.clock.items():
+            for actor, seq in sorted(sess.clock.items()):
                 merged[actor] = max(merged.get(actor, 0), seq)
-        return merged
+        # sorted at the END so the frontier's key order (which reaches wire
+        # frames via json) is a function of the actor set alone — the
+        # clock-matrix loop above inserts in actor-table interning (arrival)
+        # order, which is replica-local
+        return dict(sorted(merged.items()))
 
     def overflow_count(self) -> int:
         """Docs the device read path cannot serve: apply-time capacity
@@ -2509,7 +2519,7 @@ def _doc_path_of_object(doc: Doc, target) -> Optional[list]:
         meta = doc._metadata.get(oid)
         if not isinstance(meta, MapMeta):
             continue
-        for key, child in meta.children.items():
+        for key, child in sorted(meta.children.items()):  # deterministic BFS path
             if child == target:
                 return path + [key]
             queue.append((child, path + [key]))

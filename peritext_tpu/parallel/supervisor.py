@@ -182,14 +182,14 @@ class GuardedSession:
             if self._inject_failures:
                 raise self._inject_failures.pop(0)
             scheduled = self._run_guarded(self._round)
-        except Exception as exc:
+        except Exception as exc:  # graftlint: boundary(degradation ladder root: ANY round failure rolls back to the last good checkpoint)
             self._rollback(exc)
             return 0
         self._rounds_since_checkpoint += 1
         if self._rounds_since_checkpoint >= self.checkpoint_every:
             try:
                 self.checkpoint()
-            except Exception:
+            except Exception:  # graftlint: boundary(checkpoint save failure tolerated; next round retries)
                 # a failed save (disk full, permissions) must not breach the
                 # no-fault contract of step(); the journal was only truncated
                 # after a successful save, so rollback state stays complete —
@@ -254,7 +254,7 @@ class GuardedSession:
         self.session = self._restore_base()
         try:
             self._run_guarded(self._drain_device)
-        except Exception as exc:
+        except Exception as exc:  # graftlint: boundary(second-strike containment: a still-sick device path falls back to scalar replay)
             # the device path is still sick: rebuild once more from durable
             # state (a deadline here may have left a zombie thread draining
             # the object we just restored — abandon it too), then contain:

@@ -17,3 +17,18 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def recompile_sentinel():
+    """Per-jit-site XLA compile counter (jax_log_compiles-backed).
+
+    Active for the whole test: run warmup, ``mark()``, run the steady-state
+    rounds, then ``assert_steady_state()`` to require zero recompiles.
+    """
+    from peritext_tpu.observability import RecompileSentinel
+
+    with RecompileSentinel() as sentinel:
+        yield sentinel

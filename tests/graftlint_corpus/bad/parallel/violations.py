@@ -1,0 +1,101 @@
+"""graftlint fixture corpus: TRUE POSITIVES, one block per rule.
+
+Every construct here must be flagged; test_graftlint.py asserts the exact
+set of (rule, line-context) hits, and the acceptance criterion runs the CLI
+over this tree expecting a nonzero exit.  Never "fix" this file.
+"""
+
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+class Registry:
+    def __init__(self):
+        self._subscribers = {}
+        self._lost = {}
+
+    # PTL001: dict view of long-lived instance state
+    def fanout(self, update):
+        for key, callback in list(self._subscribers.items()):
+            callback(update)
+
+    # PTL001: set iteration
+    def drop_all(self, doc_ids):
+        for doc in set(doc_ids):
+            self._lost.pop(doc, None)
+
+    # PTL001: set-typed local name
+    def sweep(self):
+        pending = set(self._lost)
+        return [self._lost[d] for d in pending]
+
+    # PTL001: bare iteration over dict-typed instance state
+    def keys_walk(self):
+        return [key for key in self._subscribers]
+
+
+class PendingSet:
+    def __init__(self):
+        self._pending = set()
+
+    # PTL001: bare iteration over set-typed instance state
+    def drain(self):
+        for doc in self._pending:
+            yield doc
+
+
+# PTL002: Python control flow on a traced value
+@jax.jit
+def traced_branch(x, flag):
+    if flag:
+        return x + 1
+    while x:
+        x = x - 1
+    return jnp.where(x > 0, x, -x)
+
+
+# PTL002 (via partial form) + PTL003 (.item() host sync)
+@partial(jax.jit, static_argnums=1)
+def traced_loop(x, width):
+    total = x.sum()
+    sign = 1 if total else -1  # PTL002: ternary on a traced value
+    for _ in range(total):
+        x = x * sign * 2
+    return x.item()
+
+
+# PTL003: host sync reachable through a file-local helper
+def _helper_sync(x):
+    return np.asarray(x) + jax.device_get(x)
+
+
+@jax.jit
+def calls_helper(x):
+    return _helper_sync(x)
+
+
+# PTL004: shape-derived static arg at a jit callsite
+def dispatch(docs):
+    padded = jnp.zeros(len(docs))  # PTL004: unbucketed len() shape
+    return traced_loop(padded, len(docs))
+
+
+# PTL005: broad except without a boundary annotation
+def swallow(op):
+    try:
+        return op()
+    except Exception:
+        return None
+
+
+# PTL006: wall clock + unseeded/global RNG in a merge region
+def jittery_merge(items):
+    deadline = time.time() + 1.0
+    random.shuffle(items)
+    rng = random.Random()
+    return items, rng.random(), deadline

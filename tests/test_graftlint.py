@@ -1,0 +1,234 @@
+"""graftlint suite tests: every rule has true positives (the bad corpus)
+and clean negatives (the clean corpus), the attributed baseline round-trips,
+the CLI exit codes hold, and the repo self-scan is clean modulo the
+checked-in baseline — the acceptance criteria of the determinism contract
+(DESIGN.md "Determinism contract")."""
+
+from pathlib import Path
+
+import pytest
+
+from peritext_tpu.analysis import (
+    all_rule_ids,
+    apply_baseline,
+    find_default_baseline,
+    load_baseline,
+    rule_table,
+    scan_paths,
+    update_baseline,
+)
+from peritext_tpu.analysis.__main__ import main as graftlint_main
+from peritext_tpu.analysis.baseline import save_baseline
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+CORPUS = Path(__file__).resolve().parent / "graftlint_corpus"
+
+
+def _scan(path):
+    return scan_paths([path], root=REPO_ROOT)
+
+
+class TestRules:
+    @pytest.fixture(scope="class")
+    def bad_findings(self):
+        return _scan(CORPUS / "bad")
+
+    @pytest.mark.parametrize("rule", all_rule_ids())
+    def test_every_rule_has_a_true_positive(self, bad_findings, rule):
+        assert any(f.rule == rule for f in bad_findings), (
+            f"{rule} found nothing in the bad corpus"
+        )
+
+    def test_clean_corpus_scans_clean(self):
+        assert _scan(CORPUS / "clean") == []
+
+    def test_findings_carry_stable_contexts(self, bad_findings):
+        for f in bad_findings:
+            assert f.context, f  # fingerprint basis must never be empty
+            assert f.path.startswith("tests/graftlint_corpus/bad")
+
+    def test_expected_positive_spot_checks(self, bad_findings):
+        hits = {(f.rule, f.context) for f in bad_findings}
+        assert ("PTL001", "for key, callback in list(self._subscribers.items()):") in hits
+        # bare iteration over dict/set-typed instance state — the most
+        # common spelling of the arrival-order hazard
+        assert ("PTL001", "return [key for key in self._subscribers]") in hits
+        assert ("PTL001", "for doc in self._pending:") in hits
+        assert ("PTL002", "if flag:") in hits
+        assert ("PTL002", "while x:") in hits
+        assert ("PTL003", "return x.item()") in hits
+        assert ("PTL005", "except Exception:") in hits
+        assert ("PTL006", "rng = random.Random()") in hits
+        assert any(r == "PTL004" and "len(docs)" in c for r, c in hits)
+
+    def test_merge_scope_rules_skip_unscoped_files(self, tmp_path):
+        src = "import time\n\ndef f():\n    return time.time()\n"
+        (tmp_path / "util.py").write_text(src)
+        assert scan_paths([tmp_path / "util.py"], root=tmp_path) == []
+        scoped = tmp_path / "parallel"
+        scoped.mkdir()
+        (scoped / "util.py").write_text(src)
+        findings = scan_paths([scoped / "util.py"], root=tmp_path)
+        assert [f.rule for f in findings] == ["PTL006"]
+
+    def test_nonexistent_path_is_an_error_not_a_clean_scan(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            scan_paths([tmp_path / "no_such_pkg"], root=tmp_path)
+        (tmp_path / "notes.txt").write_text("not python")
+        with pytest.raises(ValueError):
+            scan_paths([tmp_path / "notes.txt"], root=tmp_path)
+        assert graftlint_main([str(tmp_path / "no_such_pkg")]) == 2
+
+    def test_unparseable_file_reports_ptl000(self, tmp_path):
+        bad = tmp_path / "broken.py"
+        bad.write_text("def f(:\n")
+        findings = scan_paths([bad], root=tmp_path)
+        assert [f.rule for f in findings] == ["PTL000"]
+
+    def test_rule_table_is_complete(self):
+        assert [row["id"] for row in rule_table()] == all_rule_ids()
+        assert all(row["summary"] and row["rationale"] for row in rule_table())
+        assert len(all_rule_ids()) >= 6  # registry-derived, never hardcoded
+
+    def test_assignment_ternary_on_tracer_is_flagged(self, bad_findings):
+        assert ("PTL002", "sign = 1 if total else -1  # PTL002: ternary on a traced value") in {
+            (f.rule, f.context) for f in bad_findings
+        }
+
+
+class TestBaseline:
+    def test_round_trip_suppresses_then_catches_new(self, tmp_path):
+        findings = _scan(CORPUS / "bad")
+        assert findings
+        baseline_path = tmp_path / "baseline.json"
+        save_baseline(baseline_path, update_baseline(findings, {}))
+        entries = load_baseline(baseline_path)
+
+        new, stale = apply_baseline(findings, entries)
+        assert new == [] and stale == []  # full suppression round-trip
+
+        # a brand-new violation is NOT absorbed by the old baseline
+        extra = tmp_path / "parallel"
+        extra.mkdir()
+        (extra / "fresh.py").write_text(
+            "import random\n\ndef f(xs):\n    random.shuffle(xs)\n"
+        )
+        grown = findings + scan_paths([extra], root=tmp_path)
+        new, stale = apply_baseline(grown, entries)
+        assert [f.rule for f in new] == ["PTL006"] and stale == []
+
+    def test_update_with_no_prior_baseline_anchors_at_cwd(self, tmp_path, monkeypatch, capsys):
+        """--update-baseline must write the ledger at the scan root (cwd),
+        never inside the scanned tree, so default discovery finds it with
+        matching relative paths."""
+        scoped = tmp_path / "parallel"
+        scoped.mkdir()
+        (scoped / "v.py").write_text(
+            "import random\n\ndef f(xs):\n    random.shuffle(xs)\n"
+        )
+        monkeypatch.chdir(tmp_path)
+        assert graftlint_main(["parallel/v.py", "--update-baseline"]) == 0
+        ledger = tmp_path / "graftlint_baseline.json"
+        assert ledger.is_file()
+        assert not (scoped / "graftlint_baseline.json").exists()
+        entries = load_baseline(ledger)
+        assert {e.path for e in entries.values()} == {"parallel/v.py"}
+        # and the default-discovery scan is now clean against it
+        assert graftlint_main(["parallel"]) == 0
+
+    def test_stale_entries_are_reported_not_fatal(self):
+        findings = _scan(CORPUS / "bad")
+        entries = update_baseline(findings, {})
+        by_key = {(e.rule, e.path, e.context): e for e in entries}
+        new, stale = apply_baseline(findings[1:], by_key)
+        assert new == []
+        assert len(stale) == 1  # the dropped finding's entry went stale
+
+    def test_update_preserves_justifications(self):
+        findings = _scan(CORPUS / "bad")
+        first = update_baseline(findings, {})
+        first[0].justification = "because physics"
+        old = {(e.rule, e.path, e.context): e for e in first}
+        second = update_baseline(findings, old)
+        assert second[0].justification == "because physics"
+        assert all(
+            e.justification.startswith("TODO") for e in second[1:]
+        ) or len(second) == 1
+
+
+class TestCli:
+    def test_bad_corpus_exits_nonzero(self, capsys):
+        rc = graftlint_main([str(CORPUS / "bad"), "--no-baseline"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "PTL001" in out and "PTL006" in out
+
+    def test_clean_corpus_exits_zero(self, capsys):
+        assert graftlint_main([str(CORPUS / "clean"), "--no-baseline"]) == 0
+
+    def test_rule_subset_and_unknown_rule(self, capsys):
+        rc = graftlint_main(
+            [str(CORPUS / "bad"), "--no-baseline", "--rules", "PTL005"]
+        )
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "PTL005" in out and "PTL001" not in out
+        assert graftlint_main([str(CORPUS / "bad"), "--rules", "PTL999"]) == 2
+
+    def test_json_format(self, capsys):
+        import json
+
+        rc = graftlint_main(
+            [str(CORPUS / "bad"), "--no-baseline", "--format", "json"]
+        )
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert {f["rule"] for f in payload["findings"]} == set(all_rule_ids())
+
+    def test_rules_scoped_update_preserves_other_entries(self, tmp_path, monkeypatch):
+        """--rules + --update-baseline must not delete other rules' ledger
+        entries (or their justifications)."""
+        scoped = tmp_path / "parallel"
+        scoped.mkdir()
+        (scoped / "v.py").write_text(
+            "import random, time\n\ndef f(xs):\n"
+            "    random.shuffle(xs)\n"
+            "    for x in set(xs):\n        pass\n"
+        )
+        monkeypatch.chdir(tmp_path)
+        assert graftlint_main(["parallel", "--update-baseline"]) == 0
+        ledger = tmp_path / "graftlint_baseline.json"
+        full = load_baseline(ledger)
+        assert {e.rule for e in full.values()} == {"PTL001", "PTL006"}
+        for e in full.values():
+            e.justification = "kept"
+        from peritext_tpu.analysis.baseline import save_baseline as _save
+
+        _save(ledger, full.values())
+        assert graftlint_main(["parallel", "--rules", "PTL001", "--update-baseline"]) == 0
+        after = load_baseline(ledger)
+        assert {e.rule for e in after.values()} == {"PTL001", "PTL006"}
+        assert all(e.justification == "kept" for e in after.values())
+
+
+class TestRepoSelfScan:
+    def test_checked_in_baseline_is_found(self):
+        found = find_default_baseline([REPO_ROOT / "peritext_tpu"])
+        assert found == REPO_ROOT / "graftlint_baseline.json"
+
+    def test_repo_scan_is_clean_modulo_baseline(self):
+        """THE acceptance criterion: zero unbaselined findings in the
+        package, and every baseline entry both live and justified."""
+        findings = scan_paths([REPO_ROOT / "peritext_tpu"], root=REPO_ROOT)
+        entries = load_baseline(REPO_ROOT / "graftlint_baseline.json")
+        new, stale = apply_baseline(findings, entries)
+        assert new == [], "unbaselined graftlint findings:\n" + "\n".join(
+            f.render() for f in new
+        )
+        assert stale == [], "stale baseline entries: " + ", ".join(
+            f"{e.rule} {e.path}" for e in stale
+        )
+        assert all(
+            e.justification and not e.justification.startswith("TODO")
+            for e in entries.values()
+        ), "baseline entries must carry real justifications"
