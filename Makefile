@@ -6,7 +6,7 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test fuzz fuzz-differential fuzz-frames fuzz-crash chaos weak-scaling \
-	bench bench-smoke bench-streaming entry dryrun lint lint-baseline clean
+	bench bench-smoke bench-streaming entry dryrun lint lint-baseline clean obs
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -30,6 +30,12 @@ chaos:
 # 1/2/4/8-device virtual-mesh scaling + digest-invariance evidence
 weak-scaling:
 	$(PY) scripts/weak_scaling.py
+
+# observability smoke (mirrors the CI obs-smoke job): 128-doc streaming
+# session with tracing on; asserts a non-empty Perfetto dump parses and
+# prints the per-stage summary (artifacts land in /tmp/pt-obs)
+obs:
+	$(CPU_ENV) $(PY) scripts/obs_smoke.py --out /tmp/pt-obs
 
 # streaming frame ingest vs oracle (spans + incremental patch streams)
 fuzz-frames:

@@ -355,8 +355,14 @@ def run_streaming(args) -> dict:
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    from peritext_tpu.obs import GLOBAL_HISTOGRAMS, GLOBAL_TRACER
     from peritext_tpu.parallel.streaming import StreamingMerge
     from peritext_tpu.testing.fuzz import generate_workload
+
+    if args.trace_out:
+        # pipeline spans for the measured sessions -> Perfetto JSON; render
+        # a per-stage table with `python -m peritext_tpu.obs <trace>`
+        GLOBAL_TRACER.enabled = True
 
     d, rounds = args.docs, args.rounds
     gen_start = time.perf_counter()
@@ -430,7 +436,14 @@ def run_streaming(args) -> dict:
     baseline, native_baseline = _baselines_for(args.ops_per_doc, args.seed or 7)
     honest = native_baseline or baseline
     value = total_ops / elapsed
+    if args.trace_out:
+        GLOBAL_TRACER.write_chrome_trace(args.trace_out)
     return {
+        # rolling percentiles of the committed-round wall (schedule+apply
+        # dispatch) across the whole measurement, the deadline-autotune view
+        "round_latency": GLOBAL_HISTOGRAMS.get(
+            "streaming.round_seconds"
+        ).snapshot(),
         "metric": "streaming_crdt_ops_per_sec_per_chip",
         "value": round(value, 1),
         "unit": "ops/s",
@@ -1206,13 +1219,23 @@ def main() -> None:
         help="capture a jax.profiler trace of the steady-state loop into DIR",
     )
     parser.add_argument(
+        "--trace-out", default=None, metavar="PATH", dest="trace_out",
+        help="write the streaming pipeline spans as Perfetto/Chrome "
+             "trace-event JSON to PATH (streaming mode)",
+    )
+    parser.add_argument(
         "--_worker", action="store_true", dest="worker", help=argparse.SUPPRESS
     )
     args = parser.parse_args()
 
+    if args.trace_out and args.mode not in ("streaming",):
+        # only the streaming runner consumes it; anything else would both
+        # skip the default ladder AND silently write no trace
+        parser.error("--trace-out requires --mode streaming")
+
     explicit_sizing = (
         any(v is not None for v in (args.docs, args.ops_per_doc, args.slots,
-                                    args.marks, args.profile))
+                                    args.marks, args.profile, args.trace_out))
         or args.iters != 10 or args.seed != 0 or args.rounds != 4
         or args.object_ingest
     )

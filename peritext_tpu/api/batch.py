@@ -18,7 +18,6 @@ replaying all changes — the differential tests assert exactly that equality.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -26,7 +25,7 @@ import numpy as np
 
 from ..core.doc import Doc
 from ..core.types import Change, FormatSpan
-from ..observability import GLOBAL_COUNTERS, MergeStats
+from ..obs import GLOBAL_COUNTERS, GLOBAL_HISTOGRAMS, GLOBAL_TRACER, MergeStats
 from ..ops.decode import decode_block_spans
 from ..ops.encode import EncodedBatch, encode_workloads
 from ..ops.kernel import apply_batch, apply_batch_jit, encoded_arrays_of
@@ -77,7 +76,12 @@ class DocBatch:
         jit: bool = True,
         mesh=None,
         guard: bool = False,
+        tracer=None,
     ) -> None:
+        #: pipeline-span producer (obs/spans.py): merge() opens a
+        #: ``batch.merge`` span with encode/apply/resolve/decode children,
+        #: whose durations also feed MergeStats — one clock, two surfaces
+        self.tracer = tracer if tracer is not None else GLOBAL_TRACER
         self.slot_capacity = slot_capacity
         self.mark_capacity = mark_capacity
         self.comment_capacity = comment_capacity
@@ -149,24 +153,38 @@ class DocBatch:
         (-1 when the cursor's element is absent).  Device docs resolve on
         device (ops/resolve.resolve_cursors); fallback docs via the oracle.
         """
+        with self.tracer.span("batch.merge", docs=len(workloads)) as sp:
+            report = self._merge(workloads, cursors)
+        GLOBAL_HISTOGRAMS.observe("merge.seconds", sp.duration)
+        return report
+
+    def _merge(
+        self,
+        workloads: Sequence[Workload],
+        cursors: Optional[Sequence[Sequence[dict]]],
+    ) -> MergeReport:
+        """merge() behind its pipeline span: each stage runs under a child
+        span whose duration doubles as the MergeStats stage wall-clock."""
         stats = MergeStats(docs=len(workloads))
-        t0 = time.perf_counter()
-        encoded = self.encode(workloads)
-        stats.encode_seconds = time.perf_counter() - t0
+        with self.tracer.span("batch.encode") as sp:
+            encoded = self.encode(workloads)
+        stats.encode_seconds = sp.duration
 
         try:
-            t0 = time.perf_counter()
-            state = self.apply_encoded(encoded)
-            np.asarray(state.num_slots)  # host sync: time the apply honestly
-            stats.apply_seconds = time.perf_counter() - t0
+            with self.tracer.span("batch.apply") as sp:
+                state = self.apply_encoded(encoded)
+                np.asarray(state.num_slots)  # host sync: time apply honestly
+            stats.apply_seconds = sp.duration
 
-            t0 = time.perf_counter()
-            resolved_dev = self._resolve(state, self.comment_capacity)
-            # One whole-array transfer per field, up front: decoding per doc
-            # on the raw (possibly mesh-sharded) arrays would do 5 device
-            # gathers per document.
-            resolved = type(resolved_dev)(*(np.asarray(x) for x in resolved_dev))
-            stats.resolve_seconds = time.perf_counter() - t0
+            with self.tracer.span("batch.resolve") as sp:
+                resolved_dev = self._resolve(state, self.comment_capacity)
+                # One whole-array transfer per field, up front: decoding per
+                # doc on the raw (possibly mesh-sharded) arrays would do 5
+                # device gathers per document.
+                resolved = type(resolved_dev)(
+                    *(np.asarray(x) for x in resolved_dev)
+                )
+            stats.resolve_seconds = sp.duration
         except Exception as exc:  # graftlint: boundary(guarded merge: ANY device-path failure degrades to the scalar oracle; re-raised when unguarded)
             if not self.guard:
                 raise
@@ -192,44 +210,44 @@ class DocBatch:
                 state, resolved_dev.visible, encoded, cursors, fallback, oracle_doc_for
             )
 
-        t0 = time.perf_counter()
-        from ..ops.decode import decode_doc_root
-        from types import SimpleNamespace
+        with self.tracer.span("batch.decode") as sp:
+            from ..ops.decode import decode_doc_root
+            from types import SimpleNamespace
 
-        # register table transfer (small: 5 x (D, R) int32)
-        regs = SimpleNamespace(
-            r_obj=np.asarray(state.r_obj), r_key=np.asarray(state.r_key),
-            r_op=np.asarray(state.r_op), r_kind=np.asarray(state.r_kind),
-            r_val=np.asarray(state.r_val), num_regs=np.asarray(state.num_regs),
-        )
-        # one vectorized span decode for the whole batch (Python touches only
-        # mark-run segments); fallback docs replay through the oracle
-        device_mask = np.zeros(resolved.visible.shape[0], bool)
-        for d in range(len(workloads)):
-            device_mask[d] = d not in fallback
-        block_spans = decode_block_spans(
-            resolved,
-            lambda d: encoded.attr_tables[d],
-            lambda d: encoded.attr_tables[d],
-            doc_mask=device_mask,
-        )
-        spans: List[List[FormatSpan]] = []
-        roots: List[dict] = []
-        device_ops = 0
-        fallback_ops = 0
-        for d, workload in enumerate(workloads):
-            if d in fallback:
-                doc = oracle_doc_for(d)
-                spans.append(doc.get_text_with_formatting(["text"]))
-                roots.append(doc.root)
-                fallback_ops += int(encoded.num_ops[d])
-            else:
-                spans.append(block_spans[d])
-                roots.append(
-                    decode_doc_root(regs, resolved, d, encoded.map_tables[d])
-                )
-                device_ops += int(encoded.num_ops[d])
-        stats.decode_seconds = time.perf_counter() - t0
+            # register table transfer (small: 5 x (D, R) int32)
+            regs = SimpleNamespace(
+                r_obj=np.asarray(state.r_obj), r_key=np.asarray(state.r_key),
+                r_op=np.asarray(state.r_op), r_kind=np.asarray(state.r_kind),
+                r_val=np.asarray(state.r_val), num_regs=np.asarray(state.num_regs),
+            )
+            # one vectorized span decode for the whole batch (Python touches
+            # only mark-run segments); fallback docs replay through the oracle
+            device_mask = np.zeros(resolved.visible.shape[0], bool)
+            for d in range(len(workloads)):
+                device_mask[d] = d not in fallback
+            block_spans = decode_block_spans(
+                resolved,
+                lambda d: encoded.attr_tables[d],
+                lambda d: encoded.attr_tables[d],
+                doc_mask=device_mask,
+            )
+            spans: List[List[FormatSpan]] = []
+            roots: List[dict] = []
+            device_ops = 0
+            fallback_ops = 0
+            for d, workload in enumerate(workloads):
+                if d in fallback:
+                    doc = oracle_doc_for(d)
+                    spans.append(doc.get_text_with_formatting(["text"]))
+                    roots.append(doc.root)
+                    fallback_ops += int(encoded.num_ops[d])
+                else:
+                    spans.append(block_spans[d])
+                    roots.append(
+                        decode_doc_root(regs, resolved, d, encoded.map_tables[d])
+                    )
+                    device_ops += int(encoded.num_ops[d])
+        stats.decode_seconds = sp.duration
 
         stream_capacity = encoded.num_docs * (
             encoded.ins_op.shape[1]
@@ -269,17 +287,17 @@ class DocBatch:
         roots: List[dict] = []
         positions: Optional[List[List[int]]] = [] if cursors is not None else None
         fallback_ops = 0
-        t0 = time.perf_counter()
-        for d, workload in enumerate(workloads):
-            doc = _oracle_doc(workload)
-            spans.append(doc.get_text_with_formatting(["text"]))
-            roots.append(doc.root)
-            fallback_ops += sum(
-                len(ch.ops) for log in workload.values() for ch in log
-            )
-            if positions is not None:
-                positions.append(oracle_cursor_positions(doc, cursors[d]))
-        stats.decode_seconds = time.perf_counter() - t0
+        with self.tracer.span("batch.degraded-replay", docs=len(workloads)) as sp:
+            for d, workload in enumerate(workloads):
+                doc = _oracle_doc(workload)
+                spans.append(doc.get_text_with_formatting(["text"]))
+                roots.append(doc.root)
+                fallback_ops += sum(
+                    len(ch.ops) for log in workload.values() for ch in log
+                )
+                if positions is not None:
+                    positions.append(oracle_cursor_positions(doc, cursors[d]))
+        stats.decode_seconds = sp.duration
         stats.fallback_docs = len(workloads)
         stats.device_docs = 0
         stats.fallback_ops = fallback_ops

@@ -1,0 +1,77 @@
+"""peritext_tpu.obs — the fleet telemetry subsystem.
+
+What grew out of ``peritext_tpu/observability.py`` (which remains as a
+re-export shim so no historical import breaks): the instrumentation layer
+every streaming-perf PR is judged by.  Four cooperating pieces:
+
+* :mod:`.spans` — structured pipeline spans (:class:`Tracer`): nested,
+  monotonic-id spans over the merge pipeline (ingest → encode →
+  device-apply → resolve → decode → patch-scatter), serialized as
+  Perfetto-compatible Chrome trace-event JSON and correlated ACROSS HOSTS
+  by a compact trace-context field carried in the wire codec (frame v5)
+  and the anti-entropy frontier.
+* :mod:`.histograms` — fixed-bucket latency/size histograms with
+  p50/p95/p99 readout; the rolling round-latency window behind the
+  supervisor's deadline autotuning.
+* :mod:`.recorder` — the flight recorder: a bounded ring of recent
+  spans+events per session, dumped as JSONL on quarantine, rollback, or
+  transport give-up so chaos-soak failures become post-mortems.
+* :mod:`.exporters` — Prometheus text exposition and JSON snapshot
+  endpoints (:class:`MetricsServer`, mounted by ``ReplicaServer``), plus
+  the ``python -m peritext_tpu.obs`` CLI (:mod:`.__main__`) that renders a
+  trace dump into a per-stage/per-host summary table.
+
+Design rule (DESIGN.md "Telemetry"): timestamps are telemetry, not merge
+inputs.  Merge-scope modules (``core/``, ``ops/``, ``parallel/``) never
+read the wall clock directly — they open spans and observe histograms, and
+the clock reads happen HERE, outside graftlint's PTL006 merge scope, so the
+determinism contract stays machine-checkable.
+"""
+
+from .events import EventLog, profile_trace
+from .histograms import (
+    GLOBAL_HISTOGRAMS,
+    Histogram,
+    HistogramRegistry,
+    LATENCY_BUCKETS_S,
+    SIZE_BUCKETS,
+)
+from .metrics import Counters, GLOBAL_COUNTERS, health_snapshot
+from .recorder import FlightRecorder
+from .sentinel import RecompileSentinel
+from .spans import (
+    GLOBAL_TRACER,
+    Span,
+    TraceContext,
+    Tracer,
+    ambient_parent,
+    current_span,
+    merge_traces,
+)
+from .stats import MergeStats
+from .exporters import MetricsServer, prometheus_text
+
+__all__ = [
+    "Counters",
+    "EventLog",
+    "FlightRecorder",
+    "GLOBAL_COUNTERS",
+    "GLOBAL_HISTOGRAMS",
+    "GLOBAL_TRACER",
+    "Histogram",
+    "HistogramRegistry",
+    "LATENCY_BUCKETS_S",
+    "MergeStats",
+    "MetricsServer",
+    "RecompileSentinel",
+    "SIZE_BUCKETS",
+    "Span",
+    "TraceContext",
+    "Tracer",
+    "ambient_parent",
+    "current_span",
+    "health_snapshot",
+    "merge_traces",
+    "profile_trace",
+    "prometheus_text",
+]

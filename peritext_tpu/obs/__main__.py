@@ -1,0 +1,169 @@
+"""``python -m peritext_tpu.obs`` — render telemetry artifacts.
+
+Reads Perfetto/Chrome trace-event JSON (a ``Tracer.chrome_trace()`` dump,
+``/trace.json`` scrape, or obs-smoke artifact) or flight-recorder JSONL and
+prints a per-stage / per-host summary table: span count, total wall, mean,
+and p50/p95/p99 per (stage, host).
+
+Usage::
+
+    python -m peritext_tpu.obs summary trace.json [more.json ...]
+    python -m peritext_tpu.obs summary flight-*.jsonl --json
+    python -m peritext_tpu.obs merge -o merged.json hostA.json hostB.json
+
+``summary`` is the default command (``python -m peritext_tpu.obs t.json``
+works).  Exit codes: 0 ok, 1 no spans found, 2 unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+
+def load_spans(path: str | Path) -> List[Dict]:
+    """Normalized span rows ``{name, host, duration_s, trace_id}`` from a
+    Chrome trace JSON or a flight-recorder JSONL file."""
+    text = Path(path).read_text()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if doc is not None:  # chrome trace: object with traceEvents, or a list
+        events = doc.get("traceEvents", []) if isinstance(doc, dict) else doc
+        return [
+            {
+                "name": e.get("name", "?"),
+                "host": e.get("args", {}).get("host", str(e.get("pid", "?"))),
+                "duration_s": e.get("dur", 0) / 1e6,
+                "trace_id": e.get("args", {}).get("trace_id"),
+            }
+            for e in events
+            if e.get("ph") == "X"
+        ]
+    # flight-recorder JSONL: one record per line, spans have kind == "span"
+    spans = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        rec = json.loads(line)
+        if rec.get("kind") == "span":
+            spans.append({
+                "name": rec.get("name", "?"),
+                "host": rec.get("host", "?"),
+                "duration_s": rec.get("duration_s", 0.0),
+                "trace_id": rec.get("trace_id"),
+            })
+    return spans
+
+
+def _pct(durs: List[float], q: float) -> float:
+    if not durs:
+        return 0.0
+    idx = min(len(durs) - 1, max(0, int(q * len(durs)) - (0 if q * len(durs) % 1 else 1)))
+    return durs[idx]
+
+
+def summarize(spans: Sequence[Dict]) -> List[Dict]:
+    """Per-(stage, host) rows sorted by total wall descending."""
+    groups: Dict[tuple, List[float]] = {}
+    for sp in spans:
+        groups.setdefault((sp["name"], sp["host"]), []).append(sp["duration_s"])
+    rows = []
+    for (name, host), durs in sorted(groups.items()):
+        durs = sorted(durs)
+        total = sum(durs)
+        rows.append({
+            "stage": name,
+            "host": host,
+            "count": len(durs),
+            "total_ms": round(total * 1e3, 3),
+            "mean_ms": round(total / len(durs) * 1e3, 3),
+            "p50_ms": round(_pct(durs, 0.50) * 1e3, 3),
+            "p95_ms": round(_pct(durs, 0.95) * 1e3, 3),
+            "p99_ms": round(_pct(durs, 0.99) * 1e3, 3),
+        })
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def render_table(rows: Sequence[Dict]) -> str:
+    cols = ["stage", "host", "count", "total_ms", "mean_ms", "p50_ms",
+            "p95_ms", "p99_ms"]
+    cells = [[str(r[c]) for c in cols] for r in rows]
+    widths = [max(len(c), *(len(row[i]) for row in cells)) if cells else len(c)
+              for i, c in enumerate(cols)]
+    def fmt(row):
+        return "  ".join(
+            v.ljust(w) if i < 2 else v.rjust(w)
+            for i, (v, w) in enumerate(zip(row, widths))
+        )
+    lines = [fmt(cols), fmt(["-" * w for w in widths])]
+    lines.extend(fmt(row) for row in cells)
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # default command: `python -m peritext_tpu.obs trace.json` == summary
+    if argv and argv[0] not in ("summary", "merge", "-h", "--help"):
+        argv.insert(0, "summary")
+    parser = argparse.ArgumentParser(
+        prog="python -m peritext_tpu.obs", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = parser.add_subparsers(dest="cmd")
+    p_sum = sub.add_parser("summary", help="per-stage/per-host summary table")
+    p_sum.add_argument("paths", nargs="+")
+    p_sum.add_argument("--json", action="store_true",
+                       help="machine-readable rows instead of the table")
+    p_merge = sub.add_parser("merge", help="merge chrome traces into one")
+    p_merge.add_argument("paths", nargs="+")
+    p_merge.add_argument("-o", "--out", required=True)
+    args = parser.parse_args(argv)
+    if args.cmd is None:
+        parser.print_help()
+        return 2
+
+    if args.cmd == "merge":
+        from .spans import merge_traces
+
+        traces = []
+        for p in args.paths:
+            try:
+                traces.append(json.loads(Path(p).read_text()))
+            except (OSError, json.JSONDecodeError) as exc:
+                print(f"unreadable trace {p}: {exc}", file=sys.stderr)
+                return 2
+        Path(args.out).write_text(json.dumps(merge_traces(*traces)))
+        print(f"merged {len(traces)} trace(s) -> {args.out}")
+        return 0
+
+    spans: List[Dict] = []
+    for p in args.paths:
+        try:
+            spans.extend(load_spans(p))
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"unreadable trace {p}: {exc}", file=sys.stderr)
+            return 2
+    if not spans:
+        print("no spans found", file=sys.stderr)
+        return 1
+    rows = summarize(spans)
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        hosts = sorted({sp["host"] for sp in spans})
+        traces = sorted({sp["trace_id"] for sp in spans if sp["trace_id"]})
+        print(f"{len(spans)} spans · {len(hosts)} host(s) · "
+              f"{len(traces)} trace(s)")
+        print(render_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,171 @@
+"""Metrics exporters: Prometheus text exposition and HTTP endpoints.
+
+:func:`prometheus_text` renders the process counters + histograms (and
+optionally one session's health) in Prometheus text-exposition format
+(version 0.0.4).  :class:`MetricsServer` mounts that plus the JSON health
+snapshot and the live Perfetto trace on a tiny threaded HTTP server —
+``ReplicaServer(metrics_port=...)`` starts one per host, so a fleet scrape
+is ``GET /metrics`` against every replica.
+
+Endpoints:
+
+* ``/metrics``     — Prometheus text exposition
+* ``/health.json`` — :func:`~.metrics.health_snapshot` as JSON
+* ``/trace.json``  — the attached tracer's Chrome trace-event dump
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+from .histograms import GLOBAL_HISTOGRAMS, HistogramRegistry
+from .metrics import Counters, GLOBAL_COUNTERS, health_snapshot
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(name: str) -> str:
+    return "peritext_" + _NAME_RE.sub("_", name)
+
+
+def _fmt(value: float) -> str:
+    return repr(round(float(value), 9)) if value % 1 else str(int(value))
+
+
+def prometheus_text(
+    counters: Optional[Counters] = None,
+    histograms: Optional[HistogramRegistry] = None,
+    session=None,
+    sentinel=None,
+) -> str:
+    """Prometheus text exposition of the process telemetry.  Counter names
+    sanitize ``.`` → ``_`` under a ``peritext_`` prefix; histograms emit the
+    standard ``_bucket{le=...}`` / ``_sum`` / ``_count`` series; a session's
+    numeric health fields land as ``peritext_session_*`` gauges."""
+    counters = counters or GLOBAL_COUNTERS
+    histograms = histograms if histograms is not None else GLOBAL_HISTOGRAMS
+    lines = []
+    for name, value in sorted(counters.snapshot().items()):
+        m = _metric_name(name)
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {_fmt(value)}")
+    for name, hist in histograms.items():
+        m = _metric_name(name)
+        lines.append(f"# TYPE {m} histogram")
+        for bound, cum in hist.bucket_counts():
+            lines.append(f'{m}_bucket{{le="{bound:g}"}} {cum}')
+        lines.append(f'{m}_bucket{{le="+Inf"}} {hist.count}')
+        lines.append(f"{m}_sum {_fmt(hist.sum)}")
+        lines.append(f"{m}_count {hist.count}")
+    if sentinel is not None:
+        m = "peritext_recompiles_total"
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {sentinel.total}")
+    if session is not None:
+        health = session.health()
+        for key in sorted(health):
+            value = health[key]
+            if isinstance(value, bool):
+                value = int(value)
+            if isinstance(value, (int, float)):
+                m = _metric_name(f"session.{key}")
+                lines.append(f"# TYPE {m} gauge")
+                lines.append(f"{m} {_fmt(value)}")
+        quarantined = health.get("quarantined")
+        if isinstance(quarantined, dict):
+            m = _metric_name("session.quarantined_docs")
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {len(quarantined)}")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "peritext-obs"
+
+    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        routes: Dict[str, Tuple[Callable[[], str], str]] = self.server._routes  # type: ignore[attr-defined]
+        entry = routes.get(self.path.split("?", 1)[0])
+        if entry is None:
+            self.send_error(404)
+            return
+        fn, content_type = entry
+        try:
+            body = fn().encode("utf-8")
+        except Exception as exc:  # graftlint: boundary(an exporter endpoint answers 500, never kills the serving thread)
+            self.send_error(500, explain=str(exc))
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args) -> None:  # scrapes must not spam stderr
+        pass
+
+
+class MetricsServer:
+    """Threaded HTTP exporter for one host's telemetry (see module doc)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        counters: Optional[Counters] = None,
+        histograms: Optional[HistogramRegistry] = None,
+        session=None,
+        tracer=None,
+        recorder=None,
+        sentinel=None,
+    ) -> None:
+        def metrics() -> str:
+            return prometheus_text(
+                counters=counters, histograms=histograms,
+                session=session, sentinel=sentinel,
+            )
+
+        def snapshot() -> str:
+            return json.dumps(
+                health_snapshot(
+                    counters=counters, session=session, sentinel=sentinel,
+                    histograms=histograms, recorder=recorder,
+                ),
+                default=str,
+            )
+
+        routes: Dict[str, Tuple[Callable[[], str], str]] = {
+            "/metrics": (metrics, "text/plain; version=0.0.4; charset=utf-8"),
+            "/health.json": (snapshot, "application/json"),
+        }
+        if tracer is not None:
+            routes["/trace.json"] = (
+                lambda: json.dumps(tracer.chrome_trace()),
+                "application/json",
+            )
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd._routes = routes  # type: ignore[attr-defined]
+        self.address: Tuple[str, int] = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> Tuple[str, int]:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self.address
+
+    def stop(self) -> None:
+        if self._thread is None:
+            # never started: shutdown() would block forever waiting for a
+            # serve_forever() loop that doesn't exist — just release the port
+            self._httpd.server_close()
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+        self._thread = None

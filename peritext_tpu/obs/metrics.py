@@ -1,0 +1,98 @@
+"""Process-local counters and the composed fleet health snapshot."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Dict, Iterator, Optional
+
+
+class Counters:
+    """Thread-safe named counters and accumulated timings."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Dict[str, float] = defaultdict(float)
+
+    def add(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counts[name] += value
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._counts.get(name, 0.0)
+
+    @contextlib.contextmanager
+    def timed(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - start)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+#: Default process-wide counters.
+GLOBAL_COUNTERS = Counters()
+
+
+#: counter/histogram namespaces that make up the fault-domain health surface
+_HEALTH_PREFIXES = ("streaming.", "transport.", "supervisor.", "merge.", "jit.")
+
+
+def health_snapshot(
+    counters: Optional[Counters] = None,
+    session=None,
+    sentinel=None,
+    histograms=None,
+    recorder=None,
+) -> Dict[str, Any]:
+    """One structured dict for a fleet health endpoint: every fault-domain
+    counter (quarantines, corrupt frames, transport retries / behind peers,
+    supervisor rollbacks, guarded-merge fallbacks, per-jit-site compile
+    counts) and the fault-domain latency/size histogram percentiles, plus —
+    when a streaming session or its
+    :class:`~..parallel.supervisor.GuardedSession` is given — that session's
+    own ``health()`` (quarantine registry with typed reasons,
+    fallback/pending counts, rollback evidence, deadline-autotune state,
+    padding efficiency).  With a :class:`~.sentinel.RecompileSentinel`
+    attached, its per-site compile counts appear under ``recompiles`` (the
+    counter form lands under ``counters`` as ``jit.compiles.*`` either
+    way); with a :class:`~.recorder.FlightRecorder`, its ring/dump summary
+    appears under ``flight_recorder``.  Everything in the snapshot is
+    JSON-serializable (the exporter-schema golden test pins this)."""
+    from .histograms import GLOBAL_HISTOGRAMS
+
+    counters = counters or GLOBAL_COUNTERS
+    histograms = histograms if histograms is not None else GLOBAL_HISTOGRAMS
+    out: Dict[str, Any] = {
+        "counters": {
+            k: v
+            for k, v in sorted(counters.snapshot().items())
+            if k.startswith(_HEALTH_PREFIXES)
+        },
+        "histograms": {
+            name: snap
+            for name, snap in sorted(histograms.snapshot().items())
+            if name.startswith(_HEALTH_PREFIXES)
+        },
+    }
+    if session is not None:
+        out["session"] = session.health()
+    if sentinel is not None:
+        out["recompiles"] = {
+            "sites": dict(sorted(sentinel.counts.items())),
+            "total": sentinel.total,
+        }
+    if recorder is not None:
+        out["flight_recorder"] = recorder.snapshot()
+    return out

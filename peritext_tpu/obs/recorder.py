@@ -1,0 +1,138 @@
+"""Flight recorder: a bounded ring of recent spans+events per session,
+dumped as JSONL when something goes wrong.
+
+Chaos-soak failures used to be shrugs — a digest mismatch with no record of
+which round did what.  The recorder keeps the last ``capacity`` telemetry
+records (finished spans via :meth:`record_span` — wire it as a
+:class:`~.spans.Tracer` sink — plus structured fault events) and writes the
+whole ring to a JSONL file on :meth:`fault` (quarantine, rollback,
+transport give-up; throttled) or an explicit :meth:`dump`.  Each line is
+one JSON record; a ``kind: "dump"`` header line carries the reason, so a
+post-mortem starts from ``python -m peritext_tpu.obs summary <dump>``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Dict, List, Optional
+
+#: process-wide dump numbering: several recorders sharing one dump_dir
+#: (e.g. a crash-restored supervisor reusing <ckpt>/flight) must never
+#: mint colliding default filenames — an overwritten dump is exactly the
+#: post-mortem the recorder exists to preserve
+_DUMP_IDS = itertools.count(1)
+
+
+class FlightRecorder:
+    """Bounded telemetry ring with fault-triggered JSONL dumps.
+
+    ``dump_dir`` enables automatic dumps on :meth:`fault` (at most one per
+    ``min_dump_interval`` seconds — a burst of quarantines produces one
+    post-mortem, not a disk flood).  ``fsync=True`` fsyncs each dump before
+    returning: the flight-recorder path exists for crashes, and a dump that
+    dies in the page cache recorded nothing.
+    """
+
+    def __init__(self, capacity: int = 1024,
+                 dump_dir: Optional[str | Path] = None,
+                 fsync: bool = False,
+                 min_dump_interval: float = 1.0) -> None:
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.capacity = capacity
+        self.dump_dir = Path(dump_dir) if dump_dir is not None else None
+        self.fsync = bool(fsync)
+        self.min_dump_interval = float(min_dump_interval)
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._seq = 0
+        self._last_auto_dump: Optional[float] = None
+        self.faults = 0
+        self.dumps = 0
+        self.last_dump_path: Optional[Path] = None
+
+    # -- recording -----------------------------------------------------------
+
+    def record(self, kind: str, **fields) -> Dict:
+        """Append one structured record to the ring."""
+        with self._lock:
+            self._seq += 1
+            rec = {"seq": self._seq, "ts": time.time(), "kind": kind, **fields}
+            self._ring.append(rec)
+        return rec
+
+    def record_span(self, span) -> None:
+        """Tracer-sink form: ``tracer.add_sink(recorder.record_span)``."""
+        self.record("span", **span.to_json())
+
+    def fault(self, reason: str, **fields) -> Dict:
+        """Record a fault event and (when a ``dump_dir`` is configured)
+        dump the ring — the quarantine/rollback/transport-give-up hook."""
+        self.faults += 1
+        rec = self.record("fault", reason=reason, **fields)
+        if self.dump_dir is not None:
+            now = time.monotonic()
+            if (self._last_auto_dump is None
+                    or now - self._last_auto_dump >= self.min_dump_interval):
+                self._last_auto_dump = now
+                try:
+                    self.dump(reason=reason)
+                except OSError:
+                    # graftlint: boundary(a full/readonly disk must not turn a contained fault into a crash; the ring stays queryable in memory)
+                    pass
+        return rec
+
+    # -- dumping -------------------------------------------------------------
+
+    def entries(self) -> List[Dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def dump(self, path: Optional[str | Path] = None,
+             reason: Optional[str] = None) -> Path:
+        """Write the ring to ``path`` (default: a fresh
+        ``flight-<pid>-<n>-<reason>.jsonl`` under ``dump_dir``, where
+        ``<n>`` is process-unique so recorders sharing the directory never
+        overwrite each other's post-mortems) as JSONL; returns the path
+        written."""
+        entries = self.entries()
+        if path is None:
+            if self.dump_dir is None:
+                raise ValueError("no dump path given and no dump_dir configured")
+            self.dump_dir.mkdir(parents=True, exist_ok=True)
+            tag = (reason or "manual").replace("/", "_").replace(" ", "_")
+            path = self.dump_dir / (
+                f"flight-{os.getpid()}-{next(_DUMP_IDS):06d}-{tag}.jsonl"
+            )
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        header = {"kind": "dump", "ts": time.time(), "reason": reason,
+                  "records": len(entries), "capacity": self.capacity}
+        with open(path, "w") as f:
+            f.write(json.dumps(header, default=str) + "\n")
+            for rec in entries:
+                f.write(json.dumps(rec, default=str) + "\n")
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+        self.dumps += 1
+        self.last_dump_path = path
+        return path
+
+    def snapshot(self) -> Dict:
+        """Health-endpoint summary (JSON-serializable)."""
+        with self._lock:
+            size = len(self._ring)
+        return {
+            "capacity": self.capacity,
+            "size": size,
+            "faults": self.faults,
+            "dumps": self.dumps,
+            "last_dump": str(self.last_dump_path) if self.last_dump_path else None,
+        }

@@ -1,336 +1,57 @@
-"""Metrics, structured event logging, and profiling hooks.
-
-The reference's observability is ``console.log`` plus demo DOM panels (SURVEY
-§5.5); this module supplies the framework-grade replacements it calls for:
-
-* :class:`Counters` — process-local counters/timers for the north-star
-  metrics (ops applied per second per chip, convergence wall-clock, padding
-  efficiency of the static-shape batches).
-* :class:`EventLog` — structured, append-only JSON-lines event stream
-  (replaces the reference's DOM change log, ``outputDebugForChange``
-  src/bridge.ts:235-242); works as an ``Editor.on_event`` sink and a general
-  framework event bus.
-* :func:`profile_trace` — context manager around ``jax.profiler`` traces for
-  TensorBoard/Perfetto viewing; no-ops cleanly when profiling is unavailable
-  so library code can call it unconditionally.
-* :class:`MergeStats` — per-merge report: device vs fallback op counts,
-  stage wall-clocks, and padding efficiency (the fraction of padded device
-  work that was real), attached to ``DocBatch.merge`` results.
+"""Back-compat shim: the observability layer grew into the
+:mod:`peritext_tpu.obs` package (spans/tracing, histograms, flight
+recorder, exporters — see its docstring).  Every historical name re-exports
+from there, unchanged in identity (``GLOBAL_COUNTERS`` here IS
+``peritext_tpu.obs.GLOBAL_COUNTERS``), so existing imports keep working.
+New code should import from :mod:`peritext_tpu.obs` directly.
 """
 
 from __future__ import annotations
 
-import contextlib
-import json
-import logging
-import re
-import threading
-import time
-from collections import defaultdict
-from dataclasses import dataclass, field
-from pathlib import Path
-from typing import Any, Dict, IO, Iterator, Optional
+from .obs import (  # noqa: F401
+    Counters,
+    EventLog,
+    FlightRecorder,
+    GLOBAL_COUNTERS,
+    GLOBAL_HISTOGRAMS,
+    GLOBAL_TRACER,
+    Histogram,
+    HistogramRegistry,
+    LATENCY_BUCKETS_S,
+    MergeStats,
+    MetricsServer,
+    RecompileSentinel,
+    SIZE_BUCKETS,
+    Span,
+    TraceContext,
+    Tracer,
+    health_snapshot,
+    merge_traces,
+    profile_trace,
+    prometheus_text,
+)
+from .obs.metrics import _HEALTH_PREFIXES  # noqa: F401
+from .obs.sentinel import _COMPILE_MSG_RE  # noqa: F401
 
-
-class Counters:
-    """Thread-safe named counters and accumulated timings."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._counts: Dict[str, float] = defaultdict(float)
-
-    def add(self, name: str, value: float = 1.0) -> None:
-        with self._lock:
-            self._counts[name] += value
-
-    def get(self, name: str) -> float:
-        with self._lock:
-            return self._counts.get(name, 0.0)
-
-    @contextlib.contextmanager
-    def timed(self, name: str) -> Iterator[None]:
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.add(name, time.perf_counter() - start)
-
-    def snapshot(self) -> Dict[str, float]:
-        with self._lock:
-            return dict(self._counts)
-
-    def reset(self) -> None:
-        with self._lock:
-            self._counts.clear()
-
-
-#: Default process-wide counters.
-GLOBAL_COUNTERS = Counters()
-
-
-#: counter namespaces that make up the fault-domain health surface
-_HEALTH_PREFIXES = ("streaming.", "transport.", "supervisor.", "merge.", "jit.")
-
-
-def health_snapshot(
-    counters: Optional[Counters] = None, session=None, sentinel=None
-) -> Dict[str, Any]:
-    """One structured dict for a fleet health endpoint: every fault-domain
-    counter (quarantines, corrupt frames, transport retries / behind peers,
-    supervisor rollbacks, guarded-merge fallbacks, per-jit-site compile
-    counts), plus — when a streaming session or its
-    :class:`~.parallel.supervisor.GuardedSession` is given — that session's
-    own ``health()`` (quarantine registry with typed reasons,
-    fallback/pending counts, rollback evidence).  With a
-    :class:`RecompileSentinel` attached, its per-site compile counts appear
-    under ``recompiles`` (the counter form lands under ``counters`` as
-    ``jit.compiles.*`` either way)."""
-    counters = counters or GLOBAL_COUNTERS
-    out: Dict[str, Any] = {
-        "counters": {
-            k: v
-            for k, v in sorted(counters.snapshot().items())
-            if k.startswith(_HEALTH_PREFIXES)
-        },
-    }
-    if session is not None:
-        out["session"] = session.health()
-    if sentinel is not None:
-        out["recompiles"] = {
-            "sites": dict(sorted(sentinel.counts.items())),
-            "total": sentinel.total,
-        }
-    return out
-
-
-#: jax's log_compiles emission: "Compiling <site> with global shapes and
-#: types ..." (pxla) / "Compiling <site> for ..." (older dispatch paths)
-_COMPILE_MSG_RE = re.compile(r"^Compiling (\S+)")
-
-
-class RecompileSentinel(logging.Handler):
-    """Runtime guard for the compile-shape discipline (DESIGN.md "compile-
-    shape discipline", graftlint PTL004): counts XLA compilations **per jit
-    site** so steady-state streaming rounds can assert *zero* recompiles.
-
-    Backed by ``jax_log_compiles``: while active, jax logs one
-    ``Compiling <site> ...`` record per executable built, and this handler
-    (attached to the ``"jax"`` logger) tallies it — no private APIs, no
-    tracing overhead beyond the log call.  Counts land three ways:
-
-    * :attr:`counts` — ``{site: compiles}`` on the sentinel itself;
-    * ``jit.compiles.<site>`` / ``jit.compiles_total`` on the target
-      :class:`Counters` (default :data:`GLOBAL_COUNTERS`), which
-      :func:`health_snapshot` exports;
-    * ``health_snapshot(sentinel=s)`` embeds the per-site dict directly.
-
-    Use as a context manager; :meth:`mark` + :meth:`assert_steady_state`
-    express the invariant tests care about::
-
-        with RecompileSentinel() as s:
-            warmup_rounds(session)
-            s.mark()
-            steady_rounds(session)
-            s.assert_steady_state("steady-state streaming rounds")
-    """
-
-    def __init__(self, counters: Optional[Counters] = None, logger: str = "jax"):
-        super().__init__(level=logging.DEBUG)
-        self.counts: Dict[str, int] = {}
-        self._marked: Dict[str, int] = {}
-        self._counters = counters if counters is not None else GLOBAL_COUNTERS
-        self._logger = logging.getLogger(logger)
-        self._prev_log_compiles: Optional[bool] = None
-        self._active = False
-
-    # -- logging.Handler ------------------------------------------------------
-
-    def emit(self, record: logging.LogRecord) -> None:
-        try:
-            message = record.getMessage()
-        except Exception:  # graftlint: boundary(malformed foreign log records are ignored, never raised into the workload)
-            return
-        m = _COMPILE_MSG_RE.match(message)
-        if m is None:
-            return
-        site = m.group(1)
-        self.counts[site] = self.counts.get(site, 0) + 1
-        self._counters.add(f"jit.compiles.{site}")
-        self._counters.add("jit.compiles_total")
-
-    # -- lifecycle ------------------------------------------------------------
-
-    def start(self) -> "RecompileSentinel":
-        if self._active:
-            return self
-        import jax
-
-        self._prev_log_compiles = bool(jax.config.jax_log_compiles)
-        jax.config.update("jax_log_compiles", True)
-        self._logger.addHandler(self)
-        self._active = True
-        return self
-
-    def stop(self) -> None:
-        if not self._active:
-            return
-        self._logger.removeHandler(self)
-        try:
-            import jax
-
-            jax.config.update("jax_log_compiles", self._prev_log_compiles)
-        except Exception:  # graftlint: boundary(best-effort config restore on teardown; the counts already collected stay valid)
-            pass
-        self._active = False
-
-    def __enter__(self) -> "RecompileSentinel":
-        return self.start()
-
-    def __exit__(self, *exc_info) -> None:
-        self.stop()
-
-    # -- assertions -----------------------------------------------------------
-
-    @property
-    def total(self) -> int:
-        return sum(self.counts.values())
-
-    def mark(self) -> None:
-        """Snapshot the current counts; :meth:`since_mark` and
-        :meth:`assert_steady_state` measure growth from here."""
-        self._marked = dict(self.counts)
-
-    def since_mark(self) -> Dict[str, int]:
-        """Per-site compiles since :meth:`mark` (empty dict = steady state)."""
-        return {
-            site: n - self._marked.get(site, 0)
-            for site, n in sorted(self.counts.items())
-            if n > self._marked.get(site, 0)
-        }
-
-    def assert_steady_state(self, what: str = "steady-state rounds") -> None:
-        fresh = self.since_mark()
-        if fresh:
-            raise AssertionError(
-                f"{what} triggered {sum(fresh.values())} recompile(s): {fresh} "
-                "— a per-round shape escaped the padded-shape tables "
-                "(see DESIGN.md compile-shape discipline / graftlint PTL004)"
-            )
-
-
-class EventLog:
-    """Append-only structured event stream.
-
-    Events are plain dicts with a ``kind``; every record gets a monotonic
-    sequence number and a wall-clock timestamp.  Optionally tees each record
-    to a JSON-lines file.  Usable directly as an ``Editor.on_event`` sink.
-    """
-
-    def __init__(self, path: Optional[str | Path] = None, capacity: Optional[int] = 10000):
-        self._lock = threading.Lock()
-        self._events: list = []
-        self._seq = 0
-        self.capacity = capacity
-        self._file: Optional[IO[str]] = open(path, "a") if path is not None else None
-
-    def emit(self, kind: str, **fields: Any) -> Dict[str, Any]:
-        record = {"seq": None, "ts": time.time(), "kind": kind, **fields}
-        with self._lock:
-            self._seq += 1
-            record["seq"] = self._seq
-            self._events.append(record)
-            if self.capacity is not None and len(self._events) > self.capacity:
-                self._events = self._events[-self.capacity :]
-            if self._file is not None:
-                self._file.write(json.dumps(record, default=str) + "\n")
-                self._file.flush()
-        return record
-
-    # Editor.on_event sink (bridge.EditorEvent)
-    def __call__(self, editor_event) -> None:
-        self.emit(
-            f"editor.{editor_event.kind}", actor=editor_event.actor, **editor_event.detail
-        )
-
-    def events(self, kind: Optional[str] = None) -> list:
-        with self._lock:
-            evs = list(self._events)
-        return [e for e in evs if kind is None or e["kind"] == kind] if kind else evs
-
-    def close(self) -> None:
-        if self._file is not None:
-            self._file.close()
-            self._file = None
-
-
-@contextlib.contextmanager
-def profile_trace(log_dir: str | Path, enabled: bool = True) -> Iterator[None]:
-    """Capture a JAX profiler trace (viewable in TensorBoard / Perfetto) for
-    the enclosed block.  Silently degrades to a no-op if the profiler is
-    unavailable on the current platform."""
-    if not enabled:
-        yield
-        return
-    try:
-        import jax
-
-        jax.profiler.start_trace(str(log_dir))
-        started = True
-    except Exception:  # graftlint: boundary(profiler availability is platform-defined; tracing must never fail the traced workload)
-        started = False
-    try:
-        yield
-    finally:
-        if started:
-            try:
-                jax.profiler.stop_trace()
-            except Exception:  # graftlint: boundary(stop mirrors start: a torn trace is dropped, never raised into the workload)
-                pass
-
-
-@dataclass
-class MergeStats:
-    """Per-merge observability (attached to ``api.batch.MergeReport``)."""
-
-    docs: int = 0
-    device_docs: int = 0
-    fallback_docs: int = 0
-    device_ops: int = 0
-    fallback_ops: int = 0
-    encode_seconds: float = 0.0
-    apply_seconds: float = 0.0
-    resolve_seconds: float = 0.0
-    decode_seconds: float = 0.0
-    #: real ops / padded op-stream capacity across the batch (0..1)
-    padding_efficiency: float = 0.0
-    extras: Dict[str, float] = field(default_factory=dict)
-
-    @property
-    def total_seconds(self) -> float:
-        return (
-            self.encode_seconds
-            + self.apply_seconds
-            + self.resolve_seconds
-            + self.decode_seconds
-        )
-
-    @property
-    def device_ops_per_sec(self) -> float:
-        wall = self.apply_seconds
-        return self.device_ops / wall if wall > 0 else 0.0
-
-    def to_json(self) -> Dict[str, Any]:
-        return {
-            "docs": self.docs,
-            "device_docs": self.device_docs,
-            "fallback_docs": self.fallback_docs,
-            "device_ops": self.device_ops,
-            "fallback_ops": self.fallback_ops,
-            "encode_seconds": round(self.encode_seconds, 6),
-            "apply_seconds": round(self.apply_seconds, 6),
-            "resolve_seconds": round(self.resolve_seconds, 6),
-            "decode_seconds": round(self.decode_seconds, 6),
-            "padding_efficiency": round(self.padding_efficiency, 4),
-            "device_ops_per_sec": round(self.device_ops_per_sec, 1),
-            **self.extras,
-        }
+__all__ = [
+    "Counters",
+    "EventLog",
+    "FlightRecorder",
+    "GLOBAL_COUNTERS",
+    "GLOBAL_HISTOGRAMS",
+    "GLOBAL_TRACER",
+    "Histogram",
+    "HistogramRegistry",
+    "LATENCY_BUCKETS_S",
+    "MergeStats",
+    "MetricsServer",
+    "RecompileSentinel",
+    "SIZE_BUCKETS",
+    "Span",
+    "TraceContext",
+    "Tracer",
+    "health_snapshot",
+    "merge_traces",
+    "profile_trace",
+    "prometheus_text",
+]

@@ -18,6 +18,7 @@ from typing import Dict, List
 from ..core.doc import Doc
 from ..core.errors import PeritextError
 from ..core.types import Change, Clock, Patch
+from ..obs import GLOBAL_COUNTERS, GLOBAL_TRACER
 from .causal import causal_sort
 
 
@@ -79,9 +80,13 @@ def apply_changes(doc: Doc, changes: List[Change]) -> List[Patch]:
 def sync(left: Doc, right: Doc, store: ChangeStore) -> Dict[str, List[Patch]]:
     """Bidirectional anti-entropy between two replicas; returns patches each
     side produced."""
-    to_right = store.missing_changes(left.clock, right.clock)
-    to_left = store.missing_changes(right.clock, left.clock)
-    return {
-        "right": apply_changes(right, to_right),
-        "left": apply_changes(left, to_left),
-    }
+    with GLOBAL_TRACER.span("anti-entropy.local-sync"):
+        to_right = store.missing_changes(left.clock, right.clock)
+        to_left = store.missing_changes(right.clock, left.clock)
+        out = {
+            "right": apply_changes(right, to_right),
+            "left": apply_changes(left, to_left),
+        }
+    GLOBAL_COUNTERS.add("transport.local_syncs")
+    GLOBAL_COUNTERS.add("transport.local_sync_changes", len(to_right) + len(to_left))
+    return out

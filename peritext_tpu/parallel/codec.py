@@ -47,8 +47,21 @@ _VERSION = 2
 #: decodable only through a WireSession — the storage/ingest format stays
 #: self-contained v1/v2 (``WireSession.decode_frame`` returns normalized v2
 #: bytes for consumers that store or re-fan frames).
-_DECODABLE_VERSIONS = (1, 2, 3, 4)
+#: v5 is a TRACED v2: identical body, plus a fixed 16-byte trace-context
+#: field (trace id + parent span id, observability spans) between header
+#: and string table.  Like v3/v4 it is a TRANSPORT format — emission is
+#: version-negotiated (the anti-entropy frontier advertises ``WIRE_CAPS``,
+#: so an old peer is never sent one), and ingest/storage normalize to v2
+#: via :func:`strip_trace_context`.  The context is telemetry only: it
+#: never reaches merge state, and stripping it yields byte-identical v2.
+_DECODABLE_VERSIONS = (1, 2, 3, 4, 5)
 _SESSION_VERSIONS = (3, 4)
+_VERSION_TRACED = 5
+_TRACE_CTX = struct.Struct("<QQ")  # trace id, parent span id
+#: transport capability level advertised in anti-entropy frontiers: the
+#: highest wire version this codec decodes (>= _VERSION_TRACED means the
+#: peer may send trace-context frames)
+WIRE_CAPS = 5
 #: bounded inflate for v4: a legit frame body deflates ~2-4x, so cap the
 #: inflated size well above that but proportional to the wire bytes — a
 #: crafted bomb must not expand unboundedly.
@@ -627,10 +640,50 @@ def _normalize_decode_errors(on_fail: "Optional[Callable[[], None]]" = None):
         raise DecodeError(f"corrupt frame: {exc!r}") from exc
 
 
+def encode_frame_traced(changes: List[Change], trace_id: int,
+                        span_id: int) -> bytes:
+    """A v5 frame: :func:`encode_frame` output carrying a compact trace
+    context (observability spans, ``obs/spans.py``).  Send ONLY to a peer
+    whose frontier advertised ``caps >= WIRE_CAPS``."""
+    raw = encode_frame(changes)
+    magic, _, n_ch, n_str, n_ints, plen = _HEADER.unpack_from(raw)
+    return (
+        _HEADER.pack(magic, _VERSION_TRACED, n_ch, n_str, n_ints, plen)
+        + _TRACE_CTX.pack(int(trace_id) & 0xFFFFFFFFFFFFFFFF,
+                          int(span_id) & 0xFFFFFFFFFFFFFFFF)
+        + raw[_HEADER.size:]
+    )
+
+
+def strip_trace_context(data: bytes):
+    """``((trace_id, span_id) | None, self-contained v1/v2-style bytes)``.
+
+    Total function: anything that is not a well-formed v5 frame passes
+    through unchanged with a ``None`` context (downstream decode classifies
+    corruption as usual), so ingest paths can call it unconditionally —
+    the storage/ingest format stays v1/v2, the context is telemetry."""
+    if (len(data) < _HEADER.size + _TRACE_CTX.size
+            or data[:4] != _MAGIC or data[4] != _VERSION_TRACED):
+        return None, data
+    ctx = _TRACE_CTX.unpack_from(data, _HEADER.size)
+    magic, _, n_ch, n_str, n_ints, plen = _HEADER.unpack_from(data)
+    plain = (_HEADER.pack(magic, 2, n_ch, n_str, n_ints, plen)
+             + data[_HEADER.size + _TRACE_CTX.size:])
+    return ctx, plain
+
+
+def decode_frame_traced(data: bytes):
+    """``(changes, (trace_id, span_id) | None)`` — :func:`decode_frame`
+    plus the v5 trace context when the frame carries one."""
+    ctx, _ = strip_trace_context(data)
+    return decode_frame(data), ctx
+
+
 def decode_frame(data: bytes) -> List[Change]:
-    """Inverse of :func:`encode_frame`; raises :class:`DecodeError` (a
-    ValueError subclass, so pre-existing handlers keep working) on corrupt
-    frames.
+    """Inverse of :func:`encode_frame` (v5 traced frames decode too; the
+    context is ignored here — :func:`decode_frame_traced` surfaces it);
+    raises :class:`DecodeError` (a ValueError subclass, so pre-existing
+    handlers keep working) on corrupt frames.
 
     Returned ``Change.deps`` mappings must be treated as read-only: a run of
     changes with identical clocks (DEPS_SAME on the wire) shares one
@@ -857,6 +910,8 @@ def iter_frames(data: bytes):
         else:
             if version == 3:  # session base varint precedes the table
                 _, p = _read_varint(data, p)
+            elif version == _VERSION_TRACED:  # fixed trace-context field
+                p += _TRACE_CTX.size
             end = _walk_string_table(data, p, n_strings) + payload_len
         if end > len(data):
             raise DecodeError("truncated payload")
@@ -955,6 +1010,12 @@ def _frame_parts(data: bytes, start: int = 0, session_strings=None,
         raise ValueError("frame header counts exceed frame size")
 
     pos = start + _HEADER.size
+    if version == _VERSION_TRACED:
+        # traced v2: skip the fixed telemetry field, decode the v2 body
+        if len(data) - pos < _TRACE_CTX.size:
+            raise ValueError("truncated trace context")
+        pos += _TRACE_CTX.size
+        version = 2
     if version == 4:
         comp = data[pos : pos + payload_len]
         if len(comp) != payload_len:

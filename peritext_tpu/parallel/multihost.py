@@ -56,14 +56,17 @@ from typing import Callable, List, Optional, Tuple
 
 from ..core.errors import DecodeError, PeritextError, TransportError
 from ..core.types import Change, Clock
-from ..observability import GLOBAL_COUNTERS
+from ..obs import GLOBAL_COUNTERS, GLOBAL_HISTOGRAMS, GLOBAL_TRACER, TraceContext
 from .anti_entropy import ChangeStore
 from .codec import (
+    WIRE_CAPS,
     WireSession,
     decode_frame,
     encode_frame,
     encode_frame_chunks,
+    encode_frame_traced,
     iter_frames,
+    strip_trace_context,
 )
 
 _LEN = struct.Struct(">I")
@@ -160,16 +163,48 @@ def _recv_message(sock: socket.socket) -> Tuple[bytes, bytes]:
     return payload[:1], payload[1:]
 
 
-def _send_frontier(sock: socket.socket, clock: Clock) -> None:
-    _send_message(sock, MSG_FRONTIER, json.dumps(clock).encode("utf-8"))
+# Frontier metadata sentinels (observability, round 3 of the wire): the
+# frontier stays a ``{str: int}`` JSON map — exactly what every deployed
+# peer validates — and capability/trace metadata rides as keys that can
+# never collide with an actor id (actor ids are printable; these start with
+# NUL).  An OLD peer accepts them as unknown "actors" whose seqs it never
+# looks up (``missing_changes`` iterates only the SOURCE clock), so the
+# negotiation is invisible to it; a NEW peer strips them before any clock
+# math.  ``caps`` advertises the sender's max decodable wire version —
+# trace-context (v5) frames are sent only to a peer that advertised
+# ``caps >= WIRE_CAPS``, which is how old peers keep decoding everything.
+_META_CAPS = "\x00caps"
+_META_TRACE = "\x00trace"
+_META_SPAN = "\x00span"
+_META_KEYS = {_META_CAPS: "caps", _META_TRACE: "trace", _META_SPAN: "span"}
 
 
-def _parse_frontier(body: bytes) -> Clock:
+def _frontier_meta(tracer, span) -> dict:
+    """The metadata this endpoint attaches to an outbound frontier: always
+    its wire caps; plus the current span's trace context when tracing is
+    live, so the peer's handler span can join OUR trace."""
+    meta = {_META_CAPS: WIRE_CAPS}
+    if span is not None and tracer is not None and tracer.active():
+        meta[_META_TRACE] = int(span.trace_id)
+        meta[_META_SPAN] = int(span.span_id)
+    return meta
+
+
+def _send_frontier(sock: socket.socket, clock: Clock,
+                   meta: Optional[dict] = None) -> None:
+    payload = dict(clock)
+    if meta:
+        payload.update(meta)
+    _send_message(sock, MSG_FRONTIER, json.dumps(payload).encode("utf-8"))
+
+
+def _parse_frontier(body: bytes) -> Tuple[Clock, dict]:
     """Decode and validate a frontier message: must be ``{actor: seq}`` with
     string keys and int seqs — anything else is a protocol error, typed as
     :class:`DecodeError` (a ValueError) so both endpoints' error contracts
     stay uniform and ``try_sync_with`` can absorb a corrupt peer as a
-    ``behind`` outcome."""
+    ``behind`` outcome.  Returns ``(clock, meta)`` with the metadata
+    sentinels (caps / trace context) stripped out of the clock."""
     try:
         clock = json.loads(body)
     except json.JSONDecodeError as exc:
@@ -179,7 +214,19 @@ def _parse_frontier(body: bytes) -> Clock:
         for k, v in clock.items()
     ):
         raise DecodeError("bad frontier: expected {actor: seq}")
-    return clock
+    meta = {
+        name: clock.pop(key)
+        for key, name in _META_KEYS.items()
+        if key in clock
+    }
+    return clock, meta
+
+
+def _meta_ctx(meta: dict) -> Optional[TraceContext]:
+    """The peer's wire-carried trace context, when its frontier sent one."""
+    if "trace" in meta and "span" in meta:
+        return TraceContext(meta["trace"], meta["span"])
+    return None
 
 
 def _expect(sock: socket.socket, expected: bytes) -> bytes:
@@ -189,16 +236,26 @@ def _expect(sock: socket.socket, expected: bytes) -> bytes:
     return body
 
 
-def _send_changes(sock: socket.socket, changes: List[Change]) -> None:
+def _send_changes(sock: socket.socket, changes: List[Change],
+                  peer_caps: int = 0,
+                  ctx: Optional[TraceContext] = None) -> None:
     """One MSG_CHANGES frame when the backlog fits a single frame's decode
     budget (the overwhelmingly common case, wire-identical to old peers),
     else MSG_CHANGES_MULTI: session-scoped (v4) chunks sharing one string
     dictionary + deflate — the string table and repeated attrs are paid once
-    per backlog, not once per chunk."""
+    per backlog, not once per chunk.  With a trace context AND a peer that
+    advertised ``caps >= WIRE_CAPS``, the single frame rides wire v5 so the
+    receiver's pipeline spans join the sender's trace (large MULTI backlogs
+    fall back to untraced chunks — the frontier already carried the
+    context)."""
     from .codec import _ENCODE_CHUNK_CHARGE
 
     if sum(1 + len(c.deps or {}) for c in changes) <= _ENCODE_CHUNK_CHARGE:
-        _send_message(sock, MSG_CHANGES, encode_frame(changes))
+        if ctx is not None and peer_caps >= WIRE_CAPS:
+            frame = encode_frame_traced(changes, ctx.trace_id, ctx.span_id)
+        else:
+            frame = encode_frame(changes)
+        _send_message(sock, MSG_CHANGES, frame)
         return
     chunks = encode_frame_chunks(changes, session=WireSession(compress=True))
     _send_message(sock, MSG_CHANGES_MULTI, b"".join(chunks))
@@ -206,15 +263,22 @@ def _send_changes(sock: socket.socket, changes: List[Change]) -> None:
 
 def _recv_changes(
     sock: socket.socket, want_frames: bool = True,
-) -> Tuple[List[Change], List[bytes]]:
+) -> Tuple[List[Change], List[bytes], Optional[TraceContext]]:
     """Receive either changes kind; returns (changes, self-contained frames
     for ``on_frame`` consumers — MULTI chunks are normalized to v2 so a
-    consumer can store or re-ingest each frame independently).  Pass
-    ``want_frames=False`` when no on_frame consumer exists: normalization
-    is a full re-encode of the backlog, wasted on discarded output."""
+    consumer can store or re-ingest each frame independently, and a traced
+    v5 single frame is stripped the same way — plus the frame-carried trace
+    context when there was one).  Pass ``want_frames=False`` when no
+    on_frame consumer exists: normalization is a full re-encode of the
+    backlog, wasted on discarded output."""
     kind, body = _recv_message(sock)
     if kind == MSG_CHANGES:
-        return decode_frame(body), [body] if want_frames else []
+        ctx, plain = strip_trace_context(body)
+        return (
+            decode_frame(plain),
+            [plain] if want_frames else [],
+            TraceContext(*ctx) if ctx is not None else None,
+        )
     if kind == MSG_CHANGES_MULTI:
         sess = WireSession()
         changes: List[Change] = []
@@ -226,7 +290,7 @@ def _recv_changes(
             else:
                 part = sess.decode_frame(raw)
             changes.extend(part)
-        return changes, frames
+        return changes, frames, None
     raise ConnectionError(f"expected changes message, got {kind!r}")
 
 
@@ -269,6 +333,9 @@ class ReplicaServer:
         on_changes: Optional[Callable[[List[Change]], None]] = None,
         on_frame: Optional[Callable[[bytes], None]] = None,
         timeout: float = 30.0,
+        tracer=None,
+        recorder=None,
+        metrics_port: Optional[int] = None,
     ) -> None:
         """``on_changes`` receives each batch of newly-merged decoded
         changes; ``on_frame`` receives the RAW inbound frame bytes whenever
@@ -276,11 +343,21 @@ class ReplicaServer:
         session's ``ingest_frame`` (frames are duplicate-tolerant, so
         redelivered changes inside the frame are harmless).  ``timeout`` is
         the per-connection socket deadline: a peer that stalls mid-exchange
-        holds a handler thread for at most this long."""
+        holds a handler thread for at most this long.
+
+        Observability: ``tracer`` (default the process tracer) produces
+        anti-entropy spans that join a traced peer's trace via the
+        wire-carried context; ``recorder`` gets a ``fault`` record on
+        transport give-ups (``try_sync_with``); ``metrics_port`` (0 =
+        ephemeral) mounts an :class:`~..obs.MetricsServer` exposing
+        ``/metrics`` (Prometheus), ``/health.json`` and ``/trace.json`` —
+        its bound address is :attr:`metrics_address` after :meth:`start`."""
         self.store = store
         self.on_changes = on_changes
         self.on_frame = on_frame
         self.timeout = timeout
+        self.tracer = tracer if tracer is not None else GLOBAL_TRACER
+        self.recorder = recorder
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -289,10 +366,29 @@ class ReplicaServer:
         self.address: Tuple[str, int] = self._sock.getsockname()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self.metrics = None
+        self.metrics_address: Optional[Tuple[str, int]] = None
+        if metrics_port is not None:
+            from ..obs import MetricsServer
+
+            try:
+                self.metrics = MetricsServer(
+                    host=host, port=metrics_port,
+                    tracer=self.tracer, recorder=self.recorder,
+                )
+            except OSError:
+                # metrics port unavailable: release the already-bound
+                # replica socket too, or a caller's retry loop finds its
+                # replica port intermittently held by this dead instance
+                self._sock.close()
+                raise
+            self.metrics_address = self.metrics.address
 
     def start(self) -> Tuple[str, int]:
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
+        if self.metrics is not None:
+            self.metrics_address = self.metrics.start()
         return self.address
 
     def stop(self) -> None:
@@ -303,6 +399,8 @@ class ReplicaServer:
             pass
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self.metrics is not None:
+            self.metrics.stop()
 
     # internals
 
@@ -326,7 +424,7 @@ class ReplicaServer:
         return sync_with(
             self.store, host, port,
             on_changes=self.on_changes, timeout=timeout, lock=self._lock,
-            on_frame=self.on_frame, retry=retry,
+            on_frame=self.on_frame, retry=retry, tracer=self.tracer,
         )
 
     def try_sync_with(
@@ -337,35 +435,55 @@ class ReplicaServer:
         return try_sync_with(
             self.store, host, port,
             on_changes=self.on_changes, lock=self._lock,
-            on_frame=self.on_frame, retry=retry,
+            on_frame=self.on_frame, retry=retry, tracer=self.tracer,
+            recorder=self.recorder,
         )
 
     def _serve_one(self, conn: socket.socket) -> None:
         try:
             with conn:
                 conn.settimeout(self.timeout)
-                peer_clock = _parse_frontier(_expect(conn, MSG_FRONTIER))
-                with self._lock:
-                    my_clock = self.store.clock()
-                    outbound = self.store.missing_changes(my_clock, peer_clock)
-                # chunked: a large backlog splits into multiple frames so no
-                # single frame approaches the peer's decode dep budget
-                _send_changes(conn, outbound)
-                _send_frontier(conn, my_clock)
-                inbound, frames = _recv_changes(
-                    conn, want_frames=self.on_frame is not None
-                )
-                with self._lock:
-                    fresh = merge_changes(self.store, inbound)
-                if fresh:
-                    # on_frame first: consumers that ingest via on_frame and
-                    # account via on_changes must never observe the count
-                    # ahead of the ingestion
-                    if self.on_frame is not None:
-                        for one in frames:
-                            self.on_frame(one)
-                    if self.on_changes is not None:
-                        self.on_changes(fresh)
+                peer_clock, meta = _parse_frontier(_expect(conn, MSG_FRONTIER))
+                # the peer's frontier carried its trace context: this
+                # handler's span (and every child span it opens — ingest,
+                # merge) joins the PEER's trace, so a two-host exchange
+                # renders as one timeline in the merged Perfetto trace
+                with self.tracer.span(
+                    "anti-entropy.serve", ctx=_meta_ctx(meta),
+                ) as sp:
+                    with self._lock:
+                        my_clock = self.store.clock()
+                        outbound = self.store.missing_changes(my_clock, peer_clock)
+                    # chunked: a large backlog splits into multiple frames so
+                    # no single frame approaches the peer's decode dep budget
+                    _send_changes(
+                        conn, outbound, peer_caps=int(meta.get("caps", 0)),
+                        ctx=sp.context if self.tracer.active() else None,
+                    )
+                    _send_frontier(
+                        conn, my_clock, meta=_frontier_meta(self.tracer, sp)
+                    )
+                    # the frame-level ctx is redundant HERE: this handler
+                    # span already adopted the same context from the peer's
+                    # frontier, and the on_frame/on_changes delivery below
+                    # runs inside it (the client side of the exchange is
+                    # where the frame field is load-bearing — sync_with)
+                    inbound, frames, _ = _recv_changes(
+                        conn, want_frames=self.on_frame is not None
+                    )
+                    with self._lock:
+                        fresh = merge_changes(self.store, inbound)
+                    sp.args.update(pulled=len(fresh), pushed=len(outbound))
+                    if fresh:
+                        # on_frame first: consumers that ingest via on_frame
+                        # and account via on_changes must never observe the
+                        # count ahead of the ingestion
+                        if self.on_frame is not None:
+                            for one in frames:
+                                self.on_frame(one)
+                        if self.on_changes is not None:
+                            self.on_changes(fresh)
+                GLOBAL_HISTOGRAMS.observe("transport.serve_seconds", sp.duration)
         except (ConnectionError, ValueError, OSError, PeritextError):
             # a bad peer (bad framing, corrupt frame, malformed frontier, or a
             # change batch with log gaps) must not take the server down
@@ -383,28 +501,37 @@ def _sync_once(
     timeout: float,
     lock: threading.Lock,
     want_frames: bool,
-) -> Tuple[List[Change], int, List[bytes]]:
+    tracer,
+) -> Tuple[List[Change], int, List[bytes], Optional[TraceContext]]:
     """One attempt of the bidirectional exchange (see :func:`sync_with`).
     The store mutates only AFTER the socket closes cleanly, so a failed
     attempt is side-effect free and safe to retry.  Returns the freshly
-    merged changes, the pushed count, and the raw inbound frames —
-    on_frame/on_changes delivery happens in the CALLER, outside the retried
-    region: a callback failure after a successful merge is a local error,
-    and retrying it would skip the callbacks entirely (the reconnect pulls
-    only duplicates)."""
-    with socket.create_connection((host, port), timeout=timeout) as sock:
-        sock.settimeout(timeout)  # per-socket deadline on every send/recv
+    merged changes, the pushed count, the raw inbound frames, and the
+    peer's frame-carried trace context — on_frame/on_changes delivery
+    happens in the CALLER, outside the retried region: a callback failure
+    after a successful merge is a local error, and retrying it would skip
+    the callbacks entirely (the reconnect pulls only duplicates)."""
+    with tracer.span("anti-entropy.sync", peer=f"{host}:{port}") as sp:
+        with socket.create_connection((host, port), timeout=timeout) as sock:
+            sock.settimeout(timeout)  # per-socket deadline on every send/recv
+            with lock:
+                my_clock = store.clock()
+            # the frontier carries our caps + this span's trace context, so
+            # the peer's handler span joins THIS trace (cross-host spans)
+            _send_frontier(sock, my_clock, meta=_frontier_meta(tracer, sp))
+            inbound, frames, in_ctx = _recv_changes(sock, want_frames=want_frames)
+            peer_clock, meta = _parse_frontier(_expect(sock, MSG_FRONTIER))
+            with lock:
+                outbound = store.missing_changes(store.clock(), peer_clock)
+            _send_changes(
+                sock, outbound, peer_caps=int(meta.get("caps", 0)),
+                ctx=sp.context if tracer.active() else None,
+            )
         with lock:
-            my_clock = store.clock()
-        _send_frontier(sock, my_clock)
-        inbound, frames = _recv_changes(sock, want_frames=want_frames)
-        peer_clock = _parse_frontier(_expect(sock, MSG_FRONTIER))
-        with lock:
-            outbound = store.missing_changes(store.clock(), peer_clock)
-        _send_changes(sock, outbound)
-    with lock:
-        fresh = merge_changes(store, inbound)
-    return fresh, len(outbound), frames
+            fresh = merge_changes(store, inbound)
+        sp.args.update(pulled=len(fresh), pushed=len(outbound))
+    GLOBAL_HISTOGRAMS.observe("transport.sync_seconds", sp.duration)
+    return fresh, len(outbound), frames, in_ctx
 
 
 #: what a retry may absorb: connect/stall/teardown (OSError family, incl.
@@ -423,6 +550,7 @@ def sync_with(
     lock: Optional[threading.Lock] = None,
     on_frame: Optional[Callable[[bytes], None]] = None,
     retry: Optional[RetryPolicy] = None,
+    tracer=None,
 ) -> Tuple[int, int]:
     """One full bidirectional anti-entropy round against a peer.
 
@@ -444,6 +572,7 @@ def sync_with(
     """
     lock = lock or threading.Lock()
     policy = retry or NO_RETRY
+    tracer = tracer if tracer is not None else GLOBAL_TRACER
     deadline = timeout if timeout is not None else policy.timeout
     rng = random.Random()
     last: Optional[BaseException] = None
@@ -452,18 +581,27 @@ def sync_with(
             GLOBAL_COUNTERS.add("transport.retries")
             time.sleep(policy.delay(attempt - 1, rng))
         try:
-            fresh, pushed, frames = _sync_once(
-                store, host, port, deadline, lock, on_frame is not None
+            fresh, pushed, frames, in_ctx = _sync_once(
+                store, host, port, deadline, lock, on_frame is not None,
+                tracer,
             )
         except _RETRYABLE as exc:
             last = exc
             continue
         if fresh:
-            if on_frame is not None:  # before on_changes; see ReplicaServer
-                for one in frames:
-                    on_frame(one)
-            if on_changes is not None:
-                on_changes(fresh)
+            # delivery runs after the sync span closed (outside the retried
+            # region), so the peer's FRAME-carried context is what links the
+            # consumer's ingest spans into the exchange's trace — this is
+            # the client-side consumer of wire v5 (the serve side's ingest
+            # nests under its handler span, which adopted the frontier ctx)
+            with tracer.span(
+                "anti-entropy.deliver", ctx=in_ctx, pulled=len(fresh),
+            ):
+                if on_frame is not None:  # before on_changes; see ReplicaServer
+                    for one in frames:
+                        on_frame(one)
+                if on_changes is not None:
+                    on_changes(fresh)
         return len(fresh), pushed
     if isinstance(last, ValueError) and not isinstance(last, OSError):
         raise last  # protocol corruption: keep the typed DecodeError surface
@@ -481,6 +619,8 @@ def try_sync_with(
     lock: Optional[threading.Lock] = None,
     on_frame: Optional[Callable[[bytes], None]] = None,
     retry: Optional[RetryPolicy] = None,
+    tracer=None,
+    recorder=None,
 ) -> SyncOutcome:
     """Anti-entropy round that NEVER raises on transport failure: a peer
     that stays unreachable through the retry budget yields a ``behind``
@@ -517,10 +657,17 @@ def try_sync_with(
         pulled, pushed = sync_with(
             store, host, port, on_changes=_fenced(on_changes),
             lock=lock, on_frame=_fenced(on_frame), retry=policy,
+            tracer=tracer,
         )
     except _CallbackFailed as exc:
         raise exc.__cause__
     except (TransportError, DecodeError) as exc:
         GLOBAL_COUNTERS.add("transport.behind_peers")
+        if recorder is not None:
+            # transport give-up: the flight recorder turns "that peer was
+            # behind all soak" into a post-mortem with the attempts' spans
+            recorder.fault(
+                "transport-give-up", peer=f"{host}:{port}", error=str(exc)
+            )
         return SyncOutcome(ok=False, error=str(exc))
     return SyncOutcome(pulled=pulled, pushed=pushed)

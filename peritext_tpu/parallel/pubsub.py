@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from typing import Callable, Dict, Generic, TypeVar
 
+from ..obs import GLOBAL_COUNTERS, GLOBAL_TRACER
+
 T = TypeVar("T")
 
 
@@ -28,8 +30,10 @@ class Publisher(Generic[T]):
         del self._subscribers[key]
 
     def publish(self, sender: str, update: T) -> None:
-        # deterministic fan-out order: subscription (arrival) order is
-        # replica-local history and must not drive delivery (PTL001)
-        for key, callback in sorted(self._subscribers.items()):
-            if key != sender:
-                callback(update)
+        with GLOBAL_TRACER.span("pubsub.publish", sender=sender):
+            # deterministic fan-out order: subscription (arrival) order is
+            # replica-local history and must not drive delivery (PTL001)
+            for key, callback in sorted(self._subscribers.items()):
+                if key != sender:
+                    callback(update)
+        GLOBAL_COUNTERS.add("transport.pubsub_published")

@@ -49,7 +49,14 @@ from .. import native
 from ..core.doc import Doc
 from ..core.errors import DecodeError
 from ..core.types import Change, Clock, FormatSpan
-from ..observability import GLOBAL_COUNTERS
+from ..obs import (
+    GLOBAL_COUNTERS,
+    GLOBAL_HISTOGRAMS,
+    GLOBAL_TRACER,
+    MergeStats,
+    SIZE_BUCKETS,
+    TraceContext,
+)
 from ..ops.decode import decode_doc_spans
 from ..ops.encode import DocEncoder, _DocStreams
 from ..ops.encode import MAP_STREAM_COLS, MARK_COLS
@@ -69,7 +76,7 @@ from ..ops.packed import PackedDocs, empty_docs
 from ..ops.resolve import resolve, resolve_jit
 from ..utils.interning import Interner, OrderedActorTable
 from .causal import causal_schedule
-from .codec import decode_frame, encode_frame
+from .codec import decode_frame, encode_frame, strip_trace_context
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .mesh import convergence_digest, shard_docs
@@ -468,10 +475,25 @@ class StreamingMerge:
         map_capacity: int = 32,
         read_chunk: int = 8192,
         mesh=None,
+        tracer=None,
     ) -> None:
         self.num_docs = num_docs
         self.actors = list(actors)
         self.mesh = mesh
+        #: pipeline-span producer (obs/spans.py).  Spans always measure, so
+        #: per-round MergeStats work even with tracing off; they are only
+        #: retained when the tracer is enabled or has sinks (e.g. the
+        #: supervisor's flight recorder).
+        self.tracer = tracer if tracer is not None else GLOBAL_TRACER
+        #: optional FlightRecorder: quarantines land as fault records (and
+        #: trigger its auto-dump) — the supervisor attaches one
+        self.recorder = None
+        #: MergeStats of the most recent committed round batch
+        self.last_round_stats: Optional[MergeStats] = None
+        # cumulative padded-stream accounting behind health()'s
+        # padding-efficiency readout
+        self._pad_real_ops = 0
+        self._pad_capacity = 0
         self.round_caps = (round_insert_capacity, round_delete_capacity,
                            round_mark_capacity, round_map_capacity)
         self.comment_capacity = comment_capacity
@@ -628,6 +650,21 @@ class StreamingMerge:
         if on_corrupt not in ("raise", "quarantine"):
             raise ValueError(f"unknown on_corrupt mode: {on_corrupt!r}")
         items = list(items)
+        # Traced (v5) transport frames normalize to the self-contained v2
+        # storage form here — durable history and the native parser only
+        # ever see v1/v2 — and the wire-carried context links this host's
+        # ingest span into the SENDING host's trace.
+        ctx: Optional[TraceContext] = None
+        for j, (d, data) in enumerate(items):
+            c, plain = strip_trace_context(data)
+            if c is not None:
+                items[j] = (d, plain)
+                if ctx is None:
+                    ctx = TraceContext(*c)
+        with self.tracer.span("streaming.ingest", ctx=ctx, frames=len(items)):
+            self._ingest_items(items, on_corrupt)
+
+    def _ingest_items(self, items: List, on_corrupt: str) -> None:
         fast: List = []
         corrupt: List[int] = []
         use_native = native.available()
@@ -789,6 +826,13 @@ class StreamingMerge:
                 reason=reason, detail=detail, round=self.rounds
             )
             GLOBAL_COUNTERS.add("streaming.quarantined_docs")
+            if self.recorder is not None:
+                # flight recorder: the quarantine becomes a post-mortem —
+                # fault() auto-dumps the recent span/event ring as JSONL
+                self.recorder.fault(
+                    "quarantine", doc=doc_index, quarantine_reason=reason,
+                    detail=detail, round=self.rounds,
+                )
         elif rec.reason == REASON_DECODE and reason != REASON_DECODE:
             self._quarantine[doc_index] = QuarantineRecord(
                 reason=reason, detail=detail, round=self.rounds
@@ -863,13 +907,25 @@ class StreamingMerge:
 
     def health(self) -> Dict:
         """One structured snapshot of the session's fault-domain state —
-        what a fleet health endpoint would export per session."""
+        what a fleet health endpoint would export per session.  Includes
+        the padding-efficiency readout of the LAST committed round batch
+        and the session-cumulative ratio (real ops / padded stream
+        capacity), so a fleet scrape can spot a session whose round widths
+        are mis-sized for its workload."""
+        last = self.last_round_stats
         return {
             "rounds": self.rounds,
             "num_docs": self.num_docs,
             "pending_changes": self.pending_count(),
             "fallback_docs": sum(1 for s in self.docs if s.fallback),
             "frame_docs": int(self._frame_mode.sum()),
+            "round_padding_efficiency": (
+                round(last.padding_efficiency, 4) if last is not None else None
+            ),
+            "padding_efficiency_cum": (
+                round(self._pad_real_ops / self._pad_capacity, 4)
+                if self._pad_capacity else None
+            ),
             "quarantined": {
                 d: {"reason": r.reason, "detail": r.detail, "round": r.round}
                 for d, r in sorted(self.quarantined().items())
@@ -912,11 +968,49 @@ class StreamingMerge:
         dispatched asynchronously; the caller may immediately ingest and
         schedule the next round while the TPU runs this one.
         """
-        enc, widths, scheduled = self._schedule_round()
-        if scheduled:
-            self._commit_rounds([(enc, widths)])
+        with self.tracer.span("streaming.round") as rsp:
+            with self.tracer.span("streaming.schedule") as ssp:
+                enc, widths, scheduled = self._schedule_round()
+            if scheduled:
+                with self.tracer.span("streaming.apply", rounds=1) as asp:
+                    self._commit_rounds([(enc, widths)])
+                self._emit_round_stats(
+                    [(enc, widths)], scheduled, ssp.duration, asp.duration
+                )
+            rsp.args["scheduled"] = scheduled
         self._sweep_decode_quarantine()
         return scheduled
+
+    def _emit_round_stats(self, batch, scheduled: int,
+                          schedule_s: float, apply_s: float) -> None:
+        """Per-commit MergeStats + histograms: the streaming path's analog
+        of ``DocBatch.merge``'s report — the slowest bench row is no longer
+        the least instrumented.  ``apply_seconds`` is host DISPATCH wall
+        (device work is async; reads are the sync points), which is exactly
+        the quantity the per-dispatch-floor analysis needs."""
+        touched: set = set()
+        real = 0
+        capacity = 0
+        for enc, widths in batch:
+            touched.update(int(r) for r in np.nonzero(enc.num_ops)[0])
+            real += int(enc.num_ops.sum())
+            capacity += self._padded_docs * sum(widths)
+        stats = MergeStats(
+            docs=len(touched),
+            device_docs=len(touched),
+            device_ops=real,
+            encode_seconds=schedule_s,
+            apply_seconds=apply_s,
+            padding_efficiency=real / capacity if capacity else 0.0,
+            extras={"rounds": len(batch), "scheduled_changes": scheduled},
+        )
+        self.last_round_stats = stats
+        self._pad_real_ops += real
+        self._pad_capacity += capacity
+        GLOBAL_HISTOGRAMS.observe("streaming.round_seconds", schedule_s + apply_s)
+        GLOBAL_HISTOGRAMS.observe(
+            "streaming.round_scheduled_changes", scheduled, buckets=SIZE_BUCKETS
+        )
 
     def _schedule_round(self):
         """The HOST half of a round: causal admission into staging buffers
@@ -1434,15 +1528,22 @@ class StreamingMerge:
         rounds = 0
         while rounds < max_rounds:
             batch = []
-            while (len(batch) < self.FUSE_MAX_ROUNDS
-                   and rounds + len(batch) < max_rounds):
-                enc, widths, scheduled = self._schedule_round()
-                if not scheduled:
-                    break
-                batch.append((enc, widths))
+            scheduled_total = 0
+            with self.tracer.span("streaming.schedule") as ssp:
+                while (len(batch) < self.FUSE_MAX_ROUNDS
+                       and rounds + len(batch) < max_rounds):
+                    enc, widths, scheduled = self._schedule_round()
+                    if not scheduled:
+                        break
+                    batch.append((enc, widths))
+                    scheduled_total += scheduled
             if not batch:
                 break
-            self._commit_rounds(batch)
+            with self.tracer.span("streaming.apply", rounds=len(batch)) as asp:
+                self._commit_rounds(batch)
+            self._emit_round_stats(
+                batch, scheduled_total, ssp.duration, asp.duration
+            )
             rounds += len(batch)
         self._sweep_decode_quarantine()
         return rounds
@@ -1557,10 +1658,11 @@ class StreamingMerge:
             return entry
         lo, hi = self._block_bounds(block_index)
         on_device = self._block_fallback_mask(block_index)
-        resolved, digest_dev = _resolve_block_digest_jit(
-            self._state_block(block_index), self.comment_capacity,
-            jnp.asarray(on_device), *self._digest_tables(lo, hi),
-        )
+        with self.tracer.span("streaming.resolve", block=block_index):
+            resolved, digest_dev = _resolve_block_digest_jit(
+                self._state_block(block_index), self.comment_capacity,
+                jnp.asarray(on_device), *self._digest_tables(lo, hi),
+            )
         entry = _BlockResolution(resolved, digest_dev, on_device)
         if len(cache) >= 2:  # bound host/device memory at large scale
             cache.pop(next(iter(cache)))  # least-recently-used
@@ -1852,6 +1954,10 @@ class StreamingMerge:
         pass per block (ops/decode.decode_block_spans_compact — Python
         touches only mark-run segments, the device link only visible-prefix
         planes), fallback/overflow docs replay."""
+        with self.tracer.span("streaming.decode", docs=self.num_docs):
+            return self._read_all()
+
+    def _read_all(self) -> List[List[FormatSpan]]:
         from ..ops.decode import decode_block_spans_compact
 
         out: List[Optional[List[FormatSpan]]] = [None] * self.num_docs
@@ -1879,6 +1985,10 @@ class StreamingMerge:
         whole-session sweep (the per-doc ``read_patches`` stays for point
         reads).  Shares the per-block compact transfer with read_all via
         the (round, epoch) cache."""
+        with self.tracer.span("streaming.patch-scatter", docs=self.num_docs):
+            return self._read_patches_all()
+
+    def _read_patches_all(self) -> List[List]:
         from ..ops.decode import block_char_states_compact
         from ..ops.patches import diff_patches, doc_chars_scalar
 
@@ -2145,6 +2255,10 @@ class StreamingMerge:
         per-round cost proportional to touched docs.  ``refresh=True`` is
         the verification path: every row re-hashes from current device
         state, ignoring (and rebuilding) the carried plane."""
+        with self.tracer.span("streaming.digest", full=full, refresh=refresh):
+            return self._digest(full, refresh)
+
+    def _digest(self, full: bool, refresh: bool) -> int:
         from .mesh import doc_digest_host
 
         if refresh:

@@ -38,7 +38,15 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 import numpy as np
 
 from ..core.errors import DeviceRoundError
-from ..observability import GLOBAL_COUNTERS
+from ..obs import (
+    FlightRecorder,
+    GLOBAL_COUNTERS,
+    GLOBAL_HISTOGRAMS,
+    Histogram,
+    Tracer,
+    ambient_parent,
+    current_span,
+)
 from .streaming import REASON_DEVICE_ROUND, StreamingMerge
 
 
@@ -50,10 +58,27 @@ class GuardedSession:
     flow through the supervisor so its journal stays complete; reads (and
     any other method) pass through to ``self.session``.
 
-    ``deadline`` is the per-round wall-clock watchdog in seconds;
+    ``deadline`` is the per-round wall-clock watchdog in seconds —
+    AUTOTUNED by default (ROADMAP "supervisor deadline autotuning"): the
+    effective deadline is ``clamp(margin * rolling_p{quantile}(round
+    latency), floor, ceiling)`` over the last ``deadline_window`` rounds,
+    so slow-compile first rounds no longer force a generous global
+    constant.  ``deadline`` doubles as the ceiling (and, /4, the floor)
+    when no explicit bound is given; the first ``deadline_warmup``
+    successful rounds are EXEMPT — they run against the ceiling and their
+    (compile-dominated) latencies never enter the window.  Rollback drains
+    always run against the ceiling: a restore replays and may recompile.
+    Set ``autotune=False`` for the pre-round-7 static behavior.
+
     ``checkpoint_every`` counts successful guarded rounds between automatic
     checkpoints (the rollback replay window is at most that many rounds of
     journal).
+
+    Observability: the supervisor owns a :class:`~..obs.Tracer` (unless
+    given one) and a :class:`~..obs.FlightRecorder` ring dumping JSONL
+    under ``<checkpoint_root>/flight`` on quarantine and rollback; both are
+    attached to the supervised session (and re-attached across restores)
+    so round/stage spans land in the ring.
     """
 
     def __init__(
@@ -64,15 +89,40 @@ class GuardedSession:
         checkpoint_every: int = 8,
         keep: int = 3,
         mesh=None,
+        tracer=None,
+        recorder=None,
+        autotune: bool = True,
+        deadline_floor: Optional[float] = None,
+        deadline_ceiling: Optional[float] = None,
+        deadline_quantile: float = 0.99,
+        deadline_margin: float = 6.0,
+        deadline_window: int = 64,
+        deadline_warmup: int = 1,
     ) -> None:
         from ..checkpoint import CheckpointManager
 
         self._factory = factory
-        self.session = factory()
+        self.tracer = tracer if tracer is not None else Tracer()
+        self.recorder = recorder if recorder is not None else FlightRecorder(
+            capacity=1024, dump_dir=Path(checkpoint_root) / "flight"
+        )
+        self.tracer.add_sink(self.recorder.record_span)
         self.manager = CheckpointManager(checkpoint_root, keep=keep)
         self.deadline = deadline
+        self.autotune = autotune
+        self._deadline_floor = deadline_floor
+        self._deadline_ceiling = deadline_ceiling
+        self.deadline_quantile = deadline_quantile
+        self.deadline_margin = deadline_margin
+        self.deadline_warmup = deadline_warmup
+        #: rolling round-latency window (successful guarded rounds, warmup
+        #: exempt) — the percentile source for the effective deadline
+        self.round_latency = Histogram(window=deadline_window)
+        self._rounds_total = 0
         self.checkpoint_every = checkpoint_every
         self.mesh = mesh
+        self.session = factory()
+        self._attach_session(self.session)
         #: everything ingested since the last checkpoint, in order — the
         #: rollback replay source (duplicate-tolerant, so overlap with the
         #: checkpoint's own frame histories is safe).  Entries are
@@ -89,6 +139,56 @@ class GuardedSession:
         #: one-shot fault injection queues (chaos harness / tests)
         self._inject_failures: List[Exception] = []
         self._inject_delays: List[float] = []
+
+    # -- deadline autotuning -------------------------------------------------
+
+    @property
+    def deadline_floor(self) -> float:
+        """Autotune lower clamp (explicit, else ``deadline / 4`` — a tuned
+        deadline may tighten, but never below a quarter of the configured
+        budget, so a mid-session compile burst cannot trip the watchdog)."""
+        return (self._deadline_floor if self._deadline_floor is not None
+                else self.deadline / 4)
+
+    @property
+    def deadline_ceiling(self) -> float:
+        """Autotune upper clamp (explicit, else the configured ``deadline``
+        — mutating ``self.deadline`` keeps working as the static control)."""
+        return (self._deadline_ceiling if self._deadline_ceiling is not None
+                else self.deadline)
+
+    def effective_deadline(self) -> float:
+        """The watchdog deadline the NEXT guarded round runs against:
+        ``clamp(margin * rolling-percentile, floor, ceiling)`` once the
+        warmup-exempt window has data, the ceiling before (first-round
+        compiles run against the full budget) and with ``autotune=False``."""
+        if not self.autotune or self.round_latency.count == 0:
+            return float(self.deadline_ceiling)
+        tuned = self.round_latency.percentile(self.deadline_quantile)
+        tuned *= self.deadline_margin
+        return float(min(self.deadline_ceiling, max(self.deadline_floor, tuned)))
+
+    # -- session attachment --------------------------------------------------
+
+    def _attach_session(self, session) -> None:
+        """Point the session's telemetry at the supervisor's tracer and
+        flight recorder (round/stage spans land in the dump ring; a
+        quarantine inside the session triggers the recorder's auto-dump)."""
+        session.tracer = self.tracer
+        session.recorder = self.recorder
+
+    def adopt_session(self, session) -> None:
+        """Install an externally-restored session (crash-restore path) with
+        the telemetry attachment a factory-built session would get."""
+        self.session = session
+        self._attach_session(session)
+
+    def close(self) -> None:
+        """Detach this supervisor's flight-recorder sink from the tracer.
+        Matters when the tracer is SHARED (caller-supplied, outliving the
+        supervisor): without the detach, every future span keeps feeding
+        this dead supervisor's recorder ring forever.  Idempotent."""
+        self.tracer.remove_sink(self.recorder.record_span)
 
     # -- ingest (journalled) ------------------------------------------------
 
@@ -148,24 +248,31 @@ class GuardedSession:
             np.asarray(session.state.num_slots)
         return scheduled
 
-    def _run_guarded(self, fn: Callable[[], int]) -> int:
+    def _run_guarded(self, fn: Callable[[], int],
+                     deadline: Optional[float] = None) -> int:
+        deadline = self.effective_deadline() if deadline is None else deadline
         box: Dict[str, object] = {}
+        # the round body runs on the watchdog thread; carry the caller's
+        # open span (supervisor.round) across so the session's stage spans
+        # nest under it in the timeline instead of rooting parentless
+        parent = current_span()
 
         def run() -> None:
             try:
-                box["value"] = fn()
+                with ambient_parent(parent):
+                    box["value"] = fn()
             except BaseException as exc:  # noqa: BLE001 - re-raised below
                 box["error"] = exc
 
         worker = threading.Thread(target=run, daemon=True)
         worker.start()
-        worker.join(self.deadline)
+        worker.join(deadline)
         if worker.is_alive():
             # the dispatch is wedged; abandon it (state is rebuilt from the
             # checkpoint — the stuck thread can no longer corrupt anything
             # the supervisor will use)
             raise DeviceRoundError(
-                f"device round exceeded its {self.deadline}s deadline"
+                f"device round exceeded its {deadline:.4g}s deadline"
             )
         if "error" in box:
             exc = box["error"]
@@ -178,13 +285,31 @@ class GuardedSession:
         """One guarded device round.  Returns the changes scheduled, or 0
         when the round was rolled back (the work is not lost: it recovered
         on device during rollback, or was demoted to scalar replay)."""
+        sp = None
         try:
             if self._inject_failures:
                 raise self._inject_failures.pop(0)
-            scheduled = self._run_guarded(self._round)
+            with self.tracer.span(
+                "supervisor.round",
+                deadline=round(self.effective_deadline(), 4),
+            ) as sp:
+                scheduled = self._run_guarded(self._round)
         except Exception as exc:  # graftlint: boundary(degradation ladder root: ANY round failure rolls back to the last good checkpoint)
+            if sp is not None:
+                # failed/deadline-hit rounds are the worst case the exported
+                # histogram exists to show — they must land too (the span's
+                # duration is set before the exception propagates)
+                GLOBAL_HISTOGRAMS.observe("supervisor.round_seconds", sp.duration)
             self._rollback(exc)
             return 0
+        self._rounds_total += 1
+        # the exported histogram sees EVERY round — an operator sizing the
+        # static ceiling needs the true worst case, compile rounds included
+        GLOBAL_HISTOGRAMS.observe("supervisor.round_seconds", sp.duration)
+        if self._rounds_total > self.deadline_warmup:
+            # warmup exemption: the first round(s) are compile-dominated and
+            # must not seed the rolling percentile the deadline derives from
+            self.round_latency.observe(sp.duration)
         self._rounds_since_checkpoint += 1
         if self._rounds_since_checkpoint >= self.checkpoint_every:
             try:
@@ -245,15 +370,23 @@ class GuardedSession:
             restored.ingest(d, list(payload))
         if run:
             restored.ingest_frames(run, on_corrupt="quarantine")
+        self._attach_session(restored)
         return restored
 
     def _rollback(self, error: BaseException) -> None:
-        """Degradation ladder steps 2-4 (see module docstring)."""
+        """Degradation ladder steps 2-4 (see module docstring).  Rollback
+        drains run against the deadline CEILING, not the tuned value — a
+        restore replays the journal and may recompile, exactly the slow
+        path the warmup exemption exists for."""
         self.rollbacks += 1
         GLOBAL_COUNTERS.add("supervisor.rollbacks")
+        self.recorder.fault(
+            "rollback", error=repr(error), rollbacks=self.rollbacks,
+            journal_frames=len(self._journal),
+        )
         self.session = self._restore_base()
         try:
-            self._run_guarded(self._drain_device)
+            self._run_guarded(self._drain_device, deadline=self.deadline_ceiling)
         except Exception as exc:  # graftlint: boundary(second-strike containment: a still-sick device path falls back to scalar replay)
             # the device path is still sick: rebuild once more from durable
             # state (a deadline here may have left a zombie thread draining
@@ -302,12 +435,22 @@ class GuardedSession:
         )
 
     def health(self) -> Dict:
-        """Session health plus the supervisor's own fault evidence."""
+        """Session health plus the supervisor's own fault evidence and the
+        deadline-autotune state (the effective value, its clamps, and the
+        rolling round-latency percentiles it derives from)."""
         out = self.session.health()
         out.update(
             rollbacks=self.rollbacks,
             checkpoints=self.checkpoints,
             journal_frames=len(self._journal),
-            deadline_seconds=self.deadline,
+            deadline_seconds=self.effective_deadline(),
+            deadline_static=self.deadline,
+            deadline_floor=self.deadline_floor,
+            deadline_ceiling=self.deadline_ceiling,
+            deadline_autotuned=bool(
+                self.autotune and self.round_latency.count > 0
+            ),
+            round_latency=self.round_latency.snapshot(),
+            flight_recorder=self.recorder.snapshot(),
         )
         return out

@@ -30,10 +30,12 @@ pending.  Any unhandled exception fails the campaign.
 
 from __future__ import annotations
 
+import json
 import random
 import socket
 import tempfile
 from dataclasses import asdict, dataclass
+from pathlib import Path
 from typing import Dict, List
 
 from ..api.batch import _oracle_doc
@@ -68,6 +70,9 @@ class ChaosReport:
     isolation_checked: bool = False
     scalar_degraded_docs: int = 0
     final_digest: int = 0
+    #: flight-recorder JSONL dumps the campaign's faults produced (the
+    #: quarantine/rollback auto-dumps plus the campaign-end post-mortem)
+    flight_dumps: int = 0
 
     def to_json(self) -> Dict:
         return asdict(self)
@@ -164,10 +169,19 @@ def run_chaos(
     # the supervised chaos session
     tmp = tempfile.TemporaryDirectory()
     try:
+        from ..obs import FlightRecorder
+
         factory = lambda: _campaign_session(num_docs, ops_per_doc)  # noqa: E731
+        # unthrottled flight recorder: every fault dumps, so the campaign's
+        # post-mortem oracle below can demand the quarantine evidence even
+        # across the crash-restore (which discards the in-memory ring)
+        recorder = lambda: FlightRecorder(  # noqa: E731
+            capacity=1024, dump_dir=Path(tmp.name) / "flight",
+            min_dump_interval=0.0,
+        )
         guarded = GuardedSession(
             factory, tmp.name, deadline=deadline,
-            checkpoint_every=checkpoint_every,
+            checkpoint_every=checkpoint_every, recorder=recorder(),
         )
         victims = set(rng.sample(range(num_docs),
                                  max(1, num_docs // 3)))
@@ -256,11 +270,11 @@ def run_chaos(
             del guarded  # crash: the process state is gone
             guarded = GuardedSession(
                 factory, tmp.name, deadline=deadline,
-                checkpoint_every=checkpoint_every,
+                checkpoint_every=checkpoint_every, recorder=recorder(),
             )
             restored = guarded.manager.latest()
             assert restored is not None
-            guarded.session = restored.session(drain=True)
+            guarded.adopt_session(restored.session(drain=True))
             guarded.rollbacks = old_rollbacks
             report.crash_restores += 1
 
@@ -293,6 +307,35 @@ def run_chaos(
             assert got == expected, (
                 f"seed={seed} doc={d}: spans diverge from oracle after repair"
             )
+
+        # -- flight-recorder oracle ----------------------------------------
+        # a campaign that quarantined anything must have produced at least
+        # one automatic JSONL dump whose records parse and include the fault
+        flight_dir = Path(tmp.name) / "flight"
+        auto_dumps = sorted(flight_dir.glob("*.jsonl"))
+        final_dump = guarded.recorder.dump(reason="campaign-end")
+        records = []
+        for dump in auto_dumps + [final_dump]:
+            records.extend(
+                json.loads(line)
+                for line in dump.read_text().splitlines() if line
+            )
+        if report.corrupt_frames:
+            assert auto_dumps, (
+                f"seed={seed}: quarantine produced no flight-recorder dump"
+            )
+            assert any(
+                r.get("kind") == "fault" and r.get("reason") == "quarantine"
+                for r in records
+            ), f"seed={seed}: flight dumps lack the quarantine fault record"
+        # campaign-end post-mortem: the ring's spans must reconstruct the
+        # recent rounds' stage timeline (guarded rounds + pipeline stages)
+        span_names = {r["name"] for r in records if r.get("kind") == "span"}
+        assert any(n.startswith("streaming.") for n in span_names) and (
+            "supervisor.round" in span_names
+        ), f"seed={seed}: flight dump spans missing the round stage timeline"
+        report.flight_dumps = len(auto_dumps) + 1
+        guarded.close()
     finally:
         tmp.cleanup()
     return report

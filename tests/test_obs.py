@@ -1,0 +1,410 @@
+"""Telemetry subsystem unit tests (ISSUE 3): tracer spans, histograms,
+flight recorder, EventLog hygiene, exporters, the health-snapshot golden
+shape, and the ``python -m peritext_tpu.obs`` renderer."""
+
+import builtins
+import json
+import urllib.request
+
+import pytest
+
+from peritext_tpu.obs import (
+    EventLog,
+    FlightRecorder,
+    GLOBAL_HISTOGRAMS,
+    Histogram,
+    HistogramRegistry,
+    MetricsServer,
+    SIZE_BUCKETS,
+    TraceContext,
+    Tracer,
+    health_snapshot,
+    merge_traces,
+    prometheus_text,
+)
+from peritext_tpu.obs.__main__ import load_spans, main as obs_main, summarize
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_nesting_and_monotonic_ids(self):
+        t = Tracer(host="h", enabled=True, trace_id=0xABC)
+        with t.span("outer") as outer:
+            with t.span("inner") as inner:
+                with t.span("leaf") as leaf:
+                    pass
+        assert outer.span_id < inner.span_id < leaf.span_id
+        assert inner.parent_id == outer.span_id
+        assert leaf.parent_id == inner.span_id
+        assert outer.parent_id == 0
+        assert {s.trace_id for s in (outer, inner, leaf)} == {0xABC}
+        assert all(s.duration >= 0 for s in (outer, inner, leaf))
+
+    def test_context_adoption_joins_remote_trace(self):
+        t = Tracer(host="h", enabled=True, trace_id=0x1)
+        with t.span("serve", ctx=TraceContext(0x99, 42)) as sp:
+            with t.span("child") as child:
+                pass
+        assert sp.trace_id == 0x99 and sp.parent_id == 42
+        # children inherit the adopted trace, not the tracer's own
+        assert child.trace_id == 0x99 and child.parent_id == sp.span_id
+
+    def test_disabled_tracer_measures_but_retains_nothing(self):
+        t = Tracer(host="h", enabled=False)
+        with t.span("x") as sp:
+            pass
+        assert sp.duration >= 0  # stats consumers still get a duration
+        assert t.spans() == []
+
+    def test_sink_receives_spans_without_enabling(self):
+        t = Tracer(host="h", enabled=False)
+        got = []
+        t.add_sink(got.append)
+        with t.span("x"):
+            pass
+        assert [s.name for s in got] == ["x"]
+        assert t.spans() == []  # sink-only: nothing retained
+
+    def test_error_is_recorded_and_reraised(self):
+        t = Tracer(host="h", enabled=True)
+        with pytest.raises(RuntimeError):
+            with t.span("boom"):
+                raise RuntimeError("nope")
+        (sp,) = t.spans()
+        assert "nope" in sp.args["error"]
+
+    def test_span_ids_unique_across_tracers(self):
+        """Two hosts' spans can share one trace id (wire-carried context),
+        so their span ids must come from disjoint ranges or parent links in
+        a merged trace are ambiguous."""
+        a, b = Tracer(host="a", enabled=True), Tracer(host="b", enabled=True)
+        for t in (a, b):
+            for _ in range(50):
+                with t.span("x"):
+                    pass
+        ids_a = {s.span_id for s in a.spans()}
+        ids_b = {s.span_id for s in b.spans()}
+        assert len(ids_a) == len(ids_b) == 50
+        assert not ids_a & ids_b
+
+    def test_ambient_parent_carries_span_across_threads(self):
+        import threading
+
+        from peritext_tpu.obs import ambient_parent
+
+        t = Tracer(host="h", enabled=True)
+        inner = []
+
+        def worker(parent):
+            with ambient_parent(parent):
+                with t.span("child") as sp:
+                    inner.append(sp)
+
+        with t.span("outer") as outer:
+            th = threading.Thread(target=worker, args=(outer,))
+            th.start()
+            th.join()
+        assert inner[0].parent_id == outer.span_id
+        assert inner[0].trace_id == outer.trace_id
+
+    def test_chrome_trace_schema_and_merge(self):
+        a = Tracer(host="hostA", enabled=True, trace_id=0x7)
+        b = Tracer(host="hostB", enabled=True, trace_id=0x7)
+        with a.span("stage"):
+            pass
+        with b.span("stage"):
+            pass
+        merged = merge_traces(a.chrome_trace(), b.chrome_trace())
+        json.dumps(merged)  # Perfetto-loadable JSON
+        events = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert len(events) == 2
+        for e in events:
+            assert {"name", "cat", "ph", "ts", "dur", "pid", "tid", "args"} <= set(e)
+            assert e["dur"] >= 1
+        assert {e["args"]["trace_id"] for e in events} == {f"{0x7:016x}"}
+        # process_name metadata rows name both hosts
+        metas = [e for e in merged["traceEvents"] if e.get("ph") == "M"]
+        assert {m["args"]["name"] for m in metas} == {"hostA", "hostB"}
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+
+class TestHistogram:
+    def test_percentiles_read_bucket_upper_bounds(self):
+        h = Histogram(buckets=(0.01, 0.1, 1.0))
+        for _ in range(98):
+            h.observe(0.005)  # bucket le=0.01
+        h.observe(0.5)  # bucket le=1.0
+        h.observe(5.0)  # overflow bucket
+        assert h.p50 == 0.01
+        assert h.percentile(0.99) == 1.0
+        assert h.percentile(1.0) == 5.0  # overflow reads the observed max
+        assert h.count == 100
+
+    def test_rolling_window_evicts(self):
+        h = Histogram(buckets=(0.01, 1.0), window=4)
+        for _ in range(10):
+            h.observe(5.0)  # slow history
+        for _ in range(4):
+            h.observe(0.005)  # fast recent window
+        assert h.count == 4
+        assert h.p99 == 0.01  # the slow history no longer dominates
+        assert h.sum == pytest.approx(0.02)
+
+    def test_empty_is_zero(self):
+        h = Histogram()
+        assert h.p50 == 0.0 and h.count == 0 and h.snapshot()["p99"] == 0.0
+
+    def test_registry_timer_and_snapshot(self):
+        reg = HistogramRegistry()
+        with reg.timed("streaming.test_seconds"):
+            pass
+        reg.observe("streaming.test_sizes", 42, buckets=SIZE_BUCKETS)
+        snap = reg.snapshot()
+        assert snap["streaming.test_seconds"]["count"] == 1
+        assert snap["streaming.test_sizes"]["p50"] == 50  # bucket upper bound
+        json.dumps(snap)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        r = FlightRecorder(capacity=4)
+        for i in range(10):
+            r.record("event", i=i)
+        entries = r.entries()
+        assert len(entries) == 4
+        assert [e["i"] for e in entries] == [6, 7, 8, 9]
+
+    def test_fault_auto_dumps_jsonl(self, tmp_path):
+        r = FlightRecorder(capacity=16, dump_dir=tmp_path / "fl", fsync=True)
+        t = Tracer(host="h", enabled=False)
+        t.add_sink(r.record_span)
+        with t.span("streaming.round"):
+            pass
+        r.fault("quarantine", doc=3, quarantine_reason="decode")
+        dumps = list((tmp_path / "fl").glob("*.jsonl"))
+        assert len(dumps) == 1
+        records = [json.loads(line) for line in dumps[0].read_text().splitlines()]
+        assert records[0]["kind"] == "dump" and records[0]["reason"] == "quarantine"
+        kinds = {rec["kind"] for rec in records}
+        assert {"span", "fault"} <= kinds
+        (fault,) = [rec for rec in records if rec["kind"] == "fault"]
+        assert fault["doc"] == 3 and fault["quarantine_reason"] == "decode"
+
+    def test_default_dump_names_unique_across_instances(self, tmp_path):
+        """Two recorders sharing a dump_dir (the crash-restore pattern)
+        must never overwrite each other's post-mortems."""
+        r1 = FlightRecorder(capacity=4, dump_dir=tmp_path,
+                            min_dump_interval=0.0)
+        r1.fault("quarantine", doc=0)
+        r2 = FlightRecorder(capacity=4, dump_dir=tmp_path,
+                            min_dump_interval=0.0)  # "restored" instance
+        r2.fault("quarantine", doc=0)
+        dumps = list(tmp_path.glob("*.jsonl"))
+        assert len(dumps) == 2
+
+    def test_dump_throttle(self, tmp_path):
+        r = FlightRecorder(capacity=4, dump_dir=tmp_path, min_dump_interval=3600)
+        r.fault("quarantine", doc=0)
+        r.fault("quarantine", doc=1)  # inside the interval: no second dump
+        assert r.dumps == 1 and r.faults == 2
+        snap = r.snapshot()
+        assert snap["dumps"] == 1 and snap["faults"] == 2
+        assert snap["last_dump"] is not None
+
+
+# ---------------------------------------------------------------------------
+# EventLog hygiene (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestEventLog:
+    def test_context_manager_closes_file(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        with EventLog(path, fsync=True) as log:
+            log.emit("test", n=1)
+            handle = log._file
+        assert handle.closed and log._file is None
+        assert json.loads(path.read_text().splitlines()[0])["kind"] == "test"
+
+    def test_bad_capacity_mid_init_does_not_leak_handle(self, tmp_path, monkeypatch):
+        opened = []
+        real_open = builtins.open
+
+        def tracking_open(*args, **kwargs):
+            f = real_open(*args, **kwargs)
+            opened.append(f)
+            return f
+
+        monkeypatch.setattr(builtins, "open", tracking_open)
+        with pytest.raises(ValueError):
+            EventLog(tmp_path / "leak.jsonl", capacity=-1)
+        assert len(opened) == 1 and opened[0].closed
+
+    def test_capacity_still_bounds_memory(self, tmp_path):
+        log = EventLog(capacity=3)
+        for i in range(9):
+            log.emit("e", i=i)
+        assert [e["i"] for e in log.events()] == [6, 7, 8]
+
+
+# ---------------------------------------------------------------------------
+# exporters + health snapshot golden shape (satellite)
+# ---------------------------------------------------------------------------
+
+
+#: exporter-schema pins: drift in these key sets breaks fleet scrapers, so
+#: it must be a deliberate, test-visible change
+GOLDEN_SNAPSHOT_KEYS = {"counters", "histograms", "session", "flight_recorder"}
+GOLDEN_SESSION_KEYS = {
+    # streaming session health
+    "rounds", "num_docs", "pending_changes", "fallback_docs", "frame_docs",
+    "round_padding_efficiency", "padding_efficiency_cum", "quarantined",
+    # supervisor overlay
+    "rollbacks", "checkpoints", "journal_frames", "deadline_seconds",
+    "deadline_static", "deadline_floor", "deadline_ceiling",
+    "deadline_autotuned", "round_latency", "flight_recorder",
+}
+
+
+class TestHealthSnapshotShape:
+    def test_composed_snapshot_golden_shape(self, tmp_path):
+        from peritext_tpu.obs import RecompileSentinel
+        from peritext_tpu.parallel.supervisor import GuardedSession
+        from peritext_tpu.testing.fuzz import _campaign_session
+
+        guarded = GuardedSession(
+            lambda: _campaign_session(1, 20), tmp_path, deadline=120.0
+        )
+        guarded.ingest_frame(0, b"garbage")  # one quarantine for the registry
+        sentinel = RecompileSentinel()
+        snap = health_snapshot(
+            session=guarded, sentinel=sentinel, recorder=guarded.recorder
+        )
+        assert set(snap) == GOLDEN_SNAPSHOT_KEYS | {"recompiles"}
+        assert set(snap["session"]) == GOLDEN_SESSION_KEYS
+        assert set(snap["flight_recorder"]) == {
+            "capacity", "size", "faults", "dumps", "last_dump",
+        }
+        assert set(snap["session"]["round_latency"]) == {
+            "count", "sum", "max", "p50", "p95", "p99",
+        }
+        # every histogram entry shares the percentile schema
+        for entry in snap["histograms"].values():
+            assert {"count", "p50", "p95", "p99"} <= set(entry)
+        json.dumps(snap, default=str)  # one JSON document, end to end
+        # fault-domain namespacing holds across every surface
+        prefixes = ("streaming.", "transport.", "supervisor.", "merge.", "jit.")
+        assert all(k.startswith(prefixes) for k in snap["counters"])
+        assert all(k.startswith(prefixes) for k in snap["histograms"])
+
+    def test_prometheus_text_format(self, tmp_path):
+        GLOBAL_HISTOGRAMS.observe("streaming.prom_test_seconds", 0.02)
+        text = prometheus_text()
+        assert "# TYPE peritext_streaming_prom_test_seconds histogram" in text
+        assert 'peritext_streaming_prom_test_seconds_bucket{le="+Inf"}' in text
+        assert "peritext_streaming_prom_test_seconds_count" in text
+        for line in text.splitlines():
+            assert line.startswith("#") or len(line.split()) == 2
+
+    def test_metrics_server_endpoints(self):
+        tracer = Tracer(host="metrics-test", enabled=True)
+        with tracer.span("probe"):
+            pass
+        server = MetricsServer(tracer=tracer)
+        host, port = server.start()
+        try:
+            with urllib.request.urlopen(f"http://{host}:{port}/metrics") as resp:
+                assert resp.status == 200
+                assert b"peritext_" in resp.read()
+            with urllib.request.urlopen(f"http://{host}:{port}/health.json") as resp:
+                snap = json.loads(resp.read())
+                assert "counters" in snap and "histograms" in snap
+            with urllib.request.urlopen(f"http://{host}:{port}/trace.json") as resp:
+                trace = json.loads(resp.read())
+                assert any(
+                    e.get("name") == "probe" for e in trace["traceEvents"]
+                )
+            req = urllib.request.Request(f"http://{host}:{port}/nope")
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(req)
+        finally:
+            server.stop()
+
+    def test_metrics_server_stop_without_start_returns(self):
+        import threading
+
+        server = MetricsServer()
+        stopper = threading.Thread(target=server.stop)
+        stopper.start()
+        stopper.join(timeout=2)
+        assert not stopper.is_alive(), "stop() before start() must not hang"
+
+
+# ---------------------------------------------------------------------------
+# the CLI renderer
+# ---------------------------------------------------------------------------
+
+
+class TestObsCli:
+    def _trace_file(self, tmp_path):
+        t = Tracer(host="cli-host", enabled=True, trace_id=0x5)
+        for _ in range(3):
+            with t.span("streaming.apply"):
+                pass
+        path = tmp_path / "trace.json"
+        t.write_chrome_trace(path)
+        return path
+
+    def test_summary_table(self, tmp_path, capsys):
+        path = self._trace_file(tmp_path)
+        assert obs_main([str(path)]) == 0  # summary is the default command
+        out = capsys.readouterr().out
+        assert "streaming.apply" in out and "cli-host" in out
+        assert "p95_ms" in out
+
+    def test_summary_reads_flight_jsonl(self, tmp_path, capsys):
+        r = FlightRecorder(capacity=8)
+        t = Tracer(host="fl-host")
+        t.add_sink(r.record_span)
+        with t.span("supervisor.round"):
+            pass
+        dump = r.dump(tmp_path / "flight.jsonl")
+        assert obs_main(["summary", str(dump), "--json"]) == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert rows[0]["stage"] == "supervisor.round"
+        assert rows[0]["host"] == "fl-host"
+
+    def test_merge_command(self, tmp_path, capsys):
+        a, b = self._trace_file(tmp_path), tmp_path / "b.json"
+        t = Tracer(host="other", enabled=True)
+        with t.span("batch.merge"):
+            pass
+        t.write_chrome_trace(b)
+        out = tmp_path / "merged.json"
+        assert obs_main(["merge", "-o", str(out), str(a), str(b)]) == 0
+        merged = json.loads(out.read_text())
+        names = {e["name"] for e in merged["traceEvents"]}
+        assert {"streaming.apply", "batch.merge"} <= names
+        spans = load_spans(out)
+        assert {row["stage"] for row in summarize(spans)} == {
+            "streaming.apply", "batch.merge",
+        }
+
+    def test_unreadable_and_empty_exit_codes(self, tmp_path, capsys):
+        assert obs_main([str(tmp_path / "missing.json")]) == 2
+        empty = tmp_path / "empty.json"
+        empty.write_text(json.dumps({"traceEvents": []}))
+        assert obs_main([str(empty)]) == 1

@@ -1,0 +1,349 @@
+"""Telemetry integration tests (ISSUE 3 acceptance): deadline autotuning
+from the rolling round-latency percentile, cross-host trace propagation via
+the wire-carried context (frame v5 + frontier sentinels with old-peer
+compatibility), and streaming per-round MergeStats."""
+
+import json
+import socket
+import time
+
+import pytest
+
+from peritext_tpu.obs import TraceContext, Tracer, merge_traces
+from peritext_tpu.parallel.anti_entropy import ChangeStore
+from peritext_tpu.parallel.codec import (
+    decode_frame,
+    decode_frame_traced,
+    encode_frame,
+    encode_frame_traced,
+    strip_trace_context,
+)
+from peritext_tpu.parallel.multihost import (
+    ReplicaServer,
+    _meta_ctx,
+    _parse_frontier,
+    _recv_message,
+    _send_changes,
+    sync_with,
+)
+from peritext_tpu.parallel.supervisor import GuardedSession
+from peritext_tpu.testing.fuzz import _campaign_session, generate_workload
+
+DOCS, OPS = 3, 25
+
+
+def _changes(seed=11, doc=0):
+    workload = generate_workload(seed, num_docs=DOCS, ops_per_doc=OPS)[doc]
+    return [ch for log in workload.values() for ch in log]
+
+
+# ---------------------------------------------------------------------------
+# deadline autotuning (closes ROADMAP "supervisor deadline autotuning")
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlineAutotune:
+    def _guarded(self, tmp_path, **kw):
+        kw.setdefault("deadline", 30.0)
+        kw.setdefault("deadline_floor", 1.0)
+        kw.setdefault("deadline_ceiling", 8.0)
+        kw.setdefault("deadline_margin", 2.0)
+        kw.setdefault("deadline_window", 8)
+        kw.setdefault("checkpoint_every", 10_000)
+        return GuardedSession(lambda: _campaign_session(1, OPS), tmp_path, **kw)
+
+    def test_first_round_compile_exempt(self, tmp_path):
+        guarded = self._guarded(tmp_path)
+        assert guarded.effective_deadline() == 8.0  # no data: ceiling
+        guarded.inject_delay(0.3)  # a "slow compile" first round
+        guarded.step()
+        # warmup-exempt: the slow first round never enters the window
+        assert guarded.round_latency.count == 0
+        assert guarded.effective_deadline() == 8.0
+
+    def test_deadline_adapts_within_floor_and_ceiling(self, tmp_path):
+        guarded = self._guarded(tmp_path)
+        guarded.step()  # warmup (exempt)
+        for _ in range(6):
+            guarded.step()  # fast empty rounds
+        assert guarded.round_latency.count == 6
+        fast = guarded.effective_deadline()
+        # fast rounds clamp at (or near) the floor, well under the ceiling
+        assert guarded.deadline_floor <= fast < guarded.deadline_ceiling
+        # slow rounds (under the current deadline, so they complete and are
+        # observed) push the rolling percentile — the deadline rises
+        for _ in range(4):
+            guarded.inject_delay(0.6)
+            guarded.step()
+        tuned = guarded.effective_deadline()
+        assert tuned >= 2.0  # 2x margin on the 0.6s rounds' bucket
+        assert tuned > fast
+        assert guarded.deadline_floor <= tuned <= guarded.deadline_ceiling
+        health = guarded.health()
+        assert health["deadline_autotuned"] is True
+        assert health["deadline_seconds"] == pytest.approx(tuned)
+        assert health["deadline_static"] == 30.0
+        assert health["round_latency"]["count"] == guarded.round_latency.count
+
+    def test_watchdog_fires_at_the_tuned_deadline(self, tmp_path):
+        """The acceptance oracle: the watchdog trips at the DERIVED deadline
+        — far below the static constant — and the ladder still recovers."""
+        guarded = self._guarded(tmp_path, deadline_floor=0.5,
+                                deadline_ceiling=8.0)
+        guarded.step()  # warmup
+        for _ in range(5):
+            guarded.step()  # fast rounds: effective ~= floor
+        tuned = guarded.effective_deadline()
+        assert tuned < 3.0  # comfortably under both ceiling and static 30s
+        from peritext_tpu.obs import GLOBAL_HISTOGRAMS
+
+        exported = GLOBAL_HISTOGRAMS.get("supervisor.round_seconds")
+        count_before = exported.count
+        guarded.inject_delay(3.2)  # over the tuned deadline, under static
+        assert guarded.step() == 0  # watchdog fired -> rollback, contained
+        assert guarded.rollbacks == 1
+        # the failed round was not observed by AUTOTUNE (window unchanged)…
+        assert guarded.effective_deadline() == tuned
+        # …but the exported fleet histogram saw it: deadline-hit rounds are
+        # the worst case operators size the static ceiling from
+        assert exported.count == count_before + 1
+        assert exported.snapshot()["max"] >= tuned
+
+    def test_stage_spans_nest_under_guarded_round(self, tmp_path):
+        """The watchdog runs the round body on a worker thread; the
+        session's stage spans must still parent under supervisor.round so
+        flight-recorder dumps reconstruct a NESTED stage timeline."""
+        from peritext_tpu.parallel.codec import encode_frame
+
+        tracer = Tracer(host="nesting", enabled=True)
+        guarded = self._guarded(tmp_path, tracer=tracer)
+        guarded.ingest_frame(0, encode_frame(_changes()))
+        guarded.step()
+        spans = {s.name: s for s in tracer.spans()}
+        round_sp = spans["supervisor.round"]
+        assert spans["streaming.round"].parent_id == round_sp.span_id
+        assert spans["streaming.round"].trace_id == round_sp.trace_id
+        assert spans["streaming.schedule"].parent_id == spans[
+            "streaming.round"
+        ].span_id
+
+    def test_autotune_off_keeps_static_behavior(self, tmp_path):
+        guarded = self._guarded(tmp_path, autotune=False)
+        for _ in range(8):
+            guarded.step()
+        assert guarded.effective_deadline() == guarded.deadline_ceiling
+
+    def test_warmup_rounds_still_export_to_global_histogram(self, tmp_path):
+        """The warmup exemption scopes the AUTOTUNE window only: the fleet
+        histogram must see every round, compile-dominated first ones
+        included (operators size the static ceiling from the true max)."""
+        from peritext_tpu.obs import GLOBAL_HISTOGRAMS
+
+        hist = GLOBAL_HISTOGRAMS.get("supervisor.round_seconds")
+        before = hist.count
+        guarded = self._guarded(tmp_path)
+        guarded.step()  # warmup round: autotune-exempt, still exported
+        assert hist.count == before + 1
+        assert guarded.round_latency.count == 0
+
+    def test_close_detaches_recorder_sink_from_shared_tracer(self, tmp_path):
+        tracer = Tracer(host="shared")
+        guarded = self._guarded(tmp_path, tracer=tracer)
+        guarded.step()
+        size_before = guarded.recorder.snapshot()["size"]
+        assert size_before > 0  # the sink was live
+        guarded.close()
+        with tracer.span("after-close"):
+            pass
+        assert guarded.recorder.snapshot()["size"] == size_before
+
+
+# ---------------------------------------------------------------------------
+# cross-host trace propagation
+# ---------------------------------------------------------------------------
+
+
+class TestCrossHostTrace:
+    def test_two_hosts_share_one_trace_id(self):
+        """Acceptance: a two-ReplicaServer sync produces a single merged
+        Perfetto trace where both hosts' spans share one trace id via the
+        wire-carried context."""
+        store_a, store_b = ChangeStore(), ChangeStore()
+        for ch in _changes():
+            store_a.append(ch)
+        tracer_a = Tracer(host="hostA", enabled=True, trace_id=0xA11CE)
+        tracer_b = Tracer(host="hostB", enabled=True, trace_id=0xB0B)
+        server_a = ReplicaServer(store_a, tracer=tracer_a)
+        server_b = ReplicaServer(store_b, tracer=tracer_b)
+        server_a.start()
+        host, port = server_b.start()
+        try:
+            pulled, pushed = server_a.sync_with(host, port)
+            assert pushed > 0
+            deadline = time.time() + 5
+            while time.time() < deadline:  # the handler thread finishes async
+                if any(s.name == "anti-entropy.serve" for s in tracer_b.spans()):
+                    break
+                time.sleep(0.02)
+        finally:
+            server_a.stop()
+            server_b.stop()
+        (sync_span,) = [
+            s for s in tracer_a.spans() if s.name == "anti-entropy.sync"
+        ]
+        (serve_span,) = [
+            s for s in tracer_b.spans() if s.name == "anti-entropy.serve"
+        ]
+        # hostB's handler joined hostA's trace, as a child of the sync span
+        assert serve_span.trace_id == sync_span.trace_id == 0xA11CE
+        assert serve_span.parent_id == sync_span.span_id
+        assert serve_span.args["pulled"] == len(_changes())
+        merged = merge_traces(tracer_a.chrome_trace(), tracer_b.chrome_trace())
+        exchange = [
+            e for e in merged["traceEvents"]
+            if e.get("ph") == "X" and e["name"].startswith("anti-entropy.")
+        ]
+        assert {e["args"]["host"] for e in exchange} == {"hostA", "hostB"}
+        assert {e["args"]["trace_id"] for e in exchange} == {f"{0xA11CE:016x}"}
+        json.dumps(merged)
+
+    def test_client_delivery_joins_trace_via_frame_context(self):
+        """The v5 frame field is load-bearing on the CLIENT side: delivery
+        runs after the sync span closed, so the consumer's spans link into
+        the exchange's trace through the frame-carried context — the
+        delivery span parents under the SERVER's handler span."""
+        store_a, store_b = ChangeStore(), ChangeStore()
+        for ch in _changes():  # server has the backlog; client pulls
+            store_b.append(ch)
+        tracer_a = Tracer(host="hostA", enabled=True, trace_id=0xA11CE)
+        tracer_b = Tracer(host="hostB", enabled=True, trace_id=0xB0B)
+        server_b = ReplicaServer(store_b, tracer=tracer_b)
+        host, port = server_b.start()
+        delivered = []
+        try:
+            pulled, _ = sync_with(
+                store_a, host, port, tracer=tracer_a,
+                on_changes=delivered.extend,
+            )
+            assert pulled > 0 and delivered
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if any(s.name == "anti-entropy.serve" for s in tracer_b.spans()):
+                    break
+                time.sleep(0.02)
+        finally:
+            server_b.stop()
+        (serve,) = [s for s in tracer_b.spans() if s.name == "anti-entropy.serve"]
+        (deliver,) = [
+            s for s in tracer_a.spans() if s.name == "anti-entropy.deliver"
+        ]
+        assert deliver.trace_id == 0xA11CE  # the whole exchange: one trace
+        assert deliver.parent_id == serve.span_id  # linked by the v5 field
+
+    def test_store_clocks_stay_clean_of_metadata(self):
+        """The frontier sentinels are transport metadata: after a traced
+        sync both stores' clocks hold actors only."""
+        store_a, store_b = ChangeStore(), ChangeStore()
+        for ch in _changes():
+            store_a.append(ch)
+        server = ReplicaServer(store_b, tracer=Tracer(host="b", enabled=True))
+        host, port = server.start()
+        try:
+            sync_with(store_a, host, port, tracer=Tracer(host="a", enabled=True))
+            deadline = time.time() + 5
+            while time.time() < deadline and store_b.clock() != store_a.clock():
+                time.sleep(0.02)
+        finally:
+            server.stop()
+        assert store_b.clock() == store_a.clock()
+        assert all(not a.startswith("\x00") for a in store_a.clock())
+        assert all(not a.startswith("\x00") for a in store_b.clock())
+
+
+# ---------------------------------------------------------------------------
+# wire negotiation + v5 frames
+# ---------------------------------------------------------------------------
+
+
+class TestWireNegotiation:
+    def test_frontier_metadata_roundtrip_and_old_form(self):
+        clock, meta = _parse_frontier(json.dumps({"actor": 3}).encode())
+        assert clock == {"actor": 3} and meta == {}  # pre-caps peers
+        body = json.dumps({
+            "actor": 3, "\x00caps": 5, "\x00trace": 0xA, "\x00span": 7,
+        }).encode()
+        clock, meta = _parse_frontier(body)
+        assert clock == {"actor": 3}
+        assert meta == {"caps": 5, "trace": 0xA, "span": 7}
+        assert _meta_ctx(meta) == TraceContext(0xA, 7)
+        assert _meta_ctx({"caps": 5}) is None
+
+    def test_v5_sent_only_to_capable_peers(self):
+        changes = _changes()[:5]
+        ctx = TraceContext(0x123, 9)
+        for caps, version in ((0, 2), (4, 2), (5, 5)):
+            a, b = socket.socketpair()
+            try:
+                _send_changes(a, changes, peer_caps=caps, ctx=ctx)
+                _, body = _recv_message(b)
+                assert body[4] == version, f"caps={caps}"
+                assert decode_frame(body) == changes
+            finally:
+                a.close()
+                b.close()
+
+    def test_traced_frame_roundtrip_and_strip(self):
+        changes = _changes()[:8]
+        plain = encode_frame(changes)
+        traced = encode_frame_traced(changes, 0xFEED, 21)
+        assert decode_frame(traced) == changes
+        got, ctx = decode_frame_traced(traced)
+        assert got == changes and ctx == (0xFEED, 21)
+        ctx, stripped = strip_trace_context(traced)
+        assert stripped == plain and ctx == (0xFEED, 21)
+        assert strip_trace_context(plain) == (None, plain)
+
+    def test_streaming_ingest_adopts_frame_context(self):
+        """A traced frame arriving at a session links that session's ingest
+        span into the sender's trace, and the doc converges identically."""
+        from peritext_tpu.api.batch import _oracle_doc
+
+        workload = generate_workload(11, num_docs=DOCS, ops_per_doc=OPS)[0]
+        changes = [ch for log in workload.values() for ch in log]
+        sess = _campaign_session(1, OPS)
+        tracer = Tracer(host="ingestor", enabled=True)
+        sess.tracer = tracer
+        sess.ingest_frame(0, encode_frame_traced(changes, 0x77, 9))
+        sess.drain()
+        (ingest,) = [s for s in tracer.spans() if s.name == "streaming.ingest"]
+        assert ingest.trace_id == 0x77 and ingest.parent_id == 9
+        assert sess.read(0) == _oracle_doc(workload).get_text_with_formatting(
+            ["text"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# streaming per-round MergeStats (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestStreamingRoundStats:
+    def test_round_stats_and_padding_surface(self):
+        sess = _campaign_session(DOCS, OPS)
+        assert sess.last_round_stats is None
+        assert sess.health()["round_padding_efficiency"] is None
+        for d in range(DOCS):
+            sess.ingest_frame(d, encode_frame(_changes(doc=d)))
+        sess.drain()
+        stats = sess.last_round_stats
+        assert stats is not None
+        assert stats.device_ops > 0
+        assert 0.0 < stats.padding_efficiency <= 1.0
+        assert stats.extras["rounds"] >= 1
+        assert stats.encode_seconds > 0 and stats.apply_seconds > 0
+        health = sess.health()
+        assert health["round_padding_efficiency"] == pytest.approx(
+            stats.padding_efficiency, abs=1e-4
+        )
+        assert 0.0 < health["padding_efficiency_cum"] <= 1.0
+        json.dumps(health)

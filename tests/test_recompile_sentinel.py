@@ -109,3 +109,79 @@ def test_steady_state_streaming_rounds_zero_recompiles(recompile_sentinel):
     warm = _run_schedule(fresh_session(), arrival, rounds=6)
     recompile_sentinel.assert_steady_state("steady-state streaming rounds")
     assert warm == cold  # replay converges byte-equal, and compiled nothing
+
+
+# ---------------------------------------------------------------------------
+# log-record parsing regression (ISSUE 3 satellite): the sentinel must
+# tolerate prefixed and multi-line jax log_compiles records
+# ---------------------------------------------------------------------------
+
+import logging
+
+from peritext_tpu.obs.sentinel import _COMPILE_MSG_RE
+
+#: VERBATIM record messages captured from the current jax pin (0.4.37,
+#: CPU backend, jax_log_compiles=True) — see the emitting sites in
+#: jax._src.interpreters.pxla / jax._src.dispatch.  If a jax upgrade
+#: changes these shapes, re-capture and extend; the sentinel must keep
+#: counting exactly the "Compiling <site>" records.
+VERBATIM_JAX_0_4_37 = [
+    ("Finished tracing + transforming convert_element_type for pjit "
+     "in 0.000578880 sec", None),
+    ("Compiling convert_element_type with global shapes and types "
+     "[ShapedArray(float32[])]. Argument mapping: (UnspecifiedValue,).",
+     "convert_element_type"),
+    ("Finished jaxpr to MLIR module conversion jit(convert_element_type) "
+     "in 0.026105642 sec", None),
+    ("Finished XLA compilation of jit(convert_element_type) "
+     "in 0.014521360 sec", None),
+    ("Compiling f with global shapes and types [ShapedArray(float32[3])]. "
+     "Argument mapping: (UnspecifiedValue,).", "f"),
+    ("Finished tracing + transforming multiply for pjit in 0.001347542 sec",
+     None),
+]
+
+#: shapes the regex must ALSO tolerate: a formatter-prefixed record and a
+#: multi-line record with "Finished tracing" noise batched ahead of the
+#: Compiling line (both observed from handlers downstream of other logging
+#: layers)
+HOSTILE_SHAPES = [
+    ("WARNING:2026-08-03 23:17:59,392:jax._src.interpreters.pxla:1906: "
+     "Compiling f with global shapes and types [ShapedArray(float32[3])].",
+     "f"),
+    ("Finished tracing + transforming f for pjit in 0.003565311 sec\n"
+     "Compiling f with global shapes and types [ShapedArray(float32[3])]. "
+     "Argument mapping: (UnspecifiedValue,).", "f"),
+    # prose containing "compilation"/"Recompiling" must NOT count
+    ("Finished XLA compilation of jit(f) in 0.081711054 sec", None),
+    ("Recompiling is not what this says", None),
+]
+
+
+def test_compile_regex_on_verbatim_and_hostile_records():
+    for message, site in VERBATIM_JAX_0_4_37 + HOSTILE_SHAPES:
+        m = _COMPILE_MSG_RE.search(message)
+        if site is None:
+            assert m is None, f"false positive on: {message!r}"
+        else:
+            assert m is not None and m.group(1) == site, message
+
+
+def test_sentinel_counts_prefixed_and_multiline_records():
+    """End-to-end through logging.Handler.emit with hostile record shapes:
+    the per-site counts must land exactly once per Compiling record."""
+    from peritext_tpu.observability import Counters, RecompileSentinel
+
+    counters = Counters()
+    sentinel = RecompileSentinel(counters=counters)
+    for message, _ in VERBATIM_JAX_0_4_37 + HOSTILE_SHAPES:
+        record = logging.LogRecord(
+            "jax._src.interpreters.pxla", logging.WARNING, __file__, 1,
+            message, None, None,
+        )
+        sentinel.emit(record)
+    expected_sites = [s for _, s in VERBATIM_JAX_0_4_37 + HOSTILE_SHAPES if s]
+    assert sentinel.total == len(expected_sites)
+    assert sentinel.counts == {"convert_element_type": 1, "f": 3}
+    assert counters.get("jit.compiles_total") == len(expected_sites)
+    assert counters.get("jit.compiles.f") == 3
