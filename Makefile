@@ -6,7 +6,8 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test fuzz fuzz-differential fuzz-frames fuzz-crash chaos weak-scaling \
-	bench bench-smoke bench-streaming entry dryrun lint lint-baseline clean obs
+	bench bench-smoke bench-streaming entry dryrun lint lint-baseline clean obs \
+	fleet
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -36,6 +37,13 @@ weak-scaling:
 # prints the per-stage summary (artifacts land in /tmp/pt-obs)
 obs:
 	$(CPU_ENV) $(PY) scripts/obs_smoke.py --out /tmp/pt-obs
+
+# fleet convergence smoke (mirrors the CI fleet-smoke job): an in-process
+# multi-host partition/heal episode — asymmetric partition, flapping + slow
+# links, lag-ordered gossip heal, fleet-wide digest equality — plus the
+# seeded divergence injection (artifacts land in /tmp/pt-fleet)
+fleet:
+	$(CPU_ENV) $(PY) scripts/fleet_smoke.py --out /tmp/pt-fleet
 
 # streaming frame ingest vs oracle (spans + incremental patch streams)
 fuzz-frames:

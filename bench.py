@@ -919,6 +919,39 @@ def run_wire(args) -> dict:
     }
 
 
+def run_fleet_heal(args) -> dict:
+    """Fleet-heal row (ISSUE 4): time-to-convergence and ops drained per
+    second after a simulated partition heal.  Drives the chaos fleet
+    harness (``testing/chaos.run_fleet_chaos``): an N-host ReplicaServer
+    fleet diverges under an asymmetric partition, then the gossip
+    scheduler's most-behind-first rounds drain it; the row reports how fast
+    the anti-entropy layer re-converges the fleet.  Host-only (TCP +
+    codec + store work, no device), so the row is platform-independent."""
+    from peritext_tpu.testing.chaos import run_fleet_chaos
+
+    hosts = 3 if args.smoke else 4
+    reports = []
+    for i in range(max(1, min(args.iters, 3))):
+        reports.append(run_fleet_chaos(args.seed + i, hosts=hosts,
+                                       metrics=False))
+    best = max(reports, key=lambda r: r.ops_drained / max(r.heal_seconds, 1e-9))
+    rate = best.ops_drained / max(best.heal_seconds, 1e-9)
+    return {
+        "metric": "fleet_heal_ops_per_sec",
+        "value": round(rate, 1),
+        "unit": "ops/s",
+        "baseline_impl": "asymmetric-partition heal over localhost TCP gates",
+        "hosts": hosts,
+        "episodes": len(reports),
+        "time_to_convergence_s": round(best.heal_seconds, 4),
+        "heal_rounds": best.heal_rounds,
+        "ops_drained": best.ops_drained,
+        "partition_lag_ops": sum(best.expected_lag.values()),
+        "converged": all(r.converged for r in reports),
+        "platform": "host",
+    }
+
+
 def run_sweep(args) -> dict:
     """Full-corpus sweep row (BASELINE config 5b, VERDICT r3 task 5): build
     an N-doc converged session on carried device state (the scale demo's
@@ -1005,6 +1038,7 @@ def ladder_rows(platform: str):
         ("batch_8k",     "4",  ["--mode", "batch"], platform, t),
         ("streaming",    "5",  ["--mode", "streaming"], platform, t),
         ("wire",         "-",  ["--mode", "wire"], "cpu", t),
+        ("fleet_heal",   "-",  ["--mode", "fleet"], "cpu", t),
         ("engine",       "5e", ["--mode", "engine"], platform, t),
         ("batch_1k",     "3",  ["--mode", "batch", "--docs", "1024"], platform, t),
         ("batch_128_cpu", "2", ["--mode", "batch", "--docs", "128"], "cpu", t),
@@ -1191,13 +1225,14 @@ def main() -> None:
     parser.add_argument(
         "--mode",
         choices=("batch", "streaming", "engine", "wire", "sweep", "baselines",
-                 "ladder"),
+                 "fleet", "ladder"),
         default=None,
         help="batch = one-shot converge (configs 2-4); streaming = config 5 "
              "end-to-end; engine = device-only streaming replay (the engine "
              "limit, decoupled from host parse/link); wire = codec bytes/op; "
              "sweep = config-5b full-corpus read sweep; baselines = scalar "
-             "baselines only; ladder = every row as bounded sub-workers "
+             "baselines only; fleet = partition-heal time-to-convergence "
+             "(ISSUE 4); ladder = every row as bounded sub-workers "
              "(the default when invoked with no mode and no --smoke)",
     )
     parser.add_argument("--rounds", type=int, default=4, help="streaming arrival rounds")
@@ -1261,7 +1296,7 @@ def main() -> None:
     if args.mode == "sweep":
         defaults = (2000, 220, 0, 0) if args.smoke else (100_000, 220, 0, 0)
         args.seed = args.seed or 200
-    elif args.mode == "wire":
+    elif args.mode in ("wire", "fleet"):
         defaults = (64, 192, 0, 0) if args.smoke else (512, 192, 0, 0)
     elif args.mode in ("streaming", "engine"):
         defaults = (64, 96, 256, 64) if args.smoke else (2048, 192, 384, 96)
@@ -1273,7 +1308,8 @@ def main() -> None:
     args.marks = args.marks or defaults[3]
 
     runners = {"streaming": run_streaming, "engine": run_engine, "batch": run,
-               "wire": run_wire, "sweep": run_sweep, "baselines": run_baselines}
+               "wire": run_wire, "sweep": run_sweep, "baselines": run_baselines,
+               "fleet": run_fleet_heal}
     print(json.dumps(runners[args.mode](args)))
 
 

@@ -16,10 +16,18 @@ every streaming-perf PR is judged by.  Four cooperating pieces:
 * :mod:`.recorder` — the flight recorder: a bounded ring of recent
   spans+events per session, dumped as JSONL on quarantine, rollback, or
   transport give-up so chaos-soak failures become post-mortems.
+* :mod:`.convergence` — per-peer replication-lag watermarks (ops-behind
+  clock-delta sums, staleness) and divergence probes (same frontier +
+  different commutative store digest = a first-class incident) fed by
+  every anti-entropy frontier exchange; the behind-states the
+  ``parallel/gossip.py`` healing scheduler consumes.
 * :mod:`.exporters` — Prometheus text exposition and JSON snapshot
-  endpoints (:class:`MetricsServer`, mounted by ``ReplicaServer``), plus
-  the ``python -m peritext_tpu.obs`` CLI (:mod:`.__main__`) that renders a
-  trace dump into a per-stage/per-host summary table.
+  endpoints (:class:`MetricsServer`, mounted by ``ReplicaServer``:
+  ``/metrics`` with ``peritext_convergence_*`` gauges, ``/health.json``,
+  ``/convergence.json``, ``/trace.json``), plus the
+  ``python -m peritext_tpu.obs`` CLI (:mod:`.__main__`) that renders a
+  trace dump into a per-stage/per-host summary table and
+  ``/convergence.json`` scrapes into the fleet lag view (``fleet``).
 
 Design rule (DESIGN.md "Telemetry"): timestamps are telemetry, not merge
 inputs.  Merge-scope modules (``core/``, ``ops/``, ``parallel/``) never
@@ -28,6 +36,7 @@ the clock reads happen HERE, outside graftlint's PTL006 merge scope, so the
 determinism contract stays machine-checkable.
 """
 
+from .convergence import ConvergenceMonitor, DivergenceIncident, PeerLag
 from .events import EventLog, profile_trace
 from .histograms import (
     GLOBAL_HISTOGRAMS,
@@ -52,7 +61,9 @@ from .stats import MergeStats
 from .exporters import MetricsServer, prometheus_text
 
 __all__ = [
+    "ConvergenceMonitor",
     "Counters",
+    "DivergenceIncident",
     "EventLog",
     "FlightRecorder",
     "GLOBAL_COUNTERS",
@@ -63,6 +74,7 @@ __all__ = [
     "LATENCY_BUCKETS_S",
     "MergeStats",
     "MetricsServer",
+    "PeerLag",
     "RecompileSentinel",
     "SIZE_BUCKETS",
     "Span",

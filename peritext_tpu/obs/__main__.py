@@ -3,16 +3,22 @@
 Reads Perfetto/Chrome trace-event JSON (a ``Tracer.chrome_trace()`` dump,
 ``/trace.json`` scrape, or obs-smoke artifact) or flight-recorder JSONL and
 prints a per-stage / per-host summary table: span count, total wall, mean,
-and p50/p95/p99 per (stage, host).
+and p50/p95/p99 per (stage, host).  The ``fleet`` command instead reads
+``/convergence.json`` scrapes (or ``/health.json`` bodies carrying a
+``convergence`` key) from one or more hosts and renders the fleet's
+replication-lag picture: per (host, peer) ops-behind/ahead watermarks,
+staleness, failures, and any divergence incidents.
 
 Usage::
 
     python -m peritext_tpu.obs summary trace.json [more.json ...]
     python -m peritext_tpu.obs summary flight-*.jsonl --json
     python -m peritext_tpu.obs merge -o merged.json hostA.json hostB.json
+    python -m peritext_tpu.obs fleet hostA-convergence.json hostB.json
 
 ``summary`` is the default command (``python -m peritext_tpu.obs t.json``
-works).  Exit codes: 0 ok, 1 no spans found, 2 unreadable input.
+works).  Exit codes: 0 ok (fleet: converged), 1 no spans found / fleet has
+lag or divergence, 2 unreadable input.
 """
 
 from __future__ import annotations
@@ -91,15 +97,16 @@ def summarize(spans: Sequence[Dict]) -> List[Dict]:
     return rows
 
 
-def render_table(rows: Sequence[Dict]) -> str:
-    cols = ["stage", "host", "count", "total_ms", "mean_ms", "p50_ms",
-            "p95_ms", "p99_ms"]
+def render_table(rows: Sequence[Dict], cols: Optional[List[str]] = None,
+                 left_cols: int = 2) -> str:
+    cols = cols or ["stage", "host", "count", "total_ms", "mean_ms",
+                    "p50_ms", "p95_ms", "p99_ms"]
     cells = [[str(r[c]) for c in cols] for r in rows]
     widths = [max(len(c), *(len(row[i]) for row in cells)) if cells else len(c)
               for i, c in enumerate(cols)]
     def fmt(row):
         return "  ".join(
-            v.ljust(w) if i < 2 else v.rjust(w)
+            v.ljust(w) if i < left_cols else v.rjust(w)
             for i, (v, w) in enumerate(zip(row, widths))
         )
     lines = [fmt(cols), fmt(["-" * w for w in widths])]
@@ -107,10 +114,46 @@ def render_table(rows: Sequence[Dict]) -> str:
     return "\n".join(lines)
 
 
+# -- fleet view (convergence.json scrapes) ----------------------------------
+
+
+def load_convergence(path: str | Path) -> Dict:
+    """One host's convergence snapshot from a ``/convergence.json`` scrape
+    or a ``/health.json`` body whose ``convergence`` key carries it."""
+    doc = json.loads(Path(path).read_text())
+    if isinstance(doc, dict) and "convergence" in doc:
+        doc = doc["convergence"]
+    if not isinstance(doc, dict) or "peers" not in doc:
+        raise ValueError(f"{path}: not a convergence snapshot")
+    return doc
+
+
+def fleet_rows(snapshots: Sequence[Dict]) -> List[Dict]:
+    """Flatten host snapshots into per-(host, peer) lag rows."""
+    rows = []
+    for snap in snapshots:
+        host = snap.get("host", "?")
+        for peer, rec in sorted(snap.get("peers", {}).items()):
+            rows.append({
+                "host": host,
+                "peer": peer,
+                "lag_ops": rec.get("ops_behind", 0),
+                "ahead_ops": rec.get("ops_ahead", 0),
+                "stale_rounds": rec.get("staleness_rounds", 0),
+                "failures": rec.get("failures", 0),
+                "outcome": rec.get("last_outcome", "?"),
+                "divergent": "YES" if rec.get("divergent") else "",
+                "last_error": rec.get("last_error"),
+            })
+    rows.sort(key=lambda r: (-r["lag_ops"], -r["stale_rounds"],
+                             r["host"], r["peer"]))
+    return rows
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # default command: `python -m peritext_tpu.obs trace.json` == summary
-    if argv and argv[0] not in ("summary", "merge", "-h", "--help"):
+    if argv and argv[0] not in ("summary", "merge", "fleet", "-h", "--help"):
         argv.insert(0, "summary")
     parser = argparse.ArgumentParser(
         prog="python -m peritext_tpu.obs", description=__doc__,
@@ -124,10 +167,46 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p_merge = sub.add_parser("merge", help="merge chrome traces into one")
     p_merge.add_argument("paths", nargs="+")
     p_merge.add_argument("-o", "--out", required=True)
+    p_fleet = sub.add_parser(
+        "fleet", help="per-peer replication-lag table from convergence.json "
+        "scrapes",
+    )
+    p_fleet.add_argument("paths", nargs="+")
+    p_fleet.add_argument("--json", action="store_true",
+                         help="machine-readable rows instead of the table")
     args = parser.parse_args(argv)
     if args.cmd is None:
         parser.print_help()
         return 2
+
+    if args.cmd == "fleet":
+        snapshots = []
+        for p in args.paths:
+            try:
+                snapshots.append(load_convergence(p))
+            except (OSError, ValueError, json.JSONDecodeError) as exc:
+                print(f"unreadable convergence snapshot {p}: {exc}",
+                      file=sys.stderr)
+                return 2
+        rows = fleet_rows(snapshots)
+        incidents = sum(s.get("divergence_incidents", 0) for s in snapshots)
+        total_lag = sum(r["lag_ops"] for r in rows)
+        if args.json:
+            print(json.dumps({
+                "hosts": len(snapshots), "total_lag_ops": total_lag,
+                "divergence_incidents": incidents, "rows": rows,
+            }, indent=2))
+        else:
+            print(f"{len(snapshots)} host(s) · {len(rows)} peer link(s) · "
+                  f"lag {total_lag} ops · {incidents} divergence incident(s)")
+            print(render_table(
+                rows,
+                cols=["host", "peer", "lag_ops", "ahead_ops", "stale_rounds",
+                      "failures", "outcome", "divergent"],
+            ))
+        # a fleet with outstanding lag or any divergence is exit 1: the
+        # command doubles as a CI/cron convergence check
+        return 1 if (total_lag or incidents) else 0
 
     if args.cmd == "merge":
         from .spans import merge_traces

@@ -41,11 +41,15 @@ def prometheus_text(
     histograms: Optional[HistogramRegistry] = None,
     session=None,
     sentinel=None,
+    convergence=None,
 ) -> str:
     """Prometheus text exposition of the process telemetry.  Counter names
     sanitize ``.`` → ``_`` under a ``peritext_`` prefix; histograms emit the
     standard ``_bucket{le=...}`` / ``_sum`` / ``_count`` series; a session's
-    numeric health fields land as ``peritext_session_*`` gauges."""
+    numeric health fields land as ``peritext_session_*`` gauges; a
+    :class:`~.convergence.ConvergenceMonitor` lands as per-peer
+    ``peritext_convergence_*`` gauges (lag ops, staleness rounds) plus the
+    fleet-level totals."""
     counters = counters or GLOBAL_COUNTERS
     histograms = histograms if histograms is not None else GLOBAL_HISTOGRAMS
     lines = []
@@ -65,6 +69,34 @@ def prometheus_text(
         m = "peritext_recompiles_total"
         lines.append(f"# TYPE {m} counter")
         lines.append(f"{m} {sentinel.total}")
+    if convergence is not None:
+        snap = convergence.snapshot()
+        per_peer = (
+            ("peritext_convergence_lag_ops", "ops_behind"),
+            ("peritext_convergence_ahead_ops", "ops_ahead"),
+            ("peritext_convergence_staleness_rounds", "staleness_rounds"),
+            ("peritext_convergence_peer_failures", "failures"),
+        )
+        for m, key in per_peer:
+            lines.append(f"# TYPE {m} gauge")
+            for peer, rec in snap["peers"].items():
+                # full exposition-format label escaping: backslash, quote,
+                # AND newline — peer names are arbitrary strings (pubsub
+                # subscriber keys, logical gossip names), and one raw
+                # newline would corrupt the whole scrape page
+                quoted = (peer.replace("\\", "\\\\").replace('"', '\\"')
+                          .replace("\n", "\\n"))
+                lines.append(f'{m}{{peer="{quoted}"}} {_fmt(rec[key])}')
+        for m, value in (
+            ("peritext_convergence_peers", len(snap["peers"])),
+            ("peritext_convergence_total_lag_ops", snap["total_lag_ops"]),
+            ("peritext_convergence_rounds", snap["rounds"]),
+        ):
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {_fmt(value)}")
+        m = "peritext_convergence_divergence_incidents_total"
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {_fmt(snap['divergence_incidents'])}")
     if session is not None:
         health = session.health()
         for key in sorted(health):
@@ -121,11 +153,12 @@ class MetricsServer:
         tracer=None,
         recorder=None,
         sentinel=None,
+        convergence=None,
     ) -> None:
         def metrics() -> str:
             return prometheus_text(
                 counters=counters, histograms=histograms,
-                session=session, sentinel=sentinel,
+                session=session, sentinel=sentinel, convergence=convergence,
             )
 
         def snapshot() -> str:
@@ -133,6 +166,7 @@ class MetricsServer:
                 health_snapshot(
                     counters=counters, session=session, sentinel=sentinel,
                     histograms=histograms, recorder=recorder,
+                    convergence=convergence,
                 ),
                 default=str,
             )
@@ -144,6 +178,11 @@ class MetricsServer:
         if tracer is not None:
             routes["/trace.json"] = (
                 lambda: json.dumps(tracer.chrome_trace()),
+                "application/json",
+            )
+        if convergence is not None:
+            routes["/convergence.json"] = (
+                lambda: json.dumps(convergence.snapshot()),
                 "application/json",
             )
         self._httpd = ThreadingHTTPServer((host, port), _Handler)

@@ -46,7 +46,8 @@ GLOBAL_COUNTERS = Counters()
 
 
 #: counter/histogram namespaces that make up the fault-domain health surface
-_HEALTH_PREFIXES = ("streaming.", "transport.", "supervisor.", "merge.", "jit.")
+_HEALTH_PREFIXES = ("streaming.", "transport.", "supervisor.", "merge.",
+                    "jit.", "convergence.")
 
 
 def health_snapshot(
@@ -55,11 +56,13 @@ def health_snapshot(
     sentinel=None,
     histograms=None,
     recorder=None,
+    convergence=None,
 ) -> Dict[str, Any]:
     """One structured dict for a fleet health endpoint: every fault-domain
     counter (quarantines, corrupt frames, transport retries / behind peers,
     supervisor rollbacks, guarded-merge fallbacks, per-jit-site compile
-    counts) and the fault-domain latency/size histogram percentiles, plus —
+    counts, convergence exchange/divergence tallies) and the fault-domain
+    latency/size histogram percentiles, plus —
     when a streaming session or its
     :class:`~..parallel.supervisor.GuardedSession` is given — that session's
     own ``health()`` (quarantine registry with typed reasons,
@@ -68,8 +71,11 @@ def health_snapshot(
     attached, its per-site compile counts appear under ``recompiles`` (the
     counter form lands under ``counters`` as ``jit.compiles.*`` either
     way); with a :class:`~.recorder.FlightRecorder`, its ring/dump summary
-    appears under ``flight_recorder``.  Everything in the snapshot is
-    JSON-serializable (the exporter-schema golden test pins this)."""
+    appears under ``flight_recorder``; with a
+    :class:`~.convergence.ConvergenceMonitor`, its per-peer lag watermarks
+    and divergence tallies appear under ``convergence``.  Everything in the
+    snapshot is JSON-serializable (the exporter-schema golden test pins
+    this)."""
     from .histograms import GLOBAL_HISTOGRAMS
 
     counters = counters or GLOBAL_COUNTERS
@@ -95,4 +101,6 @@ def health_snapshot(
         }
     if recorder is not None:
         out["flight_recorder"] = recorder.snapshot()
+    if convergence is not None:
+        out["convergence"] = convergence.snapshot()
     return out

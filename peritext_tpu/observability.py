@@ -9,6 +9,7 @@ New code should import from :mod:`peritext_tpu.obs` directly.
 from __future__ import annotations
 
 from .obs import (  # noqa: F401
+    ConvergenceMonitor,
     Counters,
     EventLog,
     FlightRecorder,
@@ -34,6 +35,7 @@ from .obs.metrics import _HEALTH_PREFIXES  # noqa: F401
 from .obs.sentinel import _COMPILE_MSG_RE  # noqa: F401
 
 __all__ = [
+    "ConvergenceMonitor",
     "Counters",
     "EventLog",
     "FlightRecorder",

@@ -54,14 +54,26 @@ _VERSION = 2
 #: so an old peer is never sent one), and ingest/storage normalize to v2
 #: via :func:`strip_trace_context`.  The context is telemetry only: it
 #: never reaches merge state, and stripping it yields byte-identical v2.
-_DECODABLE_VERSIONS = (1, 2, 3, 4, 5)
+#: v6 is a CHECKED v5: the same fixed trace-context field (all-zero when no
+#: trace is live), the same v2 body, plus a 4-byte CRC32 TRAILER over every
+#: preceding byte of the frame (header included).  The codec already rejects
+#: structurally invalid frames, but a bit flip that leaves the structure
+#: valid-looking used to be the transport's problem (ROADMAP "wire-frame
+#: checksum"); the trailer closes that gap for untrusted links — a mismatch
+#: raises :class:`DecodeError`, so quarantine attributes payload corruption
+#: precisely.  Like v5 it is caps-negotiated (sent only to peers advertising
+#: ``caps >= 6``) and normalizes to v5/v2 for ingest/storage.
+_DECODABLE_VERSIONS = (1, 2, 3, 4, 5, 6)
 _SESSION_VERSIONS = (3, 4)
 _VERSION_TRACED = 5
+_VERSION_CHECKED = 6
 _TRACE_CTX = struct.Struct("<QQ")  # trace id, parent span id
+_CRC = struct.Struct("<I")  # v6 CRC32 trailer
 #: transport capability level advertised in anti-entropy frontiers: the
 #: highest wire version this codec decodes (>= _VERSION_TRACED means the
-#: peer may send trace-context frames)
-WIRE_CAPS = 5
+#: peer may send trace-context frames; >= _VERSION_CHECKED additionally
+#: CRC-trailered ones)
+WIRE_CAPS = 6
 #: bounded inflate for v4: a legit frame body deflates ~2-4x, so cap the
 #: inflated size well above that but proportional to the wire bytes — a
 #: crafted bomb must not expand unboundedly.
@@ -655,15 +667,45 @@ def encode_frame_traced(changes: List[Change], trace_id: int,
     )
 
 
+def encode_frame_checked(changes: List[Change], trace_id: int = 0,
+                         span_id: int = 0) -> bytes:
+    """A v6 frame: :func:`encode_frame` output carrying the fixed trace
+    context (zeros = none live) plus a CRC32 trailer over every preceding
+    byte.  Send ONLY to a peer whose frontier advertised ``caps >= 6``."""
+    raw = encode_frame(changes)
+    magic, _, n_ch, n_str, n_ints, plen = _HEADER.unpack_from(raw)
+    body = (
+        _HEADER.pack(magic, _VERSION_CHECKED, n_ch, n_str, n_ints, plen)
+        + _TRACE_CTX.pack(int(trace_id) & 0xFFFFFFFFFFFFFFFF,
+                          int(span_id) & 0xFFFFFFFFFFFFFFFF)
+        + raw[_HEADER.size:]
+    )
+    return body + _CRC.pack(zlib.crc32(body) & 0xFFFFFFFF)
+
+
 def strip_trace_context(data: bytes):
     """``((trace_id, span_id) | None, self-contained v1/v2-style bytes)``.
 
-    Total function: anything that is not a well-formed v5 frame passes
+    Total function: anything that is not a well-formed v5/v6 frame passes
     through unchanged with a ``None`` context (downstream decode classifies
     corruption as usual), so ingest paths can call it unconditionally —
-    the storage/ingest format stays v1/v2, the context is telemetry."""
-    if (len(data) < _HEADER.size + _TRACE_CTX.size
-            or data[:4] != _MAGIC or data[4] != _VERSION_TRACED):
+    the storage/ingest format stays v1/v2, the context is telemetry.  A v6
+    frame whose CRC trailer mismatches ALSO passes through unchanged (still
+    version 6): the corruption surfaces as the decoder's typed
+    :class:`DecodeError`, never silently as a stripped-but-damaged v2."""
+    if len(data) < _HEADER.size + _TRACE_CTX.size or data[:4] != _MAGIC:
+        return None, data
+    if data[4] == _VERSION_CHECKED:
+        if (len(data) < _HEADER.size + _TRACE_CTX.size + _CRC.size
+                or _CRC.unpack_from(data, len(data) - _CRC.size)[0]
+                != zlib.crc32(data[:-_CRC.size]) & 0xFFFFFFFF):
+            return None, data  # corrupt: let the decoder raise DecodeError
+        ctx = _TRACE_CTX.unpack_from(data, _HEADER.size)
+        magic, _, n_ch, n_str, n_ints, plen = _HEADER.unpack_from(data)
+        plain = (_HEADER.pack(magic, 2, n_ch, n_str, n_ints, plen)
+                 + data[_HEADER.size + _TRACE_CTX.size:-_CRC.size])
+        return (ctx if ctx != (0, 0) else None), plain
+    if data[4] != _VERSION_TRACED:
         return None, data
     ctx = _TRACE_CTX.unpack_from(data, _HEADER.size)
     magic, _, n_ch, n_str, n_ints, plen = _HEADER.unpack_from(data)
@@ -910,9 +952,11 @@ def iter_frames(data: bytes):
         else:
             if version == 3:  # session base varint precedes the table
                 _, p = _read_varint(data, p)
-            elif version == _VERSION_TRACED:  # fixed trace-context field
-                p += _TRACE_CTX.size
+            elif version in (_VERSION_TRACED, _VERSION_CHECKED):
+                p += _TRACE_CTX.size  # fixed trace-context field
             end = _walk_string_table(data, p, n_strings) + payload_len
+            if version == _VERSION_CHECKED:
+                end += _CRC.size  # the CRC32 trailer rides inside the frame
         if end > len(data):
             raise DecodeError("truncated payload")
         yield data[pos:end]
@@ -1010,8 +1054,11 @@ def _frame_parts(data: bytes, start: int = 0, session_strings=None,
         raise ValueError("frame header counts exceed frame size")
 
     pos = start + _HEADER.size
-    if version == _VERSION_TRACED:
-        # traced v2: skip the fixed telemetry field, decode the v2 body
+    checked = version == _VERSION_CHECKED
+    if version in (_VERSION_TRACED, _VERSION_CHECKED):
+        # traced (v5) / checked (v6) v2: skip the fixed telemetry field,
+        # decode the v2 body; v6 additionally verifies its CRC trailer
+        # (after the body's end is located, below)
         if len(data) - pos < _TRACE_CTX.size:
             raise ValueError("truncated trace context")
         pos += _TRACE_CTX.size
@@ -1053,6 +1100,17 @@ def _frame_parts(data: bytes, start: int = 0, session_strings=None,
         if len(payload) != payload_len:
             raise ValueError("truncated payload")
         end = pos + payload_len
+    if checked:
+        # v6: the CRC32 trailer covers header + trace context + body; a
+        # mismatch is payload corruption, typed DecodeError via the
+        # normalization contract — undetectable bit flips no longer exist
+        # on checked links
+        if len(data) - end < _CRC.size:
+            raise ValueError("truncated checksum trailer")
+        if (_CRC.unpack_from(data, end)[0]
+                != zlib.crc32(data[start:end]) & 0xFFFFFFFF):
+            raise ValueError("frame checksum mismatch")
+        end += _CRC.size
     values = native.varint_decode(payload, n_ints) if native.available() else None
     if values is None:
         values = _py_varint_decode(payload, n_ints)

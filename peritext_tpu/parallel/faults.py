@@ -139,8 +139,8 @@ class FaultyPublisher(Publisher):
     contributes nothing, anti-entropy re-ships it later).
     """
 
-    def __init__(self, spec: FaultSpec, seed: int = 0) -> None:
-        super().__init__()
+    def __init__(self, spec: FaultSpec, seed: int = 0, monitor=None) -> None:
+        super().__init__(monitor=monitor)
         self.spec = spec
         self.rng = random.Random(seed)
         self.lost: Dict[str, List[List[Change]]] = {}
@@ -185,9 +185,17 @@ class FaultyPublisher(Publisher):
             if dropped:
                 self.lost.setdefault(key, []).append(dropped)
                 self.dropped_count += len(dropped)
+                if self.monitor is not None:
+                    # the lossy hop surfaces like a failed exchange: the
+                    # subscriber's failure count grows until redelivery
+                    self.monitor.observe_failure(
+                        key, error=f"dropped {len(dropped)} change(s)"
+                    )
             self.delivered_count += len(perturbed)
             if perturbed:
                 callback(perturbed)
+                if self.monitor is not None and not dropped:
+                    self.monitor.observe_success(key, pulled=len(perturbed))
 
     def redeliver_lost(self) -> int:
         """Re-deliver every recorded drop (faithfully, no new faults);
@@ -197,8 +205,13 @@ class FaultyPublisher(Publisher):
             callback = self._subscribers.get(key)
             if callback is None:
                 continue
+            redelivered = 0
             for batch in batches:
                 callback(list(batch))
-                count += len(batch)
+                redelivered += len(batch)
+            count += redelivered
+            if batches and self.monitor is not None:
+                # repair delivered: the subscriber's failure streak clears
+                self.monitor.observe_success(key, pulled=redelivered)
             self.lost[key] = []
         return count

@@ -63,6 +63,7 @@ from .codec import (
     WireSession,
     decode_frame,
     encode_frame,
+    encode_frame_checked,
     encode_frame_chunks,
     encode_frame_traced,
     iter_frames,
@@ -176,17 +177,33 @@ def _recv_message(sock: socket.socket) -> Tuple[bytes, bytes]:
 _META_CAPS = "\x00caps"
 _META_TRACE = "\x00trace"
 _META_SPAN = "\x00span"
-_META_KEYS = {_META_CAPS: "caps", _META_TRACE: "trace", _META_SPAN: "span"}
+#: convergence observability (round 4 of the wire): the sender's COMMUTATIVE
+#: store digest at the advertised frontier (ChangeStore.digest — the
+#: divergence probe: equal frontiers must carry equal digests), and the
+#: sender's own replica LISTENING port so the serving side can attribute the
+#: observation to a stable peer identity (peer-IP + advertised port) for its
+#: ConvergenceMonitor.  Both are ints, so old peers' {str: int} frontier
+#: validation accepts-and-ignores them like every other sentinel.
+_META_DIGEST = "\x00digest"
+_META_PORT = "\x00port"
+_META_KEYS = {_META_CAPS: "caps", _META_TRACE: "trace", _META_SPAN: "span",
+              _META_DIGEST: "digest", _META_PORT: "port"}
 
 
-def _frontier_meta(tracer, span) -> dict:
+def _frontier_meta(tracer, span, digest=None, port=None) -> dict:
     """The metadata this endpoint attaches to an outbound frontier: always
-    its wire caps; plus the current span's trace context when tracing is
-    live, so the peer's handler span can join OUR trace."""
+    its wire caps; the current span's trace context when tracing is live,
+    so the peer's handler span can join OUR trace; the store digest at the
+    advertised frontier (divergence probe); and, for endpoints that serve a
+    replica socket, the listening port (peer attribution)."""
     meta = {_META_CAPS: WIRE_CAPS}
     if span is not None and tracer is not None and tracer.active():
         meta[_META_TRACE] = int(span.trace_id)
         meta[_META_SPAN] = int(span.span_id)
+    if digest is not None:
+        meta[_META_DIGEST] = int(digest)
+    if port is not None:
+        meta[_META_PORT] = int(port)
     return meta
 
 
@@ -243,15 +260,20 @@ def _send_changes(sock: socket.socket, changes: List[Change],
     budget (the overwhelmingly common case, wire-identical to old peers),
     else MSG_CHANGES_MULTI: session-scoped (v4) chunks sharing one string
     dictionary + deflate — the string table and repeated attrs are paid once
-    per backlog, not once per chunk.  With a trace context AND a peer that
-    advertised ``caps >= WIRE_CAPS``, the single frame rides wire v5 so the
-    receiver's pipeline spans join the sender's trace (large MULTI backlogs
-    fall back to untraced chunks — the frontier already carried the
-    context)."""
-    from .codec import _ENCODE_CHUNK_CHARGE
+    per backlog, not once per chunk.  Single-frame version negotiation, by
+    the peer's advertised caps: ``caps >= 6`` rides wire v6 (CRC32-checked,
+    trace context embedded when one is live); a ``caps == 5`` peer with a
+    live trace context gets v5 (traced, unchecked — its maximum); everyone
+    else gets plain v2.  Large MULTI backlogs fall back to untraced v3/v4
+    chunks — the frontier already carried the context."""
+    from .codec import _ENCODE_CHUNK_CHARGE, _VERSION_TRACED
 
     if sum(1 + len(c.deps or {}) for c in changes) <= _ENCODE_CHUNK_CHARGE:
-        if ctx is not None and peer_caps >= WIRE_CAPS:
+        if peer_caps >= WIRE_CAPS:
+            frame = encode_frame_checked(
+                changes, *(ctx if ctx is not None else (0, 0))
+            )
+        elif ctx is not None and peer_caps >= _VERSION_TRACED:
             frame = encode_frame_traced(changes, ctx.trace_id, ctx.span_id)
         else:
             frame = encode_frame(changes)
@@ -336,6 +358,7 @@ class ReplicaServer:
         tracer=None,
         recorder=None,
         metrics_port: Optional[int] = None,
+        monitor=None,
     ) -> None:
         """``on_changes`` receives each batch of newly-merged decoded
         changes; ``on_frame`` receives the RAW inbound frame bytes whenever
@@ -348,10 +371,17 @@ class ReplicaServer:
         Observability: ``tracer`` (default the process tracer) produces
         anti-entropy spans that join a traced peer's trace via the
         wire-carried context; ``recorder`` gets a ``fault`` record on
-        transport give-ups (``try_sync_with``); ``metrics_port`` (0 =
-        ephemeral) mounts an :class:`~..obs.MetricsServer` exposing
-        ``/metrics`` (Prometheus), ``/health.json`` and ``/trace.json`` —
-        its bound address is :attr:`metrics_address` after :meth:`start`."""
+        transport give-ups (``try_sync_with``) and divergence incidents;
+        ``monitor`` (default: a fresh
+        :class:`~..obs.convergence.ConvergenceMonitor`) ingests every
+        frontier this server exchanges, inbound and outbound, maintaining
+        per-peer lag watermarks and divergence probes; ``metrics_port``
+        (0 = ephemeral) mounts an :class:`~..obs.MetricsServer` exposing
+        ``/metrics`` (Prometheus, with ``peritext_convergence_*`` gauges),
+        ``/health.json``, ``/convergence.json`` and ``/trace.json`` — its
+        bound address is :attr:`metrics_address` after :meth:`start`."""
+        from ..obs import ConvergenceMonitor
+
         self.store = store
         self.on_changes = on_changes
         self.on_frame = on_frame
@@ -364,6 +394,9 @@ class ReplicaServer:
         self._sock.bind((host, port))
         self._sock.listen()
         self.address: Tuple[str, int] = self._sock.getsockname()
+        self.monitor = monitor if monitor is not None else ConvergenceMonitor(
+            host=f"{self.address[0]}:{self.address[1]}", recorder=recorder,
+        )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.metrics = None
@@ -375,6 +408,7 @@ class ReplicaServer:
                 self.metrics = MetricsServer(
                     host=host, port=metrics_port,
                     tracer=self.tracer, recorder=self.recorder,
+                    convergence=self.monitor,
                 )
             except OSError:
                 # metrics port unavailable: release the already-bound
@@ -417,6 +451,7 @@ class ReplicaServer:
     def sync_with(
         self, host: str, port: int, timeout: Optional[float] = None,
         retry: Optional[RetryPolicy] = None,
+        peer_name: Optional[str] = None,
     ) -> Tuple[int, int]:
         """Outbound anti-entropy round sharing this server's store lock, so a
         node that serves peers and pulls from peers concurrently stays
@@ -425,10 +460,13 @@ class ReplicaServer:
             self.store, host, port,
             on_changes=self.on_changes, timeout=timeout, lock=self._lock,
             on_frame=self.on_frame, retry=retry, tracer=self.tracer,
+            monitor=self.monitor, advertise_port=self.address[1],
+            peer_name=peer_name,
         )
 
     def try_sync_with(
         self, host: str, port: int, retry: Optional[RetryPolicy] = None,
+        peer_name: Optional[str] = None,
     ) -> SyncOutcome:
         """Non-raising outbound round: terminal transport failure becomes a
         ``behind`` outcome for the next anti-entropy pass."""
@@ -436,7 +474,8 @@ class ReplicaServer:
             self.store, host, port,
             on_changes=self.on_changes, lock=self._lock,
             on_frame=self.on_frame, retry=retry, tracer=self.tracer,
-            recorder=self.recorder,
+            recorder=self.recorder, monitor=self.monitor,
+            advertise_port=self.address[1], peer_name=peer_name,
         )
 
     def _serve_one(self, conn: socket.socket) -> None:
@@ -444,6 +483,18 @@ class ReplicaServer:
             with conn:
                 conn.settimeout(self.timeout)
                 peer_clock, meta = _parse_frontier(_expect(conn, MSG_FRONTIER))
+                # peer attribution for the convergence monitor: a frontier
+                # that advertised the sender's replica port names a stable
+                # identity (peer IP + that port); bare clients (no replica
+                # socket) stay anonymous and are not tracked
+                peer_name = None
+                if "port" in meta:
+                    try:
+                        peer_name = (
+                            f"{conn.getpeername()[0]}:{int(meta['port'])}"
+                        )
+                    except OSError:
+                        peer_name = None
                 # the peer's frontier carried its trace context: this
                 # handler's span (and every child span it opens — ingest,
                 # merge) joins the PEER's trace, so a two-host exchange
@@ -453,7 +504,17 @@ class ReplicaServer:
                 ) as sp:
                     with self._lock:
                         my_clock = self.store.clock()
+                        my_digest = self.store.digest(my_clock)
                         outbound = self.store.missing_changes(my_clock, peer_clock)
+                    if peer_name is not None and self.monitor is not None:
+                        # inbound frontiers count too: under an asymmetric
+                        # partition (we can hear but not dial), this is how
+                        # the host still learns how far behind it is
+                        self.monitor.observe_frontier(
+                            peer_name, my_clock, peer_clock,
+                            local_digest=my_digest,
+                            peer_digest=meta.get("digest"),
+                        )
                     # chunked: a large backlog splits into multiple frames so
                     # no single frame approaches the peer's decode dep budget
                     _send_changes(
@@ -461,7 +522,10 @@ class ReplicaServer:
                         ctx=sp.context if self.tracer.active() else None,
                     )
                     _send_frontier(
-                        conn, my_clock, meta=_frontier_meta(self.tracer, sp)
+                        conn, my_clock, meta=_frontier_meta(
+                            self.tracer, sp, digest=my_digest,
+                            port=self.address[1],
+                        )
                     )
                     # the frame-level ctx is redundant HERE: this handler
                     # span already adopted the same context from the peer's
@@ -473,6 +537,11 @@ class ReplicaServer:
                     )
                     with self._lock:
                         fresh = merge_changes(self.store, inbound)
+                    if peer_name is not None and self.monitor is not None:
+                        self.monitor.observe_success(
+                            peer_name, pulled=len(fresh),
+                            pushed=len(outbound),
+                        )
                     sp.args.update(pulled=len(fresh), pushed=len(outbound))
                     if fresh:
                         # on_frame first: consumers that ingest via on_frame
@@ -502,6 +571,9 @@ def _sync_once(
     lock: threading.Lock,
     want_frames: bool,
     tracer,
+    monitor=None,
+    advertise_port: Optional[int] = None,
+    peer_name: Optional[str] = None,
 ) -> Tuple[List[Change], int, List[bytes], Optional[TraceContext]]:
     """One attempt of the bidirectional exchange (see :func:`sync_with`).
     The store mutates only AFTER the socket closes cleanly, so a failed
@@ -510,17 +582,36 @@ def _sync_once(
     peer's frame-carried trace context — on_frame/on_changes delivery
     happens in the CALLER, outside the retried region: a callback failure
     after a successful merge is a local error, and retrying it would skip
-    the callbacks entirely (the reconnect pulls only duplicates)."""
+    the callbacks entirely (the reconnect pulls only duplicates).
+
+    A ``monitor`` (:class:`~..obs.convergence.ConvergenceMonitor`) ingests
+    the peer's frontier AS SOON AS IT PARSES — before the exchange
+    completes — so an attempt that dies mid-transfer (slow link, stall)
+    still updates the peer's lag watermark with what the frontier taught
+    us."""
     with tracer.span("anti-entropy.sync", peer=f"{host}:{port}") as sp:
         with socket.create_connection((host, port), timeout=timeout) as sock:
             sock.settimeout(timeout)  # per-socket deadline on every send/recv
             with lock:
                 my_clock = store.clock()
+                my_digest = store.digest(my_clock)
             # the frontier carries our caps + this span's trace context, so
-            # the peer's handler span joins THIS trace (cross-host spans)
-            _send_frontier(sock, my_clock, meta=_frontier_meta(tracer, sp))
+            # the peer's handler span joins THIS trace (cross-host spans);
+            # plus the store digest at this frontier (divergence probe) and
+            # our replica port when we serve one (peer attribution)
+            _send_frontier(sock, my_clock, meta=_frontier_meta(
+                tracer, sp, digest=my_digest, port=advertise_port,
+            ))
             inbound, frames, in_ctx = _recv_changes(sock, want_frames=want_frames)
             peer_clock, meta = _parse_frontier(_expect(sock, MSG_FRONTIER))
+            if monitor is not None:
+                # telemetry only, observed against the PRE-merge snapshot:
+                # both frontiers are pre-exchange positions, so the
+                # clock-delta sums are this round's true lag watermarks
+                monitor.observe_frontier(
+                    peer_name or f"{host}:{port}", my_clock, peer_clock,
+                    local_digest=my_digest, peer_digest=meta.get("digest"),
+                )
             with lock:
                 outbound = store.missing_changes(store.clock(), peer_clock)
             _send_changes(
@@ -551,6 +642,9 @@ def sync_with(
     on_frame: Optional[Callable[[bytes], None]] = None,
     retry: Optional[RetryPolicy] = None,
     tracer=None,
+    monitor=None,
+    advertise_port: Optional[int] = None,
+    peer_name: Optional[str] = None,
 ) -> Tuple[int, int]:
     """One full bidirectional anti-entropy round against a peer.
 
@@ -583,11 +677,18 @@ def sync_with(
         try:
             fresh, pushed, frames, in_ctx = _sync_once(
                 store, host, port, deadline, lock, on_frame is not None,
-                tracer,
+                tracer, monitor=monitor, advertise_port=advertise_port,
+                peer_name=peer_name,
             )
         except _RETRYABLE as exc:
             last = exc
             continue
+        if monitor is not None:
+            # the pull merged: the observed lag drained, staleness resets
+            monitor.observe_success(
+                peer_name or f"{host}:{port}", pulled=len(fresh),
+                pushed=pushed,
+            )
         if fresh:
             # delivery runs after the sync span closed (outside the retried
             # region), so the peer's FRAME-carried context is what links the
@@ -621,6 +722,9 @@ def try_sync_with(
     retry: Optional[RetryPolicy] = None,
     tracer=None,
     recorder=None,
+    monitor=None,
+    advertise_port: Optional[int] = None,
+    peer_name: Optional[str] = None,
 ) -> SyncOutcome:
     """Anti-entropy round that NEVER raises on transport failure: a peer
     that stays unreachable through the retry budget yields a ``behind``
@@ -657,12 +761,20 @@ def try_sync_with(
         pulled, pushed = sync_with(
             store, host, port, on_changes=_fenced(on_changes),
             lock=lock, on_frame=_fenced(on_frame), retry=policy,
-            tracer=tracer,
+            tracer=tracer, monitor=monitor, advertise_port=advertise_port,
+            peer_name=peer_name,
         )
     except _CallbackFailed as exc:
         raise exc.__cause__
     except (TransportError, DecodeError) as exc:
         GLOBAL_COUNTERS.add("transport.behind_peers")
+        if monitor is not None:
+            # the behind state is no longer forgotten: the monitor keeps the
+            # peer's last lag estimate and grows its staleness/failure
+            # counts — the gossip scheduler's healing priority inputs
+            monitor.observe_failure(
+                peer_name or f"{host}:{port}", error=str(exc)
+            )
         if recorder is not None:
             # transport give-up: the flight recorder turns "that peer was
             # behind all soak" into a post-mortem with the attempts' spans

@@ -16,8 +16,16 @@ T = TypeVar("T")
 
 
 class Publisher(Generic[T]):
-    def __init__(self) -> None:
+    """``monitor`` (optional, a
+    :class:`~..obs.convergence.ConvergenceMonitor`) gives the in-process
+    transport the same per-peer observability surface as the multihost
+    one: every delivery records a clean exchange per subscriber, so a
+    fleet view renders editor-bridge subscribers next to TCP peers (the
+    faulty test double additionally records drops as failures)."""
+
+    def __init__(self, monitor=None) -> None:
         self._subscribers: Dict[str, Callable[[T], None]] = {}
+        self.monitor = monitor
 
     def subscribe(self, key: str, callback: Callable[[T], None]) -> None:
         if key in self._subscribers:
@@ -36,4 +44,6 @@ class Publisher(Generic[T]):
             for key, callback in sorted(self._subscribers.items()):
                 if key != sender:
                     callback(update)
+                    if self.monitor is not None:
+                        self.monitor.observe_success(key)
         GLOBAL_COUNTERS.add("transport.pubsub_published")

@@ -650,17 +650,20 @@ class StreamingMerge:
         if on_corrupt not in ("raise", "quarantine"):
             raise ValueError(f"unknown on_corrupt mode: {on_corrupt!r}")
         items = list(items)
-        # Traced (v5) transport frames normalize to the self-contained v2
-        # storage form here — durable history and the native parser only
-        # ever see v1/v2 — and the wire-carried context links this host's
-        # ingest span into the SENDING host's trace.
+        # Traced (v5) / checked (v6) transport frames normalize to the
+        # self-contained v2 storage form here — durable history and the
+        # native parser only ever see v1/v2 — and the wire-carried context
+        # links this host's ingest span into the SENDING host's trace.  A
+        # v6 frame whose CRC fails passes through UNCHANGED (identity) and
+        # is rejected as corrupt by the per-doc decode below, preserving
+        # per-doc fault isolation.
         ctx: Optional[TraceContext] = None
         for j, (d, data) in enumerate(items):
             c, plain = strip_trace_context(data)
-            if c is not None:
+            if plain is not data:
                 items[j] = (d, plain)
-                if ctx is None:
-                    ctx = TraceContext(*c)
+            if c is not None and ctx is None:
+                ctx = TraceContext(*c)
         with self.tracer.span("streaming.ingest", ctx=ctx, frames=len(items)):
             self._ingest_items(items, on_corrupt)
 
@@ -2033,28 +2036,56 @@ class StreamingMerge:
 
         ``assignment`` maps each logical doc to a target shard (len
         ``num_docs``); default balances per-shard LIVE SLOT load greedily
-        (largest doc first onto the least-loaded shard with a free row).
-        Shards are ``mesh.size`` for mesh sessions, else the read-block
-        count (balancing per-block read/digest latency).  Returns
-        ``{"moved": n, "shard_load": [...]}``."""
+        (largest doc first onto the least-loaded shard with a free row),
+        with quarantine-aware placement: quarantined/fallback docs are
+        HOST-BOUND (scalar replay runs on the shard's host CPU, not its
+        chip), so the default assignment additionally spreads their load —
+        a host-bound doc goes to the shard carrying the least host-bound
+        load first, slot load second, while device docs weigh slot load
+        first — keeping a burst of scalar-replay docs from crowding one
+        shard's host.  Shards are ``mesh.size`` for mesh sessions, else the
+        read-block count (balancing per-block read/digest latency).
+        Returns ``{"moved": n, "shard_load": [...],
+        "host_bound_load": [...]}``."""
         n_blocks = -(-self._padded_docs // self._read_chunk)
         n_shards = self.mesh.size if self.mesh is not None else n_blocks
         if n_shards <= 1 or self.num_docs == 0:
-            return {"moved": 0, "shard_load": [0] * max(n_shards, 1)}
+            return {"moved": 0, "shard_load": [0] * max(n_shards, 1),
+                    "host_bound_load": [0] * max(n_shards, 1)}
         if self._padded_docs % n_shards:
             raise ValueError("padded doc axis must divide the shard count")
         rows_per_shard = self._padded_docs // n_shards
         sizes = np.asarray(self.state.num_slots)[self._row_of[: self.num_docs]]
+        host_bound = {
+            d for d in range(self.num_docs)
+            if self.docs[d].fallback or d in self._quarantine
+        }
         if assignment is None:
-            order = sorted(range(self.num_docs), key=lambda d: -int(sizes[d]))
+            # host-bound docs place FIRST (they are the scarce dimension:
+            # row capacity must not strand the last of them on a crowded
+            # host), then device docs, each group largest-first
+            order = sorted(
+                range(self.num_docs),
+                key=lambda d: (d not in host_bound, -int(sizes[d])),
+            )
             load = [0] * n_shards
+            hb_load = [0] * n_shards
             free = [rows_per_shard] * n_shards
             assignment = [0] * self.num_docs
             for d in order:
-                s = min((s for s in range(n_shards) if free[s] > 0),
-                        key=lambda s: load[s])
+                # host-bound (quarantined/fallback scalar-replay) docs cost
+                # the shard's HOST, not its chip: balance that dimension
+                # first for them, second for device docs, so neither the
+                # chips nor one host's CPU becomes the round bound
+                key = (
+                    (lambda s: (hb_load[s], load[s])) if d in host_bound
+                    else (lambda s: (load[s], hb_load[s]))
+                )
+                s = min((s for s in range(n_shards) if free[s] > 0), key=key)
                 assignment[d] = s
                 load[s] += int(sizes[d])
+                if d in host_bound:
+                    hb_load[s] += int(sizes[d])
                 free[s] -= 1
         else:
             assignment = [int(s) for s in assignment]
@@ -2100,9 +2131,13 @@ class StreamingMerge:
             self._apply_blocks = None
             self._placement_epoch += 1
         shard_load = [0] * n_shards
+        host_bound_load = [0] * n_shards
         for d, s in enumerate(assignment):
             shard_load[s] += int(sizes[d])
-        return {"moved": moved, "shard_load": shard_load}
+            if d in host_bound:
+                host_bound_load[s] += int(sizes[d])
+        return {"moved": moved, "shard_load": shard_load,
+                "host_bound_load": host_bound_load}
 
     def _digest_tables_rows(self, rows: np.ndarray, n_real: int):
         """Digest hash tables for a GATHERED row subset (the sub-batch

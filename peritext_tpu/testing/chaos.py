@@ -34,12 +34,15 @@ import json
 import random
 import socket
 import tempfile
+import threading
+import time
 from dataclasses import asdict, dataclass
 from pathlib import Path
-from typing import Dict, List
+from typing import Dict, List, Tuple
 
 from ..api.batch import _oracle_doc
 from ..core.errors import DeviceRoundError
+from ..core.types import Change
 from ..parallel.codec import encode_frame
 from ..parallel.faults import FaultSpec, corrupt_detectably
 from ..parallel.streaming import REASON_DECODE, REASON_DEVICE_ROUND
@@ -339,6 +342,434 @@ def run_chaos(
     finally:
         tmp.cleanup()
     return report
+
+
+# ---------------------------------------------------------------------------
+# N-host fleet chaos: per-link fault schedules + lag-ordered healing
+# ---------------------------------------------------------------------------
+
+
+class _LinkGate:
+    """A DIRECTED TCP gate for one fleet link i→j: host i dials the gate,
+    the gate forwards to host j's real replica socket according to its
+    current mode.
+
+    * ``open``    — transparent proxy;
+    * ``closed``  — accepts and immediately closes (a hard partition: the
+      dialer sees a reset/EOF and fails fast);
+    * ``rx_only`` — ASYMMETRIC partition: bytes flow dialer→target but the
+      target's replies are blackholed.  The target still hears the dialer's
+      frontier (how a host keeps learning its lag while unreachable); the
+      dialer times out waiting for the response;
+    * ``slow``    — transparent but each chunk is delayed ``delay`` seconds
+      in both directions (a congested/slow link: exchanges succeed,
+      slowly).
+
+    Mode changes apply to NEW connections (each accept snapshots the mode),
+    which is exactly a per-round fault schedule's granularity.
+    """
+
+    def __init__(self, target: Tuple[str, int], mode: str = "open",
+                 delay: float = 0.02) -> None:
+        self.target = target
+        self.mode = mode
+        self.delay = delay
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(16)
+        self.address = self._sock.getsockname()
+        self._stop = False
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def set_mode(self, mode: str) -> None:
+        assert mode in ("open", "closed", "rx_only", "slow"), mode
+        self.mode = mode
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            mode = self.mode
+            if mode == "closed":
+                conn.close()
+                continue
+            threading.Thread(
+                target=self._bridge, args=(conn, mode), daemon=True
+            ).start()
+
+    def _bridge(self, conn: socket.socket, mode: str) -> None:
+        try:
+            upstream = socket.create_connection(self.target, timeout=5)
+        except OSError:
+            conn.close()
+            return
+        delay = self.delay if mode == "slow" else 0.0
+
+        def pump(src: socket.socket, dst: socket.socket,
+                 blackhole: bool) -> None:
+            try:
+                while True:
+                    data = src.recv(65536)
+                    if not data:
+                        break
+                    if delay:
+                        time.sleep(delay)
+                    if not blackhole:
+                        dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                for s in (src, dst):
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+
+        up = threading.Thread(
+            target=pump, args=(conn, upstream, False), daemon=True
+        )
+        up.start()
+        pump(upstream, conn, mode == "rx_only")
+        up.join(timeout=10)
+
+
+def _fleet_change(actor: str, seq: int) -> "Change":
+    """One synthetic map-op change (fast codec path, cheap to mint at fleet
+    volumes)."""
+    from ..core.opids import ROOT
+    from ..core.types import Operation
+
+    return Change(
+        actor=actor, seq=seq,
+        deps={actor: seq - 1} if seq > 1 else {}, start_op=seq,
+        ops=[Operation(action="set", obj=ROOT, opid=(seq, actor),
+                       key="n", value=seq)],
+    )
+
+
+def _append_changes(store, actor: str, n: int) -> int:
+    start = len(store.log(actor)) + 1
+    for seq in range(start, start + n):
+        store.append(_fleet_change(actor, seq))
+    return n
+
+
+@dataclass
+class FleetReport:
+    """Evidence from one N-host fleet partition/heal episode (all oracles
+    already held — a violated oracle raises instead of returning)."""
+
+    seed: int
+    hosts: int
+    partition_rounds: int = 0
+    #: host0's per-peer observed lag at heal time (monitor watermarks)
+    observed_lag: Dict[str, int] = None
+    #: the store-truth lag at the same instant (the acceptance instrument:
+    #: monitor numbers must EQUAL these)
+    expected_lag: Dict[str, int] = None
+    #: host0's first post-heal round order (must follow behind-ness)
+    heal_order: List[str] = None
+    lag_gauge_seen: bool = False
+    heal_rounds: int = 0
+    ops_drained: int = 0
+    heal_seconds: float = 0.0
+    converged: bool = False
+    final_digest: int = 0
+    divergence_incidents: int = 0
+
+    def to_json(self) -> Dict:
+        return asdict(self)
+
+
+def run_fleet_chaos(
+    seed: int,
+    hosts: int = 4,
+    base_ops: int = 8,
+    flap_link: bool = True,
+    metrics: bool = True,
+) -> FleetReport:
+    """One N-host fleet episode: converge a fleet, impose an asymmetric
+    partition with per-link fault schedules (host0 can hear inbound
+    frontiers but every reply and outbound dial is cut; one healthy link
+    flaps; the heal leaves the largest-lag link slow), then heal and assert
+
+    * host0's convergence monitor learned its TRUE per-peer lag (equal to
+      the store-derived clock-delta sums) through the partition;
+    * ``peritext_convergence_lag_ops`` was visible in host0's ``/metrics``
+      during the episode (when ``metrics``);
+    * host0's first post-heal gossip round followed behind-ness priority
+      (most-behind peer first);
+    * the fleet drained to IDENTICAL fleet-wide store digests and clocks.
+
+    Raises on any violation; returns the evidence report."""
+    from ..parallel.anti_entropy import ChangeStore
+    from ..parallel.gossip import GossipScheduler
+    from ..parallel.multihost import ReplicaServer, RetryPolicy
+
+    rng = random.Random(seed ^ 0xF1EE7)
+    assert hosts >= 3, "a fleet episode needs at least 3 hosts"
+    report = FleetReport(seed=seed, hosts=hosts)
+    policy = RetryPolicy(attempts=1, timeout=0.5)
+
+    stores = [ChangeStore() for _ in range(hosts)]
+    servers = [
+        ReplicaServer(stores[i], timeout=2.0,
+                      metrics_port=0 if (metrics and i == 0) else None)
+        for i in range(hosts)
+    ]
+    for s in servers:
+        s.start()
+    names = [f"{s.address[0]}:{s.address[1]}" for s in servers]
+    # one directed gate per ordered pair: host i dials gate[(i, j)]
+    gates = {
+        (i, j): _LinkGate(servers[j].address)
+        for i in range(hosts) for j in range(hosts) if i != j
+    }
+    scheds = [
+        GossipScheduler(servers[i], retry=policy)
+        for i in range(hosts)
+    ]
+    for i in range(hosts):
+        for j in range(hosts):
+            if i != j:
+                scheds[i].add_peer(*gates[(i, j)].address, name=names[j])
+
+    try:
+        # -- phase A: converge the healthy fleet ---------------------------
+        for i in range(hosts):
+            _append_changes(stores[i], f"host{i}", base_ops + i)
+        for _ in range(2):
+            for sched in scheds:
+                sched.round()
+        assert all(s.clock() == stores[0].clock() for s in stores), (
+            "healthy fleet failed to converge"
+        )
+
+        # -- phase B: asymmetric partition + per-link schedules ------------
+        # host0: outbound dials cut, inbound replies blackholed (it HEARS
+        # every peer's frontier, can repair nothing); peers cut from each
+        # other except one flapping 1<->2 link
+        for (i, j), gate in gates.items():
+            if j == 0:
+                gate.set_mode("rx_only")
+            else:
+                gate.set_mode("closed")
+        partition_rounds = 3
+        for r in range(partition_rounds):
+            if flap_link:
+                flap = "open" if r % 2 == 0 else "closed"
+                gates[(1, 2)].set_mode(flap)
+                gates[(2, 1)].set_mode(flap)
+            for j in range(1, hosts):
+                _append_changes(
+                    stores[j], f"host{j}", 3 + 2 * j + rng.randrange(3)
+                )
+            _append_changes(stores[0], "host0", 2 + rng.randrange(3))
+            for sched in scheds[1:]:
+                sched.round()
+            scheds[0].round()  # every dial fails: backoff exercised
+        report.partition_rounds = partition_rounds
+        if flap_link:
+            gates[(1, 2)].set_mode("closed")
+            gates[(2, 1)].set_mode("closed")
+        # final appends DOMINATE the flap cross-merge, so per-peer lags are
+        # strictly ordered: host j ends (200 * j) ops ahead of anything a
+        # flapped link could have equalized
+        for j in range(1, hosts):
+            _append_changes(stores[j], f"host{j}", 200 * j)
+        for sched in scheds[1:]:
+            # one more rx_only dial: host0 hears the FINAL frontiers (wake
+            # first — the peers' own backoff would otherwise skip the dial)
+            sched.wake()
+            sched.round()
+
+        # monitor truth oracle: host0's watermarks == store-derived lag
+        from ..obs.convergence import clock_delta_ops
+
+        clock0 = stores[0].clock()
+        report.expected_lag = {
+            names[j]: clock_delta_ops(clock0, stores[j].clock())
+            for j in range(1, hosts)
+        }
+        peers0 = servers[0].monitor.peers()
+        report.observed_lag = {
+            names[j]: peers0[names[j]].ops_behind for j in range(1, hosts)
+        }
+        assert report.observed_lag == report.expected_lag, (
+            f"seed={seed}: monitor watermarks {report.observed_lag} != "
+            f"store truth {report.expected_lag}"
+        )
+        assert len(set(report.observed_lag.values())) == hosts - 1, (
+            "per-peer lags must be distinct for the priority oracle"
+        )
+
+        # the lag gauges are LIVE during the episode
+        if metrics:
+            import urllib.request
+
+            mh, mp = servers[0].metrics_address
+            text = urllib.request.urlopen(
+                f"http://{mh}:{mp}/metrics", timeout=5
+            ).read().decode()
+            gauge_lines = [
+                ln for ln in text.splitlines()
+                if ln.startswith("peritext_convergence_lag_ops{")
+            ]
+            assert gauge_lines and any(
+                float(ln.rsplit(" ", 1)[1]) > 0 for ln in gauge_lines
+            ), "lag gauge absent or all-zero during the partition"
+            report.lag_gauge_seen = True
+
+        # -- phase C: heal — most-behind-first drain -----------------------
+        for gate in gates.values():
+            gate.set_mode("open")
+        # the largest-lag link stays SLOW: priority still reaches it first
+        gates[(0, hosts - 1)].set_mode("slow")
+        t0 = time.perf_counter()
+        scheds[0].wake()
+        results = scheds[0].round()
+        report.heal_order = list(scheds[0].last_round_order)
+        expected_order = [
+            name for name, _ in sorted(
+                report.expected_lag.items(), key=lambda kv: (-kv[1], kv[0])
+            )
+        ]
+        assert report.heal_order == expected_order, (
+            f"seed={seed}: heal order {report.heal_order} does not follow "
+            f"behind-ness priority {expected_order}"
+        )
+        assert all(out.ok for _, out in results), (
+            f"seed={seed}: healed links still failing: {results}"
+        )
+        report.ops_drained = sum(out.pulled + out.pushed for _, out in results)
+        # remaining hosts drain (host0's round already fanned most of it)
+        rounds = 1
+        for _ in range(8):
+            if all(s.clock() == stores[0].clock() for s in stores):
+                break
+            for sched in scheds[1:]:
+                sched.wake()
+                for _, out in sched.round():
+                    if out.ok:
+                        report.ops_drained += out.pulled + out.pushed
+            rounds += 1
+        report.heal_seconds = time.perf_counter() - t0
+        report.heal_rounds = rounds
+
+        # -- fleet-wide convergence oracle ---------------------------------
+        clocks = [s.clock() for s in stores]
+        digests = [s.digest() for s in stores]
+        assert all(c == clocks[0] for c in clocks), (
+            f"seed={seed}: clocks diverged after heal"
+        )
+        assert all(d == digests[0] for d in digests), (
+            f"seed={seed}: digests diverged after heal: {digests}"
+        )
+        report.converged = True
+        report.final_digest = digests[0]
+        report.divergence_incidents = sum(
+            len(s.monitor.divergence_incidents) for s in servers
+        )
+        assert report.divergence_incidents == 0, (
+            "a lag-only episode must never probe divergent"
+        )
+    finally:
+        for gate in gates.values():
+            gate.close()
+        for s in servers:
+            s.stop()
+    return report
+
+
+def run_divergence_injection(seed: int, dump_dir=None) -> Dict:
+    """Seeded same-frontier/different-digest injection: two stores hold the
+    SAME vector clock but one change's content differs (a corrupt merge —
+    the split-brain failure convergence digests exist to catch).  The
+    exchange must classify as a DIVERGENCE incident — counter + latched
+    peer flag + flight-recorder dump — never as plain lag.  Returns the
+    evidence (asserts already held)."""
+    from ..obs import ConvergenceMonitor, FlightRecorder, GLOBAL_COUNTERS
+    from ..parallel.anti_entropy import ChangeStore
+    from ..parallel.multihost import ReplicaServer, RetryPolicy
+
+    rng = random.Random(seed ^ 0xD1FF)
+    n = 4 + rng.randrange(4)
+    victim = 1 + rng.randrange(n)
+    a, b = ChangeStore(), ChangeStore()
+    for seq in range(1, n + 1):
+        ch = _fleet_change("shared", seq)
+        a.append(ch)
+        if seq == victim:
+            # same (actor, seq, deps) — different op content
+            from ..core.opids import ROOT
+            from ..core.types import Operation
+
+            ch = Change(
+                actor=ch.actor, seq=ch.seq, deps=ch.deps,
+                start_op=ch.start_op,
+                ops=[Operation(action="set", obj=ROOT,
+                               opid=(ch.start_op, ch.actor),
+                               key="n", value=-ch.seq)],
+            )
+        b.append(ch)
+    assert a.clock() == b.clock() and a.digest() != b.digest()
+
+    recorder = FlightRecorder(
+        capacity=64, dump_dir=dump_dir, min_dump_interval=0.0,
+    ) if dump_dir is not None else None
+    monitor = ConvergenceMonitor(host="injector", recorder=recorder)
+    before = GLOBAL_COUNTERS.get("convergence.divergence_incidents")
+    server = ReplicaServer(b)
+    host, port = server.start()
+    try:
+        from ..parallel.multihost import try_sync_with
+
+        outcome = try_sync_with(
+            a, host, port, retry=RetryPolicy(attempts=1, timeout=2.0),
+            monitor=monitor,
+        )
+    finally:
+        server.stop()
+    peer = f"{host}:{port}"
+    rec = monitor.peer(peer)
+    assert rec.divergent, "same-frontier/different-digest must latch divergent"
+    assert rec.last_outcome != "lag", "divergence must never classify as lag"
+    assert monitor.divergence_incidents, "incident record missing"
+    incident = monitor.divergence_incidents[0]
+    assert incident.local_digest != incident.peer_digest
+    assert GLOBAL_COUNTERS.get("convergence.divergence_incidents") > before
+    evidence = {
+        "seed": seed,
+        "peer": peer,
+        "outcome_ok": outcome.ok,
+        "local_digest": incident.local_digest,
+        "peer_digest": incident.peer_digest,
+        "counter_incremented": True,
+        "dump": None,
+    }
+    if recorder is not None:
+        assert recorder.last_dump_path is not None, (
+            "divergence must auto-dump the flight ring"
+        )
+        dump = Path(recorder.last_dump_path)
+        records = [json.loads(line) for line in
+                   dump.read_text().splitlines() if line]
+        assert any(
+            r.get("kind") == "fault" and r.get("reason") == "divergence"
+            for r in records
+        ), "flight dump lacks the divergence fault record"
+        evidence["dump"] = str(dump)
+    return evidence
 
 
 def run_campaign(

@@ -557,7 +557,8 @@ class TestGuardedMergeAndHealth:
         snap = health_snapshot(session=guarded)
         assert "counters" in snap
         assert all(
-            k.split(".")[0] in ("streaming", "transport", "supervisor", "merge")
+            k.split(".")[0] in ("streaming", "transport", "supervisor",
+                                "merge", "convergence")
             for k in snap["counters"]
         )
         q = snap["session"]["quarantined"]
@@ -578,6 +579,26 @@ class TestChaosHarness:
         assert report.delivered_frames > 0
         assert report.transport_repaired
         assert report.crash_restores == 1
+
+    def test_fleet_partition_heals_in_lag_order(self):
+        """ISSUE 4 acceptance: a 4-host fleet under an asymmetric partition
+        (host0 hears frontiers, every reply cut; one link flapping) with a
+        slow link at heal converges to identical fleet-wide digests, host0's
+        monitor watermarks equal the store-derived truth, the
+        ``peritext_convergence_lag_ops`` gauge is live in ``/metrics``
+        during the episode, and the first post-heal gossip round follows
+        behind-ness priority.  All oracles assert inside the harness."""
+        from peritext_tpu.testing.chaos import run_fleet_chaos
+
+        report = run_fleet_chaos(0, hosts=4)
+        assert report.converged
+        assert report.lag_gauge_seen
+        assert report.observed_lag == report.expected_lag
+        # most-behind-first: the order is the lag sort, descending
+        lags = [report.expected_lag[name] for name in report.heal_order]
+        assert lags == sorted(lags, reverse=True) and len(lags) == 3
+        assert report.ops_drained > 0
+        assert report.divergence_incidents == 0
 
     @pytest.mark.slow
     def test_chaos_soak_twenty_seeds(self):

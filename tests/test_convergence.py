@@ -1,0 +1,541 @@
+"""Convergence observability (ISSUE 4): lag-watermark arithmetic, the
+divergence-vs-lag classifier, store frontier digests, the gossip scheduler's
+behind-ness priority + backoff, wire v6 CRC frames, the exporter surfaces
+(``/convergence.json`` + ``peritext_convergence_*`` gauges, golden shape),
+and the fleet CLI view."""
+
+import json
+import urllib.request
+
+import pytest
+
+from peritext_tpu.core.errors import DecodeError
+from peritext_tpu.core.opids import ROOT
+from peritext_tpu.core.types import Change, Operation
+from peritext_tpu.obs import (
+    ConvergenceMonitor,
+    FlightRecorder,
+    GLOBAL_COUNTERS,
+    MetricsServer,
+    health_snapshot,
+    prometheus_text,
+)
+from peritext_tpu.obs.convergence import (
+    CONVERGED,
+    DIVERGENCE,
+    LAG,
+    clock_delta_ops,
+    clocks_equal,
+)
+from peritext_tpu.parallel.anti_entropy import ChangeStore, change_digest
+from peritext_tpu.parallel.gossip import GossipScheduler
+from peritext_tpu.parallel.multihost import ReplicaServer, RetryPolicy
+
+
+def _change(actor, seq, value=None):
+    return Change(
+        actor=actor, seq=seq, deps={actor: seq - 1} if seq > 1 else {},
+        start_op=seq,
+        ops=[Operation(action="set", obj=ROOT, opid=(seq, actor), key="n",
+                       value=seq if value is None else value)],
+    )
+
+
+def _fill(store, actor, n):
+    for seq in range(1, n + 1):
+        store.append(_change(actor, seq))
+
+
+# ---------------------------------------------------------------------------
+# lag-watermark arithmetic
+# ---------------------------------------------------------------------------
+
+
+class TestWatermarkArithmetic:
+    def test_clock_delta_ops_sums_only_deficits(self):
+        local = {"a": 5, "b": 2}
+        peer = {"a": 3, "b": 9, "c": 4}
+        # behind on b by 7 and c by 4; a is AHEAD and contributes nothing
+        assert clock_delta_ops(local, peer) == 11
+        assert clock_delta_ops(peer, local) == 2
+        assert clock_delta_ops(local, local) == 0
+
+    def test_clocks_equal_ignores_zero_entries(self):
+        assert clocks_equal({"a": 3, "b": 0}, {"a": 3})
+        assert not clocks_equal({"a": 3}, {"a": 4})
+
+    def test_observe_frontier_classifies_lag(self):
+        m = ConvergenceMonitor(host="t")
+        got = m.observe_frontier("p", {"a": 1}, {"a": 4, "b": 2})
+        assert got == LAG
+        rec = m.peer("p")
+        assert rec.ops_behind == 5 and rec.ops_ahead == 0
+        assert rec.peak_ops_behind == 5 and not rec.divergent
+
+    def test_observe_success_drains_and_resets_staleness(self):
+        m = ConvergenceMonitor(host="t")
+        m.observe_frontier("p", {"a": 1}, {"a": 4})
+        for _ in range(3):
+            m.advance_round()
+        assert m.peer("p").staleness(m.rounds) == 3
+        m.observe_success("p", pulled=3)
+        rec = m.peer("p")
+        assert rec.ops_behind == 0 and rec.staleness(m.rounds) == 0
+        assert m.total_lag_ops() == 0
+
+    def test_failures_accumulate_and_staleness_grows(self):
+        m = ConvergenceMonitor(host="t")
+        m.observe_frontier("p", {"a": 1}, {"a": 4})
+        for _ in range(4):
+            m.advance_round()
+            m.observe_failure("p", error="refused")
+        rec = m.peer("p")
+        assert rec.failures == 4
+        assert rec.ops_behind == 3  # the estimate survives the failures
+        assert rec.last_error == "refused"  # the WHY rides the watermarks
+        assert rec.staleness(m.rounds) == m.rounds  # never cleanly exchanged
+        assert m.behindness("p") == (3, 4)
+        m.observe_success("p")
+        assert m.peer("p").last_error is None  # a clean exchange clears it
+
+    def test_never_seen_peer_is_maximally_stale(self):
+        m = ConvergenceMonitor(host="t")
+        for _ in range(7):
+            m.advance_round()
+        assert m.behindness("ghost") == (0, 7)
+
+
+# ---------------------------------------------------------------------------
+# divergence vs lag
+# ---------------------------------------------------------------------------
+
+
+class TestDivergenceProbe:
+    def test_same_frontier_same_digest_is_converged(self):
+        m = ConvergenceMonitor(host="t")
+        got = m.observe_frontier(
+            "p", {"a": 3}, {"a": 3}, local_digest=7, peer_digest=7
+        )
+        assert got == CONVERGED and not m.peer("p").divergent
+
+    def test_same_frontier_different_digest_is_divergence_not_lag(self):
+        rec = FlightRecorder(capacity=16)
+        m = ConvergenceMonitor(host="t", recorder=rec)
+        before = GLOBAL_COUNTERS.get("convergence.divergence_incidents")
+        got = m.observe_frontier(
+            "p", {"a": 3}, {"a": 3}, local_digest=7, peer_digest=8
+        )
+        assert got == DIVERGENCE
+        assert m.peer("p").divergent and m.peer("p").last_outcome == DIVERGENCE
+        assert m.divergent_peers() == ["p"]
+        assert GLOBAL_COUNTERS.get("convergence.divergence_incidents") == before + 1
+        (incident,) = m.divergence_incidents
+        assert (incident.local_digest, incident.peer_digest) == (7, 8)
+        # the recorder saw the fault record (ring; no dump_dir configured)
+        assert any(
+            e["kind"] == "fault" and e["reason"] == "divergence"
+            for e in rec.entries()
+        )
+
+    def test_different_frontiers_never_probe_divergent(self):
+        m = ConvergenceMonitor(host="t")
+        got = m.observe_frontier(
+            "p", {"a": 1}, {"a": 3}, local_digest=7, peer_digest=8
+        )
+        assert got == LAG and not m.peer("p").divergent
+
+    def test_missing_digest_downgrades_to_frontier_compare(self):
+        m = ConvergenceMonitor(host="t")
+        assert m.observe_frontier("p", {"a": 3}, {"a": 3}) == CONVERGED
+
+    def test_end_to_end_injection_counter_and_flight_dump(self, tmp_path):
+        from peritext_tpu.testing.chaos import run_divergence_injection
+
+        evidence = run_divergence_injection(3, dump_dir=tmp_path)
+        assert evidence["counter_incremented"]
+        assert evidence["dump"] is not None
+
+
+# ---------------------------------------------------------------------------
+# store frontier digests
+# ---------------------------------------------------------------------------
+
+
+class TestStoreDigest:
+    def test_digest_is_merge_order_independent(self):
+        a, b = ChangeStore(), ChangeStore()
+        for actor in ("x", "y", "z"):
+            _fill(a, actor, 5)
+        for actor in ("z", "x", "y"):  # different arrival order
+            _fill(b, actor, 5)
+        assert a.clock() == b.clock()
+        assert a.digest() == b.digest()
+
+    def test_digest_at_frontier_prefixes(self):
+        a = ChangeStore()
+        _fill(a, "x", 6)
+        partial = ChangeStore()
+        _fill(partial, "x", 3)
+        assert a.digest({"x": 3}) == partial.digest()
+        assert a.digest({"x": 3}) != a.digest()
+        assert a.digest({}) == 0
+        # a frontier past the log clamps to what the store holds
+        assert a.digest({"x": 99}) == a.digest()
+
+    def test_content_difference_changes_digest(self):
+        a, b = ChangeStore(), ChangeStore()
+        a.append(_change("x", 1, value=1))
+        b.append(_change("x", 1, value=2))
+        assert a.clock() == b.clock()
+        assert a.digest() != b.digest()
+        assert change_digest(a.log("x")[0]) != change_digest(b.log("x")[0])
+
+
+# ---------------------------------------------------------------------------
+# gossip scheduler: priority + backoff
+# ---------------------------------------------------------------------------
+
+
+class _StubServer:
+    """Scripted try_sync_with outcomes, no sockets."""
+
+    def __init__(self, monitor, fail=()):
+        from peritext_tpu.parallel.multihost import SyncOutcome
+
+        self.monitor = monitor
+        self.fail = set(fail)
+        self.calls = []
+        self._outcome = SyncOutcome
+
+    def try_sync_with(self, host, port, retry=None, peer_name=None):
+        name = peer_name or f"{host}:{port}"
+        self.calls.append(name)
+        if name in self.fail:
+            self.monitor.observe_failure(name, "scripted failure")
+            return self._outcome(ok=False, error="scripted failure")
+        self.monitor.observe_success(name)
+        return self._outcome(pulled=1, pushed=1)
+
+
+class TestGossipScheduler:
+    def test_round_order_is_most_behind_first(self):
+        m = ConvergenceMonitor(host="t")
+        m.observe_frontier("a", {}, {"x": 5})    # 5 behind
+        m.observe_frontier("b", {}, {"x": 50})   # 50 behind
+        m.observe_frontier("c", {}, {"x": 20})   # 20 behind
+        server = _StubServer(m)
+        sched = GossipScheduler(server, monitor=m)
+        for name in ("a", "b", "c"):
+            sched.add_peer("127.0.0.1", 1, name=name)
+        sched.round()
+        assert sched.last_round_order == ["b", "c", "a"]
+        assert server.calls == ["b", "c", "a"]
+
+    def test_staleness_breaks_lag_ties(self):
+        m = ConvergenceMonitor(host="t")
+        m.observe_frontier("young", {}, {"x": 5})
+        m.observe_success("young")  # clean now; staleness 0 afterwards
+        m.observe_frontier("old", {}, {"x": 5})
+        for _ in range(3):
+            m.advance_round()
+        m.observe_frontier("young", {}, {"x": 5})
+        m.observe_frontier("old", {}, {"x": 5})
+        server = _StubServer(m, fail={"young", "old"})
+        sched = GossipScheduler(server, monitor=m)
+        sched.add_peer("127.0.0.1", 1, name="young")
+        sched.add_peer("127.0.0.1", 2, name="old")
+        assert sched.plan() == ["old", "young"]  # equal lag: staler first
+
+    def test_failed_peers_back_off_exponentially_and_wake_clears(self):
+        m = ConvergenceMonitor(host="t")
+        server = _StubServer(m, fail={"dead"})
+        sched = GossipScheduler(server, monitor=m)
+        sched.add_peer("127.0.0.1", 1, name="dead")
+        sched.add_peer("127.0.0.1", 2, name="live")
+        sched.round()  # r1: dead fails -> 2-round skip window
+        sched.round()  # r2: dead skipped
+        assert server.calls.count("dead") == 1
+        sched.round()  # r3: retried, fails again -> 4-round window
+        assert server.calls.count("dead") == 2
+        for _ in range(3):
+            sched.round()  # r4-r6: inside the wider window
+        assert server.calls.count("dead") == 2
+        assert server.calls.count("live") == 6  # full cadence throughout
+        sched.wake()  # the heal signal skips the rest of the window
+        sched.round()
+        assert server.calls.count("dead") == 3
+        snap = sched.snapshot()
+        assert snap["peers"]["dead"]["backed_off"] is True
+        json.dumps(snap)
+
+    def test_drain_stops_when_fleet_is_clean(self):
+        m = ConvergenceMonitor(host="t")
+        server = _StubServer(m)
+        sched = GossipScheduler(server, monitor=m)
+        sched.add_peer("127.0.0.1", 1, name="a")
+        assert sched.drain(max_rounds=10) == 1
+
+    def test_real_servers_converge_through_scheduler(self):
+        a, b = ChangeStore(), ChangeStore()
+        _fill(a, "hostA", 10)
+        _fill(b, "hostB", 30)
+        sa, sb = ReplicaServer(a), ReplicaServer(b)
+        sa.start()
+        hb, pb = sb.start()
+        try:
+            sched = GossipScheduler(
+                sa, retry=RetryPolicy(attempts=1, timeout=2.0)
+            )
+            sched.add_peer(hb, pb)
+            rounds = sched.drain(max_rounds=4)
+        finally:
+            sa.stop()
+            sb.stop()
+        assert rounds <= 2
+        assert a.clock() == b.clock() and a.digest() == b.digest()
+
+
+# ---------------------------------------------------------------------------
+# in-process transports feed the same surface
+# ---------------------------------------------------------------------------
+
+
+class TestInProcessHooks:
+    def test_local_sync_observes_frontiers_and_success(self):
+        from peritext_tpu.core.doc import Doc
+        from peritext_tpu.parallel.anti_entropy import sync
+
+        store = ChangeStore()
+        left, right = Doc("L"), Doc("R")
+        change, _ = left.change([
+            {"path": [], "action": "makeList", "key": "text"},
+        ])
+        store.append(change)
+        m = ConvergenceMonitor(host="local")
+        sync(left, right, store, monitor=m)
+        assert m.peer("right").exchanges == 1
+        assert m.peer("right").ops_behind == 0  # success drained it
+        assert right.clock == left.clock
+
+    def test_faulty_publisher_records_drops_and_repair(self):
+        from peritext_tpu.parallel.faults import FaultSpec, FaultyPublisher
+
+        m = ConvergenceMonitor(host="pubsub")
+        pub = FaultyPublisher(FaultSpec(drop_p=1.0, reorder=False),
+                              seed=3, monitor=m)
+        seen = []
+        pub.subscribe("sub", seen.extend)
+        pub.publish("writer", [_change("writer", 1)])
+        assert not seen
+        assert m.peer("sub").failures == 1
+        pub.redeliver_lost()
+        assert seen and m.peer("sub").failures == 0
+
+    def test_clean_publisher_records_success(self):
+        from peritext_tpu.parallel.pubsub import Publisher
+
+        m = ConvergenceMonitor(host="pubsub")
+        pub = Publisher(monitor=m)
+        pub.subscribe("a", lambda _: None)
+        pub.publish("writer", [_change("writer", 1)])
+        assert m.peer("a").exchanges == 0  # success-only path: no frontier
+        assert m.peer("a").last_outcome == "converged"
+
+
+# ---------------------------------------------------------------------------
+# wire v6: CRC32 trailer
+# ---------------------------------------------------------------------------
+
+
+class TestWireV6:
+    def _changes(self):
+        return [_change("actor", seq) for seq in range(1, 9)]
+
+    def test_checked_roundtrip_and_strip(self):
+        from peritext_tpu.parallel.codec import (
+            decode_frame, encode_frame, encode_frame_checked,
+            strip_trace_context,
+        )
+
+        chs = self._changes()
+        plain = encode_frame(chs)
+        checked = encode_frame_checked(chs)
+        assert checked[4] == 6 and len(checked) == len(plain) + 16 + 4
+        assert decode_frame(checked) == chs
+        ctx, stripped = strip_trace_context(checked)
+        assert ctx is None and stripped == plain
+
+    def test_checked_carries_trace_context(self):
+        from peritext_tpu.parallel.codec import (
+            decode_frame_traced, encode_frame_checked, strip_trace_context,
+        )
+
+        checked = encode_frame_checked(self._changes(), 0xFEED, 21)
+        assert decode_frame_traced(checked)[1] == (0xFEED, 21)
+        ctx, _ = strip_trace_context(checked)
+        assert ctx == (0xFEED, 21)
+
+    def test_every_bitflip_is_detected(self):
+        """The satellite's point: with the CRC trailer there is no longer
+        such a thing as an undetectable bit flip — every mutation raises
+        the typed DecodeError, so quarantine attributes payload corruption
+        precisely."""
+        import random
+
+        from peritext_tpu.parallel.codec import (
+            decode_frame, encode_frame_checked,
+        )
+        from peritext_tpu.parallel.faults import FaultSpec, perturb_frame
+
+        frame = encode_frame_checked(self._changes())
+        rng = random.Random(11)
+        spec = FaultSpec(truncate_p=0.3, bitflip_p=0.9)
+        mutated = 0
+        for _ in range(300):
+            bad = perturb_frame(frame, rng, spec)
+            if bad is frame:
+                continue
+            mutated += 1
+            with pytest.raises(DecodeError):
+                decode_frame(bad)
+        assert mutated > 100, "mutator produced no corruption; vacuous"
+
+    def test_corrupt_checked_frame_quarantines_with_decode_reason(self):
+        from peritext_tpu.parallel.codec import encode_frame_checked
+        from peritext_tpu.parallel.streaming import REASON_DECODE
+        from peritext_tpu.testing.fuzz import _campaign_session, generate_workload
+
+        workload = generate_workload(seed=19, num_docs=1, ops_per_doc=20)[0]
+        changes = [ch for log in workload.values() for ch in log]
+        frame = bytearray(encode_frame_checked(changes))
+        frame[len(frame) // 2] ^= 0x10  # one flipped bit mid-body
+        sess = _campaign_session(1, 20)
+        sess.ingest_frame(0, bytes(frame), on_corrupt="quarantine")
+        assert sess.quarantined()[0].reason == REASON_DECODE
+        # clean redelivery (checked wire) repairs and re-admits
+        sess.ingest_frame(0, encode_frame_checked(changes))
+        sess.drain()
+        assert 0 not in sess.quarantined()
+
+    def test_caps_negotiation_sends_v6_to_new_v5_to_traced_old(self):
+        import socket as socketlib
+
+        from peritext_tpu.obs import TraceContext
+        from peritext_tpu.parallel.codec import decode_frame
+        from peritext_tpu.parallel.multihost import _recv_message, _send_changes
+
+        chs = self._changes()
+        ctx = TraceContext(0x123, 9)
+        for caps, ctx_in, version in (
+            (0, ctx, 2), (4, ctx, 2), (5, ctx, 5), (5, None, 2),
+            (6, ctx, 6), (6, None, 6),
+        ):
+            a, b = socketlib.socketpair()
+            try:
+                _send_changes(a, chs, peer_caps=caps, ctx=ctx_in)
+                _, body = _recv_message(b)
+                assert body[4] == version, f"caps={caps} ctx={ctx_in}"
+                assert decode_frame(body) == chs
+            finally:
+                a.close()
+                b.close()
+
+
+# ---------------------------------------------------------------------------
+# exporter surfaces: gauges, /convergence.json, health composition, CLI
+# ---------------------------------------------------------------------------
+
+
+#: exporter-schema pins — drift breaks fleet scrapers, so it must be a
+#: deliberate, test-visible change
+GOLDEN_CONVERGENCE_KEYS = {
+    "host", "rounds", "peers", "total_lag_ops", "divergence_incidents",
+    "divergent_peers",
+}
+GOLDEN_PEER_KEYS = {
+    "ops_behind", "ops_ahead", "peak_ops_behind", "staleness_rounds",
+    "exchanges", "failures", "divergent", "last_outcome", "last_error",
+}
+
+
+class TestConvergenceExporters:
+    def _monitor(self):
+        m = ConvergenceMonitor(host="exp-test")
+        m.observe_frontier("peer-1", {"a": 1}, {"a": 4})
+        m.observe_frontier("peer-2", {"a": 1}, {"a": 1},
+                           local_digest=1, peer_digest=2)
+        m.advance_round()
+        m.observe_failure("peer-1", "refused")
+        return m
+
+    def test_snapshot_golden_shape(self):
+        snap = self._monitor().snapshot()
+        assert set(snap) == GOLDEN_CONVERGENCE_KEYS
+        for peer_rec in snap["peers"].values():
+            assert set(peer_rec) == GOLDEN_PEER_KEYS
+        assert snap["total_lag_ops"] == 3
+        assert snap["divergence_incidents"] == 1
+        assert snap["divergent_peers"] == ["peer-2"]
+        json.dumps(snap)
+
+    def test_health_snapshot_composes_convergence(self):
+        snap = health_snapshot(convergence=self._monitor())
+        assert set(snap["convergence"]) == GOLDEN_CONVERGENCE_KEYS
+        assert any(
+            k.startswith("convergence.") for k in snap["counters"]
+        ), "convergence counters missing from the health namespace"
+        json.dumps(snap)
+
+    def test_prometheus_gauges(self):
+        text = prometheus_text(convergence=self._monitor())
+        assert '# TYPE peritext_convergence_lag_ops gauge' in text
+        assert 'peritext_convergence_lag_ops{peer="peer-1"} 3' in text
+        assert 'peritext_convergence_staleness_rounds{peer="peer-1"} 1' in text
+        assert 'peritext_convergence_divergence_incidents_total 1' in text
+        assert 'peritext_convergence_total_lag_ops 3' in text
+        for line in text.splitlines():
+            assert line.startswith("#") or len(line.split(" ")) == 2
+
+    def test_metrics_server_convergence_endpoint(self):
+        server = MetricsServer(convergence=self._monitor())
+        host, port = server.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/convergence.json"
+            ) as resp:
+                snap = json.loads(resp.read())
+                assert set(snap) == GOLDEN_CONVERGENCE_KEYS
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics"
+            ) as resp:
+                assert b"peritext_convergence_lag_ops" in resp.read()
+        finally:
+            server.stop()
+
+    def test_fleet_cli_renders_and_flags_lag(self, tmp_path, capsys):
+        from peritext_tpu.obs.__main__ import main as obs_main
+
+        path = tmp_path / "conv.json"
+        path.write_text(json.dumps(self._monitor().snapshot()))
+        # nested form (a health.json scrape) parses too
+        nested = tmp_path / "health.json"
+        nested.write_text(json.dumps(
+            {"convergence": self._monitor().snapshot()}
+        ))
+        assert obs_main(["fleet", str(path), str(nested)]) == 1  # lag: exit 1
+        out = capsys.readouterr().out
+        assert "peer-1" in out and "lag_ops" in out and "YES" in out
+        assert obs_main(["fleet", str(path), "--json"]) == 1
+        rows = json.loads(capsys.readouterr().out)
+        assert rows["rows"][0]["peer"] == "peer-1"
+        assert rows["divergence_incidents"] == 1
+
+    def test_fleet_cli_converged_exit_zero(self, tmp_path, capsys):
+        from peritext_tpu.obs.__main__ import main as obs_main
+
+        m = ConvergenceMonitor(host="clean")
+        m.observe_frontier("p", {"a": 1}, {"a": 1})
+        path = tmp_path / "conv.json"
+        path.write_text(json.dumps(m.snapshot()))
+        assert obs_main(["fleet", str(path)]) == 0
+        assert obs_main(["fleet", str(tmp_path / "missing.json")]) == 2

@@ -306,7 +306,8 @@ class TestHealthSnapshotShape:
             assert {"count", "p50", "p95", "p99"} <= set(entry)
         json.dumps(snap, default=str)  # one JSON document, end to end
         # fault-domain namespacing holds across every surface
-        prefixes = ("streaming.", "transport.", "supervisor.", "merge.", "jit.")
+        prefixes = ("streaming.", "transport.", "supervisor.", "merge.",
+                    "jit.", "convergence.")
         assert all(k.startswith(prefixes) for k in snap["counters"])
         assert all(k.startswith(prefixes) for k in snap["histograms"])
 

@@ -408,6 +408,29 @@ class TestReshard:
         flat.drain()
         assert flat.digest() == s.digest()
 
+    def test_reshard_spreads_quarantined_docs_across_hosts(self):
+        """Quarantine-aware placement (ROADMAP): scalar-replay (host-bound)
+        docs must not crowd one shard's host — the default assignment
+        balances their load as its own dimension."""
+        workloads = self._skewed(seed=31)
+        s = StreamingMerge(num_docs=8, actors=ACTORS, read_chunk=2,
+                           round_insert_capacity=256,
+                           round_delete_capacity=128, round_mark_capacity=128)
+        for d, w in enumerate(workloads):
+            s.ingest(d, [ch for log in w.values() for ch in log])
+        s.drain()
+        for d in (0, 1, 2, 3):  # a burst of demotions, biggest docs included
+            s.force_fallback(d, detail="test demotion")
+        before_digest, before_reads = s.digest(), s.read_all()
+        r = s.reshard()
+        # 4 host-bound docs over 4 shards: every shard carries exactly one
+        # (no host runs two scalar replays while another runs none)
+        assert all(load > 0 for load in r["host_bound_load"]), r
+        assert sum(r["host_bound_load"]) <= sum(r["shard_load"])
+        # placement stays invisible to reads and digests
+        assert s.digest() == before_digest == s.digest(refresh=True)
+        assert s.read_all() == before_reads
+
     def test_reshard_explicit_assignment_and_validation(self):
         workloads = self._skewed(seed=21)
         s = StreamingMerge(num_docs=8, actors=ACTORS, read_chunk=2,
