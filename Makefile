@@ -7,7 +7,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test fuzz fuzz-differential fuzz-frames fuzz-crash chaos weak-scaling \
 	bench bench-smoke bench-streaming entry dryrun lint lint-baseline clean obs \
-	fleet
+	fleet perf-gate
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -60,6 +60,15 @@ bench-streaming:
 
 bench-engine:  # device-only streaming replay: the engine limit vs the link
 	$(PY) bench.py --mode engine
+
+# perf-regression gate (mirrors the CI perf-gate job): CPU mini-ladder with
+# devprof sampling appended to a scratch copy of the committed reference
+# ledger, then gated with per-row tolerance bands (exit 1 on regression)
+perf-gate:
+	cp perf/reference_ledger.jsonl /tmp/pt-perf-gate.jsonl
+	PT_BENCH_LADDER_ROWS="streaming,wire" $(PY) bench.py --mode ladder \
+		--smoke --platform cpu --devprof --ledger /tmp/pt-perf-gate.jsonl
+	$(PY) -m peritext_tpu.obs perf /tmp/pt-perf-gate.jsonl --gate
 
 entry:
 	$(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); \

@@ -225,7 +225,7 @@ def run(args) -> dict:
     # sync on a small output — i.e. per-op latency, = apply compute + the
     # platform's fixed dispatch+sync round trip.  Through the axon tunnel
     # that fixed term measured 0.08-0.11 s round 5 (scripts/
-    # engine_profile2.py, dispatch+fetch of an 8-int program), and it
+    # engine_profile.py --fine, dispatch+fetch of an 8-int program), and it
     # varies with tunnel load — so this field tracks LINK latency, while
     # apply_seconds (back-to-back enqueue, one sync) tracks the chip.  The
     # r2->r4 drift 0.032->0.149 s was the tunnel term, not a kernel
@@ -529,6 +529,27 @@ def _worker_argv(extra):
     return [sys.executable, os.path.abspath(__file__), "--_worker", *extra]
 
 
+def _append_ledger(path, rows, config, platform, devprof=None):
+    """Append one perf-ledger record (obs/ledger.py) built from bench rows.
+
+    Device fingerprinting here must NOT import jax — the orchestrator
+    process deliberately never initializes a backend (a dead axon tunnel
+    hangs it) — so the key is the measured rows' platform + host cores."""
+    from peritext_tpu.obs import ledger as _ledger
+
+    device = {"platform": platform, "kind": platform, "cpus": os.cpu_count()}
+    record = _ledger.ledger_record(
+        rows, config=config, devprof=devprof, device=device,
+    )
+    try:
+        _ledger.append_record(path, record)
+    except OSError as exc:  # an unwritable ledger must not cost the record
+        print(f"bench: perf-ledger append failed: {exc}", file=sys.stderr)
+        return
+    print(f"bench: appended perf-ledger record ({len(rows)} row(s)) -> {path}",
+          file=sys.stderr)
+
+
 def orchestrate(args, passthrough) -> int:
     """Probe → run worker under timeout → always print one JSON line.
 
@@ -572,6 +593,21 @@ def orchestrate(args, passthrough) -> int:
         if rc == 0 and result is not None:
             result.update(extras)
             print(json.dumps(result))
+            if args.ledger:
+                row = dict(result)
+                devprof = row.pop("devprof", None)
+                row.setdefault("row", args.mode)
+                # the EFFECTIVE sizing, not the requested one: the CPU
+                # fallback silently reruns the smoke config, and recording
+                # it under the full-run config would split one history in
+                # two and fire spurious `missing` verdicts
+                smoke = args.smoke or extras.get("fallback_config") == "smoke"
+                _append_ledger(
+                    args.ledger, [row],
+                    config=args.mode + ("-smoke" if smoke else ""),
+                    platform=row.get("platform") or platform,
+                    devprof={row["row"]: devprof} if devprof else None,
+                )
             return 0
         status = "timed out" if rc is None else f"rc={rc}"
         tail = (err or out).strip()[-1500:]
@@ -622,8 +658,8 @@ def run_engine(args) -> dict:
     ~0.1 s amortized away), and ``engine_pass_seconds`` is the single-pass
     latency including that round trip (what one isolated
     ingest->converge->digest costs).  Round-5 attribution measured the old
-    single-pass number as ~1/3 fixed tunnel RTT (scripts/engine_profile2
-    .py), which is a property of the link, not the engine."""
+    single-pass number as ~1/3 fixed tunnel RTT (scripts/engine_profile.py
+    --fine), which is a property of the link, not the engine."""
     import jax
 
     if args.platform:
@@ -1097,6 +1133,8 @@ def orchestrate_ladder(args) -> int:
         worker_args = list(rargs)
         if args.smoke:
             worker_args.append("--smoke")
+        if args.devprof:
+            worker_args.append("--devprof")
         if args.iters != 10:  # explicit --mode ladder may shape the workers
             worker_args += ["--iters", str(args.iters)]
         if args.seed:
@@ -1164,6 +1202,23 @@ def orchestrate_ladder(args) -> int:
         record["sidecar"] = os.path.basename(SIDECAR)
     except OSError as exc:  # unwritable sidecar dir must not cost the line
         print(f"bench: sidecar write failed: {exc}", file=sys.stderr)
+    if args.ledger:
+        # perf-ledger emission: ladder rows (devprof snapshots lifted out of
+        # the rows and keyed per row) appended as ONE record for the
+        # regression gate (python -m peritext_tpu.obs perf --gate)
+        devprof_map = {}
+        ledger_rows = []
+        for r in rows:
+            r = dict(r)
+            snap = r.pop("devprof", None)
+            if snap is not None:
+                devprof_map[r.get("row")] = snap
+            ledger_rows.append(r)
+        _append_ledger(
+            args.ledger, ledger_rows,
+            config="ladder" + ("-smoke" if args.smoke else ""),
+            platform=platform, devprof=devprof_map or None,
+        )
     print(json.dumps(record))
     print(json.dumps(compact_record(record)))
     return 0 if headline or all_ok else 1
@@ -1259,6 +1314,17 @@ def main() -> None:
              "trace-event JSON to PATH (streaming mode)",
     )
     parser.add_argument(
+        "--devprof", action="store_true",
+        help="enable device-cost profiling (obs/devprof.py: XLA cost/memory "
+             "introspection + bucket occupancy) for the measured rows; the "
+             "snapshot lands in the row JSON and the perf ledger",
+    )
+    parser.add_argument(
+        "--ledger", default=None, metavar="PATH",
+        help="append the run's rows (+ devprof snapshots) to the JSONL perf "
+             "ledger at PATH; gate with `python -m peritext_tpu.obs perf`",
+    )
+    parser.add_argument(
         "--_worker", action="store_true", dest="worker", help=argparse.SUPPRESS
     )
     args = parser.parse_args()
@@ -1310,7 +1376,17 @@ def main() -> None:
     runners = {"streaming": run_streaming, "engine": run_engine, "batch": run,
                "wire": run_wire, "sweep": run_sweep, "baselines": run_baselines,
                "fleet": run_fleet_heal}
-    print(json.dumps(runners[args.mode](args)))
+    if args.devprof:
+        # arm the process profiler before any jit dispatches; cost capture
+        # on — the worker is a bounded measurement run, and the AOT
+        # captures happen once per compiled shape
+        from peritext_tpu.obs import GLOBAL_DEVPROF
+
+        GLOBAL_DEVPROF.enable(capture_costs=True)
+    result = runners[args.mode](args)
+    if args.devprof:
+        result["devprof"] = GLOBAL_DEVPROF.snapshot()
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

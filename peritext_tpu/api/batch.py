@@ -25,7 +25,14 @@ import numpy as np
 
 from ..core.doc import Doc
 from ..core.types import Change, FormatSpan
-from ..obs import GLOBAL_COUNTERS, GLOBAL_HISTOGRAMS, GLOBAL_TRACER, MergeStats
+from ..obs import (
+    GLOBAL_COUNTERS,
+    GLOBAL_DEVPROF,
+    GLOBAL_HISTOGRAMS,
+    GLOBAL_TRACER,
+    MergeStats,
+    occupancy_key,
+)
 from ..ops.decode import decode_block_spans
 from ..ops.encode import EncodedBatch, encode_workloads
 from ..ops.kernel import apply_batch, apply_batch_jit, encoded_arrays_of
@@ -262,6 +269,21 @@ class DocBatch:
         stats.padding_efficiency = (
             float(encoded.num_ops.sum()) / stream_capacity if stream_capacity else 0.0
         )
+        if GLOBAL_DEVPROF.enabled:
+            # one-shot batch merges land in the same bucket-occupancy table
+            # as streaming rounds, keyed by their padded stream widths
+            GLOBAL_DEVPROF.observe_round(
+                occupancy_key(
+                    encoded.num_docs,
+                    encoded.ins_op.shape[1],
+                    encoded.del_target.shape[1],
+                    next(iter(encoded.marks.values())).shape[1],
+                    next(iter(encoded.map_ops.values())).shape[1],
+                ),
+                int(encoded.num_ops.sum()), stream_capacity,
+                origin="batch.merge",
+            )
+            GLOBAL_DEVPROF.sample_memory()
         GLOBAL_COUNTERS.add("merge.calls")
         GLOBAL_COUNTERS.add("merge.device_ops", device_ops)
         GLOBAL_COUNTERS.add("merge.fallback_docs", len(fallback))

@@ -21,6 +21,15 @@ every streaming-perf PR is judged by.  Four cooperating pieces:
   different commutative store digest = a first-class incident) fed by
   every anti-entropy frontier exchange; the behind-states the
   ``parallel/gossip.py`` healing scheduler consumes.
+* :mod:`.devprof` — the DEVICE-facing layer the host-side telemetry above
+  cannot provide: per-jit-site / per-shape-bucket XLA cost and memory
+  introspection (``cost_analysis``/``memory_analysis`` of the compiled
+  merge executables), bucket-occupancy accounting (real vs padded ops per
+  padded-shape bucket) and round-boundary device-memory watermarks.  Off
+  by default; ``GLOBAL_DEVPROF.enable()`` arms every hook in the stack.
+* :mod:`.ledger` — the append-only JSONL perf history (bench ladder rows +
+  devprof snapshots keyed by git sha / device / config) behind
+  ``python -m peritext_tpu.obs perf`` and the CI perf-gate job.
 * :mod:`.exporters` — Prometheus text exposition and JSON snapshot
   endpoints (:class:`MetricsServer`, mounted by ``ReplicaServer``:
   ``/metrics`` with ``peritext_convergence_*`` gauges, ``/health.json``,
@@ -37,6 +46,12 @@ determinism contract stays machine-checkable.
 """
 
 from .convergence import ConvergenceMonitor, DivergenceIncident, PeerLag
+from .devprof import (
+    DeviceProfiler,
+    GLOBAL_DEVPROF,
+    note_jit_dispatch,
+    occupancy_key,
+)
 from .events import EventLog, profile_trace
 from .histograms import (
     GLOBAL_HISTOGRAMS,
@@ -63,10 +78,12 @@ from .exporters import MetricsServer, prometheus_text
 __all__ = [
     "ConvergenceMonitor",
     "Counters",
+    "DeviceProfiler",
     "DivergenceIncident",
     "EventLog",
     "FlightRecorder",
     "GLOBAL_COUNTERS",
+    "GLOBAL_DEVPROF",
     "GLOBAL_HISTOGRAMS",
     "GLOBAL_TRACER",
     "Histogram",
@@ -84,6 +101,8 @@ __all__ = [
     "current_span",
     "health_snapshot",
     "merge_traces",
+    "note_jit_dispatch",
+    "occupancy_key",
     "profile_trace",
     "prometheus_text",
 ]

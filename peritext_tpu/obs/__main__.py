@@ -9,16 +9,24 @@ and p50/p95/p99 per (stage, host).  The ``fleet`` command instead reads
 replication-lag picture: per (host, peer) ops-behind/ahead watermarks,
 staleness, failures, and any divergence incidents.
 
+The ``perf`` command reads the append-only perf ledger
+(:mod:`peritext_tpu.obs.ledger`: bench ladder rows + devprof snapshots,
+one JSONL record per run) and renders the LAST record as a diff table
+against its rolling same-device reference; ``--gate`` makes a regression
+beyond the tolerance bands exit 1 — the CI perf-gate job.
+
 Usage::
 
     python -m peritext_tpu.obs summary trace.json [more.json ...]
     python -m peritext_tpu.obs summary flight-*.jsonl --json
     python -m peritext_tpu.obs merge -o merged.json hostA.json hostB.json
     python -m peritext_tpu.obs fleet hostA-convergence.json hostB.json
+    python -m peritext_tpu.obs perf perf/reference_ledger.jsonl --gate
 
 ``summary`` is the default command (``python -m peritext_tpu.obs t.json``
-works).  Exit codes: 0 ok (fleet: converged), 1 no spans found / fleet has
-lag or divergence, 2 unreadable input.
+works).  Exit codes: 0 ok (fleet: converged; perf: no regression), 1 no
+spans found / fleet has lag or divergence / perf ``--gate`` regression,
+2 unreadable input.
 """
 
 from __future__ import annotations
@@ -150,10 +158,68 @@ def fleet_rows(snapshots: Sequence[Dict]) -> List[Dict]:
     return rows
 
 
+def _perf_command(args) -> int:
+    """Render/gate the perf ledger (see module doc)."""
+    from . import ledger as _ledger
+
+    try:
+        records = _ledger.load_ledger(args.ledger)
+    except (OSError, ValueError) as exc:
+        print(f"unreadable perf ledger {args.ledger}: {exc}", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"empty perf ledger {args.ledger}", file=sys.stderr)
+        return 2
+    report = _ledger.evaluate(
+        records,
+        tolerance=(args.tolerance / 100.0 if args.tolerance is not None
+                   else None),
+        window=args.window if args.window is not None else _ledger.DEFAULT_WINDOW,
+        match=args.match,
+    )
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        cand = report["candidate"]
+        sha = (cand.get("sha") or "?")[:12]
+        dev = (cand.get("device") or {})
+        print(
+            f"{len(records)} record(s) · candidate sha {sha} · "
+            f"config {cand.get('config')} · device "
+            f"{dev.get('platform')}/{dev.get('kind')} · "
+            f"{report['reference_records']} matching reference record(s)"
+        )
+        rows = [
+            {
+                "row": v["row"],
+                "unit": v["unit"],
+                "ref": "-" if v["ref"] is None else v["ref"],
+                "value": "-" if v["value"] is None else v["value"],
+                "delta_pct": "-" if v["delta_pct"] is None else v["delta_pct"],
+                "band_pct": v["band_pct"],
+                "status": v["status"],
+            }
+            for v in report["rows"]
+        ]
+        if rows:
+            print(render_table(
+                rows,
+                cols=["row", "unit", "ref", "value", "delta_pct",
+                      "band_pct", "status"],
+            ))
+        else:
+            print("candidate record carries no rows")
+    if args.gate and report["regressed"]:
+        print("perf gate: REGRESSION detected", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # default command: `python -m peritext_tpu.obs trace.json` == summary
-    if argv and argv[0] not in ("summary", "merge", "fleet", "-h", "--help"):
+    if argv and argv[0] not in ("summary", "merge", "fleet", "perf",
+                                "-h", "--help"):
         argv.insert(0, "summary")
     parser = argparse.ArgumentParser(
         prog="python -m peritext_tpu.obs", description=__doc__,
@@ -174,10 +240,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p_fleet.add_argument("paths", nargs="+")
     p_fleet.add_argument("--json", action="store_true",
                          help="machine-readable rows instead of the table")
+    p_perf = sub.add_parser(
+        "perf", help="perf-ledger diff table: last record vs its rolling "
+        "same-device reference",
+    )
+    p_perf.add_argument("ledger", help="JSONL perf-ledger path")
+    p_perf.add_argument("--gate", action="store_true",
+                        help="exit 1 when any row regresses beyond its band")
+    p_perf.add_argument("--json", action="store_true",
+                        help="machine-readable verdicts instead of the table")
+    p_perf.add_argument("--tolerance", type=float, default=None, metavar="PCT",
+                        help="override every row's tolerance band (percent)")
+    p_perf.add_argument("--window", type=int, default=None, metavar="N",
+                        help="rolling-reference window (prior records; "
+                        "default 5)")
+    p_perf.add_argument("--match", choices=("device", "platform", "any"),
+                        default="device",
+                        help="how strictly reference records must match the "
+                        "candidate's device fingerprint (default: device)")
     args = parser.parse_args(argv)
     if args.cmd is None:
         parser.print_help()
         return 2
+
+    if args.cmd == "perf":
+        return _perf_command(args)
 
     if args.cmd == "fleet":
         snapshots = []

@@ -1,0 +1,380 @@
+"""Device-cost observability: what the compiled merge executables cost.
+
+Every telemetry layer so far (spans, histograms, flight recorder,
+convergence monitor) watches the HOST side of the pipeline.  This module is
+the device-facing counterpart — :class:`DeviceProfiler` captures, per jit
+site and shape bucket:
+
+* **XLA cost/memory introspection** — ``cost_analysis()`` (FLOPs, bytes
+  accessed) and ``memory_analysis()`` (argument/output/temp device memory)
+  of the actual compiled executables, via the AOT ``lower().compile()``
+  path.  Capture is memoized per (site, shape bucket) and gated behind
+  ``capture_costs`` because each capture builds one extra executable — a
+  warmup-time act, never a steady-state one.  (AOT compiles do NOT emit
+  jax's ``Compiling <site>`` log record, so cost capture never perturbs the
+  :class:`~.sentinel.RecompileSentinel` counts the bucket table is
+  cross-checked against.)
+* **Bucket occupancy** — per padded-shape bucket: rounds dispatched, real
+  ops vs padded op-stream capacity, and the padding waste ratio.  This
+  generalizes the single scalar ``MergeStats.padding_efficiency`` into the
+  per-bucket table Ragged Paged Attention treats as the first-class TPU
+  ragged-batching signal: a mis-sized round width shows up as one bucket
+  with high waste, not as a diluted session average.
+* **Device-memory watermarks** — ``Device.memory_stats()`` samples taken at
+  round boundaries (streaming commit, guarded supervisor round, batch
+  merge).  CPU backends return no stats; the snapshot then reports
+  ``available: false`` instead of zeros.
+* **Shape-bucket keys** — :meth:`~DeviceProfiler.shape_signature` derives a
+  stable key from the dispatch's actual argument shapes/dtypes plus its
+  static arguments, i.e. exactly the granularity of jax's compile cache.
+  The per-site distinct-shape count therefore equals the sentinel's
+  per-site compile count on a fresh-session replay — the cross-check
+  tests/test_devprof.py pins.
+
+Profiling is OFF by default (``GLOBAL_DEVPROF.enabled`` is False) and every
+hook in the merge stack is behind that one attribute check, so the disabled
+cost is a single branch per dispatch.  All host syncs here (AOT compiles,
+``memory_stats`` reads) live in ``obs/`` — outside graftlint's merge scope
+and outside every jit boundary — which is the scoping that keeps the repo
+self-scan clean (DESIGN.md "Device cost & perf ledger").
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+def _describe(obj: Any, out: list) -> None:
+    """Flatten a dispatch-argument pytree into a deterministic textual
+    descriptor: arrays become ``dtype[shape]``, containers recurse in sorted
+    key order, ``None`` is preserved (an absent optional stream changes the
+    compiled signature and must change the key too)."""
+    if obj is None:
+        out.append("none")
+    elif isinstance(obj, dict):
+        out.append("{")
+        for k in sorted(obj):
+            out.append(f"{k}:")
+            _describe(obj[k], out)
+        out.append("}")
+    elif isinstance(obj, (tuple, list)):
+        out.append("(")
+        for item in obj:
+            _describe(item, out)
+        out.append(")")
+    elif hasattr(obj, "shape") and hasattr(obj, "dtype"):
+        out.append(f"{obj.dtype}{tuple(obj.shape)}")
+    else:
+        out.append(repr(obj))
+
+
+class _ShapeBucket:
+    """One (jit site, compiled shape) bucket: dispatch count plus the
+    memoized cost/memory analyses of its executable."""
+
+    __slots__ = ("sig", "dispatches", "cost", "memory")
+
+    def __init__(self, sig: str) -> None:
+        self.sig = sig
+        self.dispatches = 0
+        self.cost: Optional[Dict[str, float]] = None
+        self.memory: Optional[Dict[str, int]] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "dispatches": self.dispatches,
+            "sig": self.sig,
+            "cost": self.cost,
+            "memory": self.memory,
+        }
+
+
+class _Occupancy:
+    """One padded-shape bucket's occupancy accounting."""
+
+    __slots__ = ("origin", "rounds", "real_ops", "padded_capacity")
+
+    def __init__(self, origin: str) -> None:
+        self.origin = origin
+        self.rounds = 0
+        self.real_ops = 0
+        self.padded_capacity = 0
+
+    def to_json(self) -> Dict[str, Any]:
+        waste = (
+            1.0 - self.real_ops / self.padded_capacity
+            if self.padded_capacity else 0.0
+        )
+        return {
+            "origin": self.origin,
+            "rounds": self.rounds,
+            "real_ops": self.real_ops,
+            "padded_capacity": self.padded_capacity,
+            "padding_waste": round(waste, 4),
+        }
+
+
+#: cost_analysis keys worth keeping (the rest is per-operand detail)
+_COST_KEYS = ("flops", "bytes accessed", "transcendentals", "optimal_seconds")
+#: CompiledMemoryStats attributes exported per bucket
+_MEMORY_ATTRS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+
+class DeviceProfiler:
+    """Per-jit-site / per-shape-bucket device-cost collector (module doc).
+
+    Use :meth:`enable` / :meth:`disable` (or the context-manager form) to
+    bound a profiled region; :meth:`snapshot` is the JSON-serializable
+    export every surface (``/devprof.json``, ``health_snapshot(devprof=)``,
+    the perf ledger, ``peritext_device_*`` gauges) shares.
+    """
+
+    def __init__(self, capture_costs: bool = False) -> None:
+        self.enabled = False
+        self.capture_costs = capture_costs
+        self._lock = threading.Lock()
+        self._sites: Dict[str, Dict[str, _ShapeBucket]] = {}
+        self._occupancy: Dict[str, _Occupancy] = {}
+        self._mem_samples = 0
+        self._mem_last: Optional[int] = None
+        self._mem_peak: Optional[int] = None
+        self._mem_backend_peak: Optional[int] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def enable(self, capture_costs: Optional[bool] = None) -> "DeviceProfiler":
+        if capture_costs is not None:
+            self.capture_costs = capture_costs
+        self.enabled = True
+        return self
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sites = {}
+            self._occupancy = {}
+            self._mem_samples = 0
+            self._mem_last = None
+            self._mem_peak = None
+            self._mem_backend_peak = None
+
+    def __enter__(self) -> "DeviceProfiler":
+        return self.enable()
+
+    def __exit__(self, *exc_info) -> None:
+        self.disable()
+
+    # -- shape-bucket keys --------------------------------------------------
+
+    @staticmethod
+    def shape_signature(tree: Any, static: Tuple = ()) -> Tuple[str, str]:
+        """``(key, sig)`` for one dispatch: ``sig`` is the readable
+        descriptor (argument shapes/dtypes + statics), ``key`` its stable
+        hash.  Built from the ACTUAL dispatched arrays so the bucket
+        granularity matches jax's compile cache exactly — neither coarser
+        (two signatures, one bucket) nor finer (one signature, two)."""
+        parts: list = []
+        _describe(tree, parts)
+        if static:
+            parts.append(f"static={static!r}")
+        sig = " ".join(parts)
+        key = hashlib.sha1(sig.encode()).hexdigest()[:16]
+        return key, sig
+
+    # -- dispatch + occupancy accounting ------------------------------------
+
+    def note_dispatch(
+        self,
+        site: str,
+        key: str,
+        sig: str = "",
+        aot: Optional[Callable[[], Any]] = None,
+    ) -> None:
+        """Record one dispatch of ``site`` under shape bucket ``key``.
+
+        ``aot`` — a zero-arg callable returning the dispatch's
+        ``jax.stages.Lowered`` (i.e. ``lambda: jitted.lower(*args)``) —
+        feeds the memoized cost/memory capture the first time a bucket is
+        seen, when ``capture_costs`` is on."""
+        capture = None
+        with self._lock:
+            buckets = self._sites.setdefault(site, {})
+            bucket = buckets.get(key)
+            if bucket is None:
+                bucket = buckets[key] = _ShapeBucket(sig)
+                if self.capture_costs and aot is not None:
+                    capture = bucket
+            bucket.dispatches += 1
+        if capture is not None:
+            cost, memory = self._analyze(aot)
+            with self._lock:
+                capture.cost, capture.memory = cost, memory
+
+    @staticmethod
+    def _analyze(aot: Callable[[], Any]):
+        """Best-effort AOT cost/memory introspection of one executable."""
+        try:
+            compiled = aot().compile()
+            raw = compiled.cost_analysis()
+            if isinstance(raw, (list, tuple)):
+                raw = raw[0] if raw else {}
+            cost = {
+                k.replace(" ", "_"): float(raw[k])
+                for k in _COST_KEYS
+                if raw and k in raw
+            } or None
+            stats = compiled.memory_analysis()
+            memory = None
+            if stats is not None:
+                memory = {
+                    a: int(getattr(stats, a))
+                    for a in _MEMORY_ATTRS
+                    if hasattr(stats, a)
+                }
+                if memory:
+                    # the executable's resident device-memory requirement:
+                    # arguments + outputs + XLA temp allocations
+                    memory["peak_bytes"] = (
+                        memory.get("argument_size_in_bytes", 0)
+                        + memory.get("output_size_in_bytes", 0)
+                        + memory.get("temp_size_in_bytes", 0)
+                    )
+            return cost, memory
+        except Exception:  # graftlint: boundary(cost introspection is best-effort telemetry; an XLA AOT quirk must never fail the dispatch path being profiled)
+            return None, None
+
+    def observe_round(
+        self, bucket: str, real_ops: int, padded_capacity: int,
+        rounds: int = 1, origin: str = "streaming.round",
+    ) -> None:
+        """Fold one committed round (or one batch merge) into the
+        bucket-occupancy table."""
+        with self._lock:
+            occ = self._occupancy.get(bucket)
+            if occ is None:
+                occ = self._occupancy[bucket] = _Occupancy(origin)
+            occ.rounds += rounds
+            occ.real_ops += int(real_ops)
+            occ.padded_capacity += int(padded_capacity)
+
+    # -- device-memory watermarks -------------------------------------------
+
+    def sample_memory(self) -> Optional[int]:
+        """Sample the first local device's live memory; returns
+        ``bytes_in_use`` (None when the backend exposes no stats — CPU)."""
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:  # graftlint: boundary(memory watermarks are best-effort; a backend without memory_stats must not fail the round being sampled)
+            stats = None
+        with self._lock:
+            self._mem_samples += 1
+            if not stats:
+                return None
+            in_use = stats.get("bytes_in_use")
+            if in_use is not None:
+                self._mem_last = int(in_use)
+                self._mem_peak = max(self._mem_peak or 0, int(in_use))
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                self._mem_backend_peak = int(peak)
+            return self._mem_last
+
+    # -- export -------------------------------------------------------------
+
+    def distinct_shapes(self) -> Dict[str, int]:
+        """Per-site distinct compiled-shape counts — the quantity that must
+        equal the RecompileSentinel's per-site compile counts on a
+        fresh-session replay."""
+        with self._lock:
+            return {site: len(b) for site, b in sorted(self._sites.items())}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One JSON-serializable document: the shape-bucket table per jit
+        site (with any captured cost/memory analyses), the bucket-occupancy
+        table, and the device-memory watermarks.  The exporter golden test
+        pins this schema."""
+        with self._lock:
+            sites = {
+                site: {
+                    "distinct_shapes": len(buckets),
+                    "dispatches": sum(b.dispatches for b in buckets.values()),
+                    "buckets": {
+                        key: b.to_json() for key, b in sorted(buckets.items())
+                    },
+                }
+                for site, buckets in sorted(self._sites.items())
+            }
+            occupancy = {
+                k: o.to_json() for k, o in sorted(self._occupancy.items())
+            }
+            real = sum(o.real_ops for o in self._occupancy.values())
+            padded = sum(o.padded_capacity for o in self._occupancy.values())
+            rounds = sum(o.rounds for o in self._occupancy.values())
+            memory = {
+                "available": self._mem_last is not None,
+                "samples": self._mem_samples,
+                "bytes_in_use": self._mem_last,
+                "peak_bytes_in_use": (
+                    self._mem_backend_peak
+                    if self._mem_backend_peak is not None
+                    else self._mem_peak
+                ),
+            }
+        return {
+            "enabled": self.enabled,
+            "capture_costs": self.capture_costs,
+            "sites": sites,
+            "occupancy": occupancy,
+            "occupancy_totals": {
+                "rounds": rounds,
+                "real_ops": real,
+                "padded_capacity": padded,
+                "padding_waste": round(1.0 - real / padded, 4) if padded else 0.0,
+            },
+            "memory": memory,
+        }
+
+
+#: Default process-wide device profiler — OFF by default; every hook in the
+#: merge stack checks ``GLOBAL_DEVPROF.enabled`` before doing any work.
+GLOBAL_DEVPROF = DeviceProfiler()
+
+
+def occupancy_key(docs: int, ki: int, kd: int, km: int, kp: int) -> str:
+    """The ONE spelling of a padded-shape occupancy bucket — every producer
+    (streaming rounds, batch merges) must share it, or the occupancy table
+    splits into incompatible key namespaces."""
+    return f"D{docs}.ki{ki}.kd{kd}.km{km}.kp{kp}"
+
+
+def note_jit_dispatch(
+    site: str,
+    jitfn: Any,
+    args: Tuple,
+    kwargs: Optional[Dict[str, Any]] = None,
+    profiler: Optional[DeviceProfiler] = None,
+) -> None:
+    """Record one dispatch of jit wrapper ``jitfn`` called as
+    ``jitfn(*args, **kwargs)``: shape-bucket key from the actual arguments
+    (static scalars inside ``args`` are folded by value, matching jax's
+    cache granularity) plus the AOT lowering for cost capture.  Callers on
+    hot paths guard on ``profiler.enabled`` first; this no-ops regardless
+    when profiling is off."""
+    p = profiler if profiler is not None else GLOBAL_DEVPROF
+    if not p.enabled:
+        return
+    kwargs = kwargs or {}
+    key, sig = p.shape_signature(args, static=tuple(sorted(kwargs.items())))
+    p.note_dispatch(site, key, sig, aot=lambda: jitfn.lower(*args, **kwargs))

@@ -9,9 +9,11 @@ is ``GET /metrics`` against every replica.
 
 Endpoints:
 
-* ``/metrics``     — Prometheus text exposition
-* ``/health.json`` — :func:`~.metrics.health_snapshot` as JSON
-* ``/trace.json``  — the attached tracer's Chrome trace-event dump
+* ``/metrics``      — Prometheus text exposition
+* ``/health.json``  — :func:`~.metrics.health_snapshot` as JSON
+* ``/trace.json``   — the attached tracer's Chrome trace-event dump
+* ``/devprof.json`` — the attached :class:`~.devprof.DeviceProfiler`
+  snapshot (shape buckets, occupancy, memory watermarks)
 """
 
 from __future__ import annotations
@@ -42,6 +44,7 @@ def prometheus_text(
     session=None,
     sentinel=None,
     convergence=None,
+    devprof=None,
 ) -> str:
     """Prometheus text exposition of the process telemetry.  Counter names
     sanitize ``.`` → ``_`` under a ``peritext_`` prefix; histograms emit the
@@ -49,7 +52,10 @@ def prometheus_text(
     numeric health fields land as ``peritext_session_*`` gauges; a
     :class:`~.convergence.ConvergenceMonitor` lands as per-peer
     ``peritext_convergence_*`` gauges (lag ops, staleness rounds) plus the
-    fleet-level totals."""
+    fleet-level totals; a :class:`~.devprof.DeviceProfiler` lands as
+    per-site ``peritext_device_*`` gauges (distinct compiled shapes,
+    dispatches, modeled flops/bytes totals, peak executable memory) plus
+    the bucket-occupancy and device-memory-watermark totals."""
     counters = counters or GLOBAL_COUNTERS
     histograms = histograms if histograms is not None else GLOBAL_HISTOGRAMS
     lines = []
@@ -97,6 +103,60 @@ def prometheus_text(
         m = "peritext_convergence_divergence_incidents_total"
         lines.append(f"# TYPE {m} counter")
         lines.append(f"{m} {_fmt(snap['divergence_incidents'])}")
+    if devprof is not None:
+        dp = devprof.snapshot()
+        per_site = []
+        for site, rec in dp["sites"].items():
+            flops = sum(
+                b["cost"]["flops"] * b["dispatches"]
+                for b in rec["buckets"].values()
+                if b.get("cost") and "flops" in b["cost"]
+            )
+            bytes_acc = sum(
+                b["cost"]["bytes_accessed"] * b["dispatches"]
+                for b in rec["buckets"].values()
+                if b.get("cost") and "bytes_accessed" in b["cost"]
+            )
+            peak = max(
+                (b["memory"]["peak_bytes"] for b in rec["buckets"].values()
+                 if b.get("memory")),
+                default=0,
+            )
+            per_site.append((site, rec, flops, bytes_acc, peak))
+        site_gauges = (
+            ("peritext_device_distinct_shapes", lambda r, f, ba, p: r["distinct_shapes"]),
+            ("peritext_device_dispatches", lambda r, f, ba, p: r["dispatches"]),
+            ("peritext_device_flops_total", lambda r, f, ba, p: f),
+            ("peritext_device_bytes_accessed_total", lambda r, f, ba, p: ba),
+            ("peritext_device_peak_bytes", lambda r, f, ba, p: p),
+        )
+        for m, value_of in site_gauges:
+            lines.append(f"# TYPE {m} gauge")
+            for site, rec, flops, bytes_acc, peak in per_site:
+                quoted = (site.replace("\\", "\\\\").replace('"', '\\"')
+                          .replace("\n", "\\n"))
+                lines.append(
+                    f'{m}{{site="{quoted}"}} '
+                    f"{_fmt(value_of(rec, flops, bytes_acc, peak))}"
+                )
+        tot = dp["occupancy_totals"]
+        for m, value in (
+            ("peritext_device_rounds_total", tot["rounds"]),
+            ("peritext_device_real_ops_total", tot["real_ops"]),
+            ("peritext_device_padded_ops_total", tot["padded_capacity"]),
+            ("peritext_device_padding_waste_ratio", tot["padding_waste"]),
+        ):
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {_fmt(value)}")
+        mem = dp["memory"]
+        if mem["available"]:
+            for m, value in (
+                ("peritext_device_memory_bytes_in_use", mem["bytes_in_use"]),
+                ("peritext_device_memory_peak_bytes", mem["peak_bytes_in_use"]),
+            ):
+                if value is not None:
+                    lines.append(f"# TYPE {m} gauge")
+                    lines.append(f"{m} {_fmt(value)}")
     if session is not None:
         health = session.health()
         for key in sorted(health):
@@ -154,11 +214,13 @@ class MetricsServer:
         recorder=None,
         sentinel=None,
         convergence=None,
+        devprof=None,
     ) -> None:
         def metrics() -> str:
             return prometheus_text(
                 counters=counters, histograms=histograms,
                 session=session, sentinel=sentinel, convergence=convergence,
+                devprof=devprof,
             )
 
         def snapshot() -> str:
@@ -166,7 +228,7 @@ class MetricsServer:
                 health_snapshot(
                     counters=counters, session=session, sentinel=sentinel,
                     histograms=histograms, recorder=recorder,
-                    convergence=convergence,
+                    convergence=convergence, devprof=devprof,
                 ),
                 default=str,
             )
@@ -183,6 +245,11 @@ class MetricsServer:
         if convergence is not None:
             routes["/convergence.json"] = (
                 lambda: json.dumps(convergence.snapshot()),
+                "application/json",
+            )
+        if devprof is not None:
+            routes["/devprof.json"] = (
+                lambda: json.dumps(devprof.snapshot()),
                 "application/json",
             )
         self._httpd = ThreadingHTTPServer((host, port), _Handler)

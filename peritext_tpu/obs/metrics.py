@@ -57,6 +57,7 @@ def health_snapshot(
     histograms=None,
     recorder=None,
     convergence=None,
+    devprof=None,
 ) -> Dict[str, Any]:
     """One structured dict for a fleet health endpoint: every fault-domain
     counter (quarantines, corrupt frames, transport retries / behind peers,
@@ -73,7 +74,9 @@ def health_snapshot(
     way); with a :class:`~.recorder.FlightRecorder`, its ring/dump summary
     appears under ``flight_recorder``; with a
     :class:`~.convergence.ConvergenceMonitor`, its per-peer lag watermarks
-    and divergence tallies appear under ``convergence``.  Everything in the
+    and divergence tallies appear under ``convergence``; with a
+    :class:`~.devprof.DeviceProfiler`, its shape-bucket / occupancy /
+    memory-watermark snapshot appears under ``devprof``.  Everything in the
     snapshot is JSON-serializable (the exporter-schema golden test pins
     this)."""
     from .histograms import GLOBAL_HISTOGRAMS
@@ -103,4 +106,6 @@ def health_snapshot(
         out["flight_recorder"] = recorder.snapshot()
     if convergence is not None:
         out["convergence"] = convergence.snapshot()
+    if devprof is not None:
+        out["devprof"] = devprof.snapshot()
     return out

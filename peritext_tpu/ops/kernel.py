@@ -31,6 +31,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# Devprof bucket plumbing at the kernel boundary (obs/devprof.py): each jit
+# wrapper below derives the dispatch's shape-bucket key from the ACTUAL
+# argument arrays plus the static kwargs — exactly the granularity of jax's
+# compile cache, so the per-site distinct-shape count cross-checks the
+# RecompileSentinel.  Guarded on ``GLOBAL_DEVPROF.enabled``: the disabled
+# path costs one attribute check per dispatch.  Merge-scope modules import
+# telemetry from ..obs only (the PR-3 facade invariant).
+from ..obs import GLOBAL_DEVPROF, note_jit_dispatch as _note_dispatch
 from .encode import EncodedBatch, MARK_COLS
 from .packed import PackedDocs
 
@@ -325,6 +333,13 @@ def apply_batch_compact_jit(state, stream_counts, ins_flat, del_flat, mark_flat,
     boundary, as in :func:`apply_batch_jit`)."""
     if insert_impl == "auto":
         insert_impl = resolve_insert_impl(state.elem_id)
+    if GLOBAL_DEVPROF.enabled:
+        _note_dispatch(
+            "apply_batch_compact", _apply_batch_compact_jit,
+            (state, stream_counts, ins_flat, del_flat, mark_flat, map_flat),
+            dict(widths=widths, insert_impl=insert_impl,
+                 insert_loop_slots=insert_loop_slots),
+        )
     return _apply_batch_compact_jit(
         state, stream_counts, ins_flat, del_flat, mark_flat, map_flat,
         widths=widths, insert_impl=insert_impl,
@@ -379,10 +394,16 @@ def apply_batch_compact_rounds_jit(state, rounds, *, widths_seq,
     at the boundary, as in :func:`apply_batch_jit`)."""
     if insert_impl == "auto":
         insert_impl = resolve_insert_impl(state.elem_id)
-    return _apply_rounds_jit(
-        state, tuple(rounds), widths_seq=tuple(widths_seq),
-        loop_slots_seq=tuple(loop_slots_seq), insert_impl=insert_impl,
-    )
+    rounds = tuple(rounds)
+    statics = dict(widths_seq=tuple(widths_seq),
+                   loop_slots_seq=tuple(loop_slots_seq),
+                   insert_impl=insert_impl)
+    if GLOBAL_DEVPROF.enabled:
+        _note_dispatch(
+            "apply_batch_compact_rounds", _apply_rounds_jit,
+            (state, rounds), statics,
+        )
+    return _apply_rounds_jit(state, rounds, **statics)
 
 
 def encoded_arrays_of(encoded: EncodedBatch):
@@ -449,6 +470,11 @@ def apply_batch_jit(
     boundary where input shardings are still observable."""
     if insert_impl == "auto":
         insert_impl = resolve_insert_impl(state.elem_id)
+    if GLOBAL_DEVPROF.enabled:
+        _note_dispatch(
+            "apply_batch", _apply_batch_jit, (state, encoded_arrays),
+            dict(insert_impl=insert_impl, insert_loop_slots=insert_loop_slots),
+        )
     return _apply_batch_jit(
         state,
         encoded_arrays,

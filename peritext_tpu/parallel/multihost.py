@@ -402,13 +402,17 @@ class ReplicaServer:
         self.metrics = None
         self.metrics_address: Optional[Tuple[str, int]] = None
         if metrics_port is not None:
-            from ..obs import MetricsServer
+            from ..obs import GLOBAL_DEVPROF, MetricsServer
 
             try:
                 self.metrics = MetricsServer(
                     host=host, port=metrics_port,
                     tracer=self.tracer, recorder=self.recorder,
                     convergence=self.monitor,
+                    # the process profiler is mounted even while disabled:
+                    # /devprof.json answers (enabled: false) and the gauges
+                    # appear the moment an operator arms GLOBAL_DEVPROF
+                    devprof=GLOBAL_DEVPROF,
                 )
             except OSError:
                 # metrics port unavailable: release the already-bound

@@ -51,11 +51,14 @@ from ..core.errors import DecodeError
 from ..core.types import Change, Clock, FormatSpan
 from ..obs import (
     GLOBAL_COUNTERS,
+    GLOBAL_DEVPROF,
     GLOBAL_HISTOGRAMS,
     GLOBAL_TRACER,
     MergeStats,
     SIZE_BUCKETS,
     TraceContext,
+    note_jit_dispatch,
+    occupancy_key,
 )
 from ..ops.decode import decode_doc_spans
 from ..ops.encode import DocEncoder, _DocStreams
@@ -995,9 +998,23 @@ class StreamingMerge:
         real = 0
         capacity = 0
         for enc, widths in batch:
+            round_real = int(enc.num_ops.sum())
+            round_cap = self._padded_docs * sum(widths)
             touched.update(int(r) for r in np.nonzero(enc.num_ops)[0])
-            real += int(enc.num_ops.sum())
-            capacity += self._padded_docs * sum(widths)
+            real += round_real
+            capacity += round_cap
+            if GLOBAL_DEVPROF.enabled:
+                # per-bucket occupancy (devprof): the round's real ops vs
+                # its padded (doc x width) capacity, keyed by the width set
+                # — the per-bucket generalization of padding_efficiency
+                GLOBAL_DEVPROF.observe_round(
+                    occupancy_key(self._padded_docs, *widths),
+                    round_real, round_cap, origin="streaming.round",
+                )
+        if GLOBAL_DEVPROF.enabled:
+            # round-boundary device-memory watermark (one sample per
+            # committed batch, not per fused round — bounded overhead)
+            GLOBAL_DEVPROF.sample_memory()
         stats = MergeStats(
             docs=len(touched),
             device_docs=len(touched),
@@ -1662,10 +1679,16 @@ class StreamingMerge:
         lo, hi = self._block_bounds(block_index)
         on_device = self._block_fallback_mask(block_index)
         with self.tracer.span("streaming.resolve", block=block_index):
-            resolved, digest_dev = _resolve_block_digest_jit(
+            dispatch_args = (
                 self._state_block(block_index), self.comment_capacity,
                 jnp.asarray(on_device), *self._digest_tables(lo, hi),
             )
+            if GLOBAL_DEVPROF.enabled:
+                note_jit_dispatch(
+                    "_resolve_block_digest_jit", _resolve_block_digest_jit,
+                    dispatch_args,
+                )
+            resolved, digest_dev = _resolve_block_digest_jit(*dispatch_args)
         entry = _BlockResolution(resolved, digest_dev, on_device)
         if len(cache) >= 2:  # bound host/device memory at large scale
             cache.pop(next(iter(cache)))  # least-recently-used
@@ -2211,10 +2234,15 @@ class StreamingMerge:
         mask = np.zeros(k, bool)
         mask[: len(rest)] = True
         sub = _gather_rows(self.state, jnp.asarray(rows_idx), self.mesh)
-        return _rows_digest_jit(
+        dispatch_args = (
             sub, self.comment_capacity, jnp.asarray(mask),
             *self._digest_tables_rows(rows_idx, len(rest)),
         )
+        if GLOBAL_DEVPROF.enabled:
+            note_jit_dispatch(
+                "_rows_digest_jit", _rows_digest_jit, dispatch_args,
+            )
+        return _rows_digest_jit(*dispatch_args)
 
     def _refresh_digest_rows(self):
         """Bring the carried per-row hash plane current for every on-device

@@ -41,6 +41,7 @@ from ..core.errors import DeviceRoundError
 from ..obs import (
     FlightRecorder,
     GLOBAL_COUNTERS,
+    GLOBAL_DEVPROF,
     GLOBAL_HISTOGRAMS,
     Histogram,
     Tracer,
@@ -306,6 +307,11 @@ class GuardedSession:
         # the exported histogram sees EVERY round — an operator sizing the
         # static ceiling needs the true worst case, compile rounds included
         GLOBAL_HISTOGRAMS.observe("supervisor.round_seconds", sp.duration)
+        if GLOBAL_DEVPROF.enabled:
+            # guarded-round boundary: device-memory watermark AFTER the
+            # round's dispatches (the periodic sync above means the sample
+            # near a checkpoint reflects settled, not queued, allocations)
+            GLOBAL_DEVPROF.sample_memory()
         if self._rounds_total > self.deadline_warmup:
             # warmup exemption: the first round(s) are compile-dominated and
             # must not seed the rolling percentile the deadline derives from

@@ -85,6 +85,19 @@ def dispatch(docs):
     return traced_loop(padded, len(docs))
 
 
+# PTL003: devprof-style cost/memory probe sneaking INSIDE a merge-scope jit
+# root — device-cost introspection belongs in obs/devprof.py, OUTSIDE every
+# jit boundary; in traced code it is a fusion-breaking host sync
+def _cost_probe(state):
+    return jax.block_until_ready(state)
+
+
+@jax.jit
+def apply_with_probe(state):
+    _cost_probe(state)
+    return state + 1
+
+
 # PTL005: broad except without a boundary annotation
 def swallow(op):
     try:

@@ -1,0 +1,490 @@
+"""Device-cost observability tests (ISSUE 5): the DeviceProfiler's shape
+buckets / occupancy / memory watermarks, the cross-check against the
+RecompileSentinel on a fresh-session replay, the new exporter surfaces'
+golden shapes (``/devprof.json``, ``peritext_device_*`` gauges,
+``health_snapshot(devprof=)``, the ledger record schema), and the perf
+ledger's rolling-reference regression gate."""
+
+import json
+import random
+import urllib.request
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from peritext_tpu.obs import (
+    DeviceProfiler,
+    GLOBAL_DEVPROF,
+    MetricsServer,
+    health_snapshot,
+    prometheus_text,
+)
+from peritext_tpu.obs import ledger as perf_ledger
+from peritext_tpu.obs.devprof import note_jit_dispatch
+from peritext_tpu.obs.__main__ import main as obs_main
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+REFERENCE_LEDGER = REPO_ROOT / "perf" / "reference_ledger.jsonl"
+
+
+@pytest.fixture
+def global_devprof():
+    """The process profiler, armed for one test and always disarmed after —
+    devprof is off by default and other tests must see it that way."""
+    GLOBAL_DEVPROF.reset()
+    GLOBAL_DEVPROF.enable(capture_costs=False)
+    try:
+        yield GLOBAL_DEVPROF
+    finally:
+        GLOBAL_DEVPROF.disable()
+        GLOBAL_DEVPROF.reset()
+
+
+# ---------------------------------------------------------------------------
+# DeviceProfiler unit behavior
+# ---------------------------------------------------------------------------
+
+
+class TestDeviceProfiler:
+    def test_off_by_default(self):
+        assert DeviceProfiler().enabled is False
+
+    def test_shape_signature_matches_compile_granularity(self):
+        p = DeviceProfiler()
+        a32 = np.zeros((4, 8), np.int32)
+        b32 = np.zeros((4, 8), np.int32)
+        key_a, sig = p.shape_signature((a32,), static=(("w", 16),))
+        key_b, _ = p.shape_signature((b32,), static=(("w", 16),))
+        assert key_a == key_b  # same shapes+statics: one bucket
+        assert "int32(4, 8)" in sig
+        # a different shape, dtype, static, or an absent optional stream
+        # each mint a distinct bucket — exactly what recompiles
+        others = [
+            ((np.zeros((4, 16), np.int32),), (("w", 16),)),
+            ((np.zeros((4, 8), np.int64),), (("w", 16),)),
+            ((a32,), (("w", 32),)),
+            ((a32, None), (("w", 16),)),
+            (({"m": a32},), (("w", 16),)),
+        ]
+        keys = {key_a} | {p.shape_signature(t, static=s)[0] for t, s in others}
+        assert len(keys) == 1 + len(others)
+
+    def test_occupancy_table_generalizes_padding_efficiency(self):
+        p = DeviceProfiler().enable()
+        p.observe_round("D8.ki16.kd8.km8.kp8", real_ops=60, padded_capacity=320)
+        p.observe_round("D8.ki16.kd8.km8.kp8", real_ops=20, padded_capacity=320)
+        p.observe_round("D8.ki8.kd8.km8.kp8", real_ops=64, padded_capacity=256,
+                        origin="batch.merge")
+        snap = p.snapshot()
+        bucket = snap["occupancy"]["D8.ki16.kd8.km8.kp8"]
+        assert bucket["rounds"] == 2
+        assert bucket["real_ops"] == 80
+        assert bucket["padded_capacity"] == 640
+        assert bucket["padding_waste"] == pytest.approx(1 - 80 / 640)
+        assert snap["occupancy"]["D8.ki8.kd8.km8.kp8"]["origin"] == "batch.merge"
+        totals = snap["occupancy_totals"]
+        assert totals["rounds"] == 3
+        assert totals["real_ops"] == 144
+        assert totals["padded_capacity"] == 896
+        assert totals["padding_waste"] == pytest.approx(1 - 144 / 896, abs=1e-4)
+
+    def test_cost_and_memory_capture_on_compiled_executable(self):
+        p = DeviceProfiler(capture_costs=True).enable()
+
+        @jax.jit
+        def _devprof_probe(x):
+            return (x * 2 + 1).sum()
+
+        x = jnp.ones((16, 16), jnp.float32)
+        _devprof_probe(x)
+        note_jit_dispatch("_devprof_probe", _devprof_probe, (x,), profiler=p)
+        note_jit_dispatch("_devprof_probe", _devprof_probe, (x,), profiler=p)
+        site = p.snapshot()["sites"]["_devprof_probe"]
+        assert site["distinct_shapes"] == 1
+        assert site["dispatches"] == 2
+        (bucket,) = site["buckets"].values()
+        assert bucket["cost"] is not None and bucket["cost"]["flops"] > 0
+        assert bucket["memory"] is not None
+        assert bucket["memory"]["peak_bytes"] >= bucket["memory"]["argument_size_in_bytes"]
+
+    def test_memory_watermark_degrades_gracefully_without_stats(self):
+        # CPU backends expose no memory_stats: the snapshot must say so
+        # instead of exporting zeros a dashboard would trust
+        p = DeviceProfiler().enable()
+        p.sample_memory()
+        mem = p.snapshot()["memory"]
+        assert mem["samples"] == 1
+        if jax.devices()[0].platform == "cpu":
+            assert mem["available"] is False
+            assert mem["bytes_in_use"] is None
+
+    def test_disabled_hooks_record_nothing(self):
+        p = DeviceProfiler()  # never enabled
+
+        @jax.jit
+        def _noop_probe(x):
+            return x
+
+        note_jit_dispatch("x", _noop_probe, (jnp.ones(2),), profiler=p)
+        assert p.snapshot()["sites"] == {}
+
+
+# ---------------------------------------------------------------------------
+# the sentinel cross-check (satellite): on a fresh-session replay of a known
+# workload, the bucket table's distinct compiled-shape count per jit site
+# equals the RecompileSentinel's per-site compile count
+# ---------------------------------------------------------------------------
+
+
+ACTORS = ("doc1", "doc2", "doc3")
+#: distinctive capacities so these sessions' compiled shapes cannot collide
+#: with (= be pre-compiled by) any other test's in this process
+_XCHECK_CONFIG = dict(
+    num_docs=5, actors=ACTORS, slot_capacity=112, mark_capacity=48,
+    tomb_capacity=56, round_insert_capacity=24, round_delete_capacity=12,
+    round_mark_capacity=12, round_map_capacity=8,
+)
+
+
+def _arrival_rounds(workloads, rounds, rng):
+    arrival = []
+    for workload in workloads:
+        changes = [ch for log in workload.values() for ch in log]
+        rng.shuffle(changes)
+        size = -(-len(changes) // rounds)
+        arrival.append(
+            [changes[i: i + size] for i in range(0, len(changes), size)]
+        )
+    return arrival
+
+
+def _run_schedule(session, arrival, rounds):
+    for r in range(rounds):
+        for d, batches in enumerate(arrival):
+            if r < len(batches):
+                session.ingest(d, batches[r])
+        session.drain()
+        session.digest()
+    return session.read_all()
+
+
+def test_bucket_table_distinct_shapes_match_sentinel(recompile_sentinel,
+                                                     global_devprof):
+    """THE acceptance cross-check: devprof's shape-bucket keys are derived
+    from the actual dispatch arguments + statics, i.e. jax's own compile
+    granularity — so on a fresh-session replay every instrumented site's
+    distinct-shape count equals the sentinel's compile count, and a warm
+    replay adds neither a shape nor a compile."""
+    from peritext_tpu.parallel.streaming import StreamingMerge
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    workloads = generate_workload(seed=33, num_docs=5, ops_per_doc=36)
+    arrival = _arrival_rounds(workloads, rounds=3, rng=random.Random(9))
+    recompile_sentinel.mark()
+
+    cold = _run_schedule(StreamingMerge(**_XCHECK_CONFIG), arrival, rounds=3)
+
+    compiles = recompile_sentinel.since_mark()
+    distinct = global_devprof.distinct_shapes()
+    assert "apply_batch_compact" in distinct  # the workload hit the kernel
+    for site, shapes in distinct.items():
+        assert shapes == compiles.get(site, 0), (
+            f"site {site}: {shapes} distinct shape bucket(s) vs "
+            f"{compiles.get(site, 0)} sentinel compile(s) — the bucket key "
+            "has drifted from jax's compile-cache granularity"
+        )
+
+    # fresh session, same workload: zero compiles AND zero new buckets
+    recompile_sentinel.mark()
+    warm = _run_schedule(StreamingMerge(**_XCHECK_CONFIG), arrival, rounds=3)
+    recompile_sentinel.assert_steady_state("fresh-session devprof replay")
+    assert global_devprof.distinct_shapes() == distinct
+    assert warm == cold
+    # and the occupancy table saw every committed round of both sessions
+    totals = global_devprof.snapshot()["occupancy_totals"]
+    assert totals["rounds"] > 0 and totals["real_ops"] > 0
+    assert 0.0 <= totals["padding_waste"] < 1.0
+
+
+# ---------------------------------------------------------------------------
+# exporter golden shapes (satellite): downstream scrapers are pinned
+# ---------------------------------------------------------------------------
+
+
+GOLDEN_DEVPROF_KEYS = {
+    "enabled", "capture_costs", "sites", "occupancy", "occupancy_totals",
+    "memory",
+}
+GOLDEN_SITE_KEYS = {"distinct_shapes", "dispatches", "buckets"}
+GOLDEN_BUCKET_KEYS = {"dispatches", "sig", "cost", "memory"}
+GOLDEN_OCCUPANCY_KEYS = {
+    "origin", "rounds", "real_ops", "padded_capacity", "padding_waste",
+}
+GOLDEN_TOTALS_KEYS = {"rounds", "real_ops", "padded_capacity", "padding_waste"}
+GOLDEN_MEMORY_KEYS = {"available", "samples", "bytes_in_use",
+                      "peak_bytes_in_use"}
+GOLDEN_LEDGER_RECORD_KEYS = {"schema", "sha", "device", "config", "rows",
+                             "devprof"}
+GOLDEN_LEDGER_ROW_KEYS = {"row", "metric", "value", "unit", "key"}
+GOLDEN_DEVICE_GAUGES = (
+    "peritext_device_distinct_shapes",
+    "peritext_device_dispatches",
+    "peritext_device_flops_total",
+    "peritext_device_bytes_accessed_total",
+    "peritext_device_peak_bytes",
+    "peritext_device_rounds_total",
+    "peritext_device_real_ops_total",
+    "peritext_device_padded_ops_total",
+    "peritext_device_padding_waste_ratio",
+)
+
+
+def _profiled_probe() -> DeviceProfiler:
+    p = DeviceProfiler(capture_costs=True).enable()
+
+    @jax.jit
+    def _golden_probe(x):
+        return x + 1
+
+    x = jnp.ones((8, 8))
+    _golden_probe(x)
+    note_jit_dispatch("_golden_probe", _golden_probe, (x,), profiler=p)
+    p.observe_round("D8.ki8.kd8.km8.kp8", real_ops=10, padded_capacity=256)
+    p.sample_memory()
+    return p
+
+
+class TestDevprofExporterGoldenShapes:
+    def test_snapshot_golden_shape(self):
+        snap = _profiled_probe().snapshot()
+        assert set(snap) == GOLDEN_DEVPROF_KEYS
+        for site in snap["sites"].values():
+            assert set(site) == GOLDEN_SITE_KEYS
+            for bucket in site["buckets"].values():
+                assert set(bucket) == GOLDEN_BUCKET_KEYS
+        for occ in snap["occupancy"].values():
+            assert set(occ) == GOLDEN_OCCUPANCY_KEYS
+        assert set(snap["occupancy_totals"]) == GOLDEN_TOTALS_KEYS
+        assert set(snap["memory"]) == GOLDEN_MEMORY_KEYS
+        json.dumps(snap)  # one JSON document, end to end
+
+    def test_health_snapshot_composition(self):
+        p = _profiled_probe()
+        snap = health_snapshot(devprof=p)
+        assert set(snap) == {"counters", "histograms", "devprof"}
+        assert set(snap["devprof"]) == GOLDEN_DEVPROF_KEYS
+        json.dumps(snap, default=str)
+
+    def test_prometheus_device_gauges(self):
+        text = prometheus_text(devprof=_profiled_probe())
+        for gauge in GOLDEN_DEVICE_GAUGES:
+            assert f"# TYPE {gauge} gauge" in text, gauge
+        assert 'peritext_device_distinct_shapes{site="_golden_probe"} 1' in text
+        for line in text.splitlines():
+            assert line.startswith("#") or len(line.split()) == 2
+
+    def test_devprof_json_endpoint(self):
+        server = MetricsServer(devprof=_profiled_probe())
+        host, port = server.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/devprof.json"
+            ) as resp:
+                assert resp.status == 200
+                snap = json.loads(resp.read())
+                assert set(snap) == GOLDEN_DEVPROF_KEYS
+                assert "_golden_probe" in snap["sites"]
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics"
+            ) as resp:
+                assert b"peritext_device_distinct_shapes" in resp.read()
+        finally:
+            server.stop()
+
+    def test_ledger_record_schema(self):
+        record = perf_ledger.ledger_record(
+            [{"row": "streaming", "metric": "m", "value": 1.0, "unit": "ops/s",
+              "docs": 64, "ops_per_doc": 96}],
+            config="test", devprof=_profiled_probe().snapshot(),
+        )
+        assert set(record) == GOLDEN_LEDGER_RECORD_KEYS
+        assert record["schema"] == perf_ledger.SCHEMA_VERSION
+        (row,) = record["rows"]
+        assert set(row) == GOLDEN_LEDGER_ROW_KEYS
+        assert row["key"] == "docs=64,ops_per_doc=96"
+        assert set(record["device"]) == {"platform", "kind", "cpus"}
+        json.dumps(record)
+
+
+# ---------------------------------------------------------------------------
+# the perf-regression gate
+# ---------------------------------------------------------------------------
+
+
+def _record(value=1000.0, unit="ops/s", row="streaming", device=None,
+            failed=False, extra_rows=()):
+    rows = [{"row": row, "metric": "m", "value": value, "unit": unit,
+             "key": "docs=64", **({"failed": True} if failed else {})}]
+    rows.extend(extra_rows)
+    return {
+        "schema": 1, "sha": "abc", "config": "test",
+        "device": device or {"platform": "cpu", "kind": "cpu", "cpus": 8},
+        "rows": rows, "devprof": None,
+    }
+
+
+class TestPerfGate:
+    def test_single_record_is_a_vacuous_pass(self):
+        report = perf_ledger.evaluate([_record()])
+        assert report["regressed"] is False
+        assert [v["status"] for v in report["rows"]] == ["new"]
+
+    def test_throughput_drop_beyond_band_regresses(self):
+        records = [_record(1000.0), _record(1000.0), _record(400.0)]
+        report = perf_ledger.evaluate(records)  # ops/s band: 50%
+        (v,) = report["rows"]
+        assert v["status"] == "regressed" and report["regressed"]
+        assert v["ref"] == 1000.0 and v["delta_pct"] == -60.0
+        # within the band: jitter, not a regression
+        ok = perf_ledger.evaluate([_record(1000.0), _record(700.0)])
+        assert ok["regressed"] is False
+
+    def test_direction_comes_from_the_unit(self):
+        # B/op is lower-better with a tight band: growing 20% regresses,
+        # shrinking 20% is an improvement
+        up = perf_ledger.evaluate([_record(5.0, "B/op"), _record(6.0, "B/op")])
+        assert up["rows"][0]["status"] == "regressed"
+        down = perf_ledger.evaluate([_record(5.0, "B/op"), _record(4.0, "B/op")])
+        assert down["rows"][0]["status"] == "improved"
+        assert down["regressed"] is False
+
+    def test_rolling_reference_is_the_median(self):
+        records = [_record(100.0), _record(1000.0), _record(1100.0),
+                   _record(1000.0)]
+        (v,) = perf_ledger.evaluate(records)["rows"]
+        assert v["ref"] == 1000.0  # the 100.0 outlier does not drag the ref
+
+    def test_device_mismatch_is_vacuous_unless_relaxed(self):
+        other = {"platform": "tpu", "kind": "TPU v5", "cpus": 8}
+        records = [_record(1000.0, device=other), _record(100.0)]
+        assert perf_ledger.evaluate(records)["rows"][0]["status"] == "new"
+        relaxed = perf_ledger.evaluate(records, match="any")
+        assert relaxed["rows"][0]["status"] == "regressed"
+
+    def test_deterministic_rows_gate_across_core_counts(self):
+        """B/op is a function of (workload, codec), not clock speed: a
+        same-platform machine with a different core count (the CI-runner
+        case) must still gate it — that is what keeps the committed
+        reference non-vacuous on ephemeral runners."""
+        two_cores = {"platform": "cpu", "kind": "cpu", "cpus": 2}
+        records = [_record(5.0, "B/op", device=two_cores), _record(7.0, "B/op")]
+        report = perf_ledger.evaluate(records)
+        assert report["rows"][0]["status"] == "regressed"
+        # ...while the wall-clock row on the same fingerprints stays vacuous
+        records = [_record(1000.0, device=two_cores), _record(100.0)]
+        assert perf_ledger.evaluate(records)["rows"][0]["status"] == "new"
+
+    def test_dropped_reference_row_fails_the_gate(self):
+        """Renaming/dropping a gated bench row must be loud, never a
+        silent pass: the reference row surfaces as a `missing` verdict."""
+        wire = {"row": "wire", "metric": "w", "value": 5.0, "unit": "B/op",
+                "key": ""}
+        records = [_record(1000.0, extra_rows=[wire]), _record(1000.0)]
+        report = perf_ledger.evaluate(records)
+        assert report["regressed"]
+        missing = [v for v in report["rows"] if v["status"] == "missing"]
+        assert [v["row"] for v in missing] == ["wire"]
+        assert missing[0]["ref"] == 5.0 and missing[0]["value"] is None
+
+    def test_other_config_records_cannot_evict_references(self):
+        """The rolling window applies per row identity, NOT to the record
+        stream: interleaved records of another config must neither evict a
+        row's true references (vacuous gate) nor suppress the missing
+        check."""
+        ref = _record(5.0, "B/op", row="wire")
+        ref["config"] = "ladder-smoke"
+        others = []
+        for _ in range(perf_ledger.DEFAULT_WINDOW + 1):
+            other = _record(100.0)
+            other["config"] = "streaming-smoke"
+            others.append(other)
+        bad = _record(50.0, "B/op", row="wire")  # 10x B/op regression
+        bad["config"] = "ladder-smoke"
+        report = perf_ledger.evaluate([ref, *others, bad])
+        (v,) = report["rows"]
+        assert v["status"] == "regressed" and report["regressed"]
+        # and a candidate that DROPPED the row still fails as missing
+        empty = _record(1.0, row="unrelated")
+        empty["config"] = "ladder-smoke"
+        report = perf_ledger.evaluate([ref, *others, empty])
+        assert any(v["status"] == "missing" and v["row"] == "wire"
+                   for v in report["rows"])
+
+    def test_different_config_is_a_separate_history_not_a_drop(self):
+        """A single-mode record appended to a ladder ledger is a NEW
+        config: no cross-config reference, and no spurious `missing`."""
+        ladder = _record(1000.0)
+        ladder["config"] = "ladder-smoke"
+        ladder["rows"].append({"row": "wire", "metric": "w", "value": 5.0,
+                               "unit": "B/op", "key": ""})
+        single = _record(100.0)
+        single["config"] = "streaming-smoke"
+        report = perf_ledger.evaluate([ladder, single])
+        assert report["regressed"] is False
+        assert [v["status"] for v in report["rows"]] == ["new"]
+
+    def test_failed_row_with_reference_regresses(self):
+        records = [_record(1000.0), _record(None, failed=True)]
+        report = perf_ledger.evaluate(records)
+        assert report["rows"][0]["status"] == "failed"
+        assert report["regressed"]
+
+    def test_cli_gate_exit_codes(self, tmp_path, capsys):
+        path = tmp_path / "ledger.jsonl"
+        for rec in (_record(1000.0), _record(950.0)):
+            perf_ledger.append_record(path, rec)
+        assert obs_main(["perf", str(path), "--gate"]) == 0
+        out = capsys.readouterr().out
+        assert "streaming" in out and "ok" in out
+        perf_ledger.append_record(path, _record(10.0))
+        assert obs_main(["perf", str(path)]) == 0  # render-only never gates
+        assert obs_main(["perf", str(path), "--gate"]) == 1
+        capsys.readouterr()  # drain the table renders before parsing JSON
+        assert obs_main(["perf", str(path), "--gate", "--json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["regressed"] is True
+
+    def test_cli_unreadable_ledger_exits_2(self, tmp_path, capsys):
+        assert obs_main(["perf", str(tmp_path / "missing.jsonl")]) == 2
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text("{not json}\n")
+        assert obs_main(["perf", str(bad)]) == 2
+
+    def test_committed_reference_gates_clean_and_catches_regression(
+        self, tmp_path, capsys
+    ):
+        """THE acceptance criterion: exit 0 on the committed reference
+        ledger, exit 1 once a synthetically regressed record lands."""
+        assert REFERENCE_LEDGER.is_file(), "committed reference ledger missing"
+        assert obs_main(["perf", str(REFERENCE_LEDGER), "--gate"]) == 0
+
+        records = perf_ledger.load_ledger(REFERENCE_LEDGER)
+        assert records, "reference ledger is empty"
+        regressed = json.loads(json.dumps(records[-1]))  # deep copy
+        for row in regressed["rows"]:
+            if isinstance(row.get("value"), (int, float)):
+                # regress every row in its OWN bad direction
+                direction = perf_ledger.DIRECTION_BY_UNIT.get(
+                    row.get("unit"), +1
+                )
+                row["value"] = (row["value"] * 0.2 if direction > 0
+                                else row["value"] * 5.0)
+        work = tmp_path / "gate.jsonl"
+        work.write_text(REFERENCE_LEDGER.read_text())
+        perf_ledger.append_record(work, regressed)
+        assert obs_main(["perf", str(work), "--gate"]) == 1
+        out = capsys.readouterr().out
+        assert "regressed" in out

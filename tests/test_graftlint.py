@@ -57,6 +57,9 @@ class TestRules:
         assert ("PTL002", "if flag:") in hits
         assert ("PTL002", "while x:") in hits
         assert ("PTL003", "return x.item()") in hits
+        # the devprof pattern: a cost/memory probe reachable from a
+        # merge-scope jit root is a host sync, obs/-scoping or not
+        assert ("PTL003", "return jax.block_until_ready(state)") in hits
         assert ("PTL005", "except Exception:") in hits
         assert ("PTL006", "rng = random.Random()") in hits
         assert any(r == "PTL004" and "len(docs)" in c for r, c in hits)
