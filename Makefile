@@ -7,7 +7,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test fuzz fuzz-differential fuzz-frames fuzz-crash chaos weak-scaling \
 	bench bench-smoke bench-streaming entry dryrun lint lint-baseline clean obs \
-	fleet perf-gate
+	fleet perf-gate serve-smoke bench-serve
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -45,6 +45,17 @@ obs:
 fleet:
 	$(CPU_ENV) $(PY) scripts/fleet_smoke.py --out /tmp/pt-fleet
 
+# serving-tier smoke (mirrors the CI serve-smoke job): overload burst ->
+# typed shed verdicts + bounded queue, redelivery -> byte equality, and
+# the `obs serve` health-check contract (exit 1 overloaded / 0 healthy);
+# artifacts land in /tmp/pt-serve
+serve-smoke:
+	$(CPU_ENV) $(PY) scripts/serve_smoke.py --out /tmp/pt-serve
+
+# sustained open-loop serving ladder: docs/s at the p99 apply-latency SLO
+bench-serve:
+	$(PY) bench.py --mode serve
+
 # streaming frame ingest vs oracle (spans + incremental patch streams)
 fuzz-frames:
 	$(CPU_ENV) $(PY) -m peritext_tpu.testing.fuzz --differential-frames
@@ -66,8 +77,9 @@ bench-engine:  # device-only streaming replay: the engine limit vs the link
 # ledger, then gated with per-row tolerance bands (exit 1 on regression)
 perf-gate:
 	cp perf/reference_ledger.jsonl /tmp/pt-perf-gate.jsonl
-	PT_BENCH_LADDER_ROWS="streaming,wire" $(PY) bench.py --mode ladder \
-		--smoke --platform cpu --devprof --ledger /tmp/pt-perf-gate.jsonl
+	PT_BENCH_LADDER_ROWS="streaming,wire,serve_sustained" $(PY) bench.py \
+		--mode ladder --smoke --platform cpu --devprof \
+		--ledger /tmp/pt-perf-gate.jsonl
 	$(PY) -m peritext_tpu.obs perf /tmp/pt-perf-gate.jsonl --gate
 
 entry:

@@ -9,6 +9,14 @@ and p50/p95/p99 per (stage, host).  The ``fleet`` command instead reads
 replication-lag picture: per (host, peer) ops-behind/ahead watermarks,
 staleness, failures, and any divergence incidents.
 
+The ``serve`` command reads ``/serve.json`` scrapes (or ``/health.json``
+bodies carrying a ``serve`` key) from one or more serving hosts and
+renders the serving tier's load picture: sessions, bounded-queue depth
+vs watermarks, typed verdict tallies (admitted / delayed / shed by
+reason), degradations, and the autotuned round-open window — exiting 1
+when any host is under sustained overload (backpressure engaged) or has
+shed load, so the command doubles as a fleet serving-health check.
+
 The ``perf`` command reads the append-only perf ledger
 (:mod:`peritext_tpu.obs.ledger`: bench ladder rows + devprof snapshots,
 one JSONL record per run) and renders the LAST record as a diff table
@@ -21,12 +29,13 @@ Usage::
     python -m peritext_tpu.obs summary flight-*.jsonl --json
     python -m peritext_tpu.obs merge -o merged.json hostA.json hostB.json
     python -m peritext_tpu.obs fleet hostA-convergence.json hostB.json
+    python -m peritext_tpu.obs serve hostA-serve.json hostB-serve.json
     python -m peritext_tpu.obs perf perf/reference_ledger.jsonl --gate
 
 ``summary`` is the default command (``python -m peritext_tpu.obs t.json``
-works).  Exit codes: 0 ok (fleet: converged; perf: no regression), 1 no
-spans found / fleet has lag or divergence / perf ``--gate`` regression,
-2 unreadable input.
+works).  Exit codes: 0 ok (fleet: converged; serve: healthy; perf: no
+regression), 1 no spans found / fleet has lag or divergence / serve has
+overload or shedding / perf ``--gate`` regression, 2 unreadable input.
 """
 
 from __future__ import annotations
@@ -158,6 +167,56 @@ def fleet_rows(snapshots: Sequence[Dict]) -> List[Dict]:
     return rows
 
 
+# -- serve view (/serve.json scrapes) ----------------------------------------
+
+
+def load_serve(path: str | Path) -> Dict:
+    """One host's serving snapshot from a ``/serve.json`` scrape or a
+    ``/health.json`` body whose ``serve`` key carries it."""
+    doc = json.loads(Path(path).read_text())
+    if isinstance(doc, dict) and "serve" in doc and "queue" not in doc:
+        doc = doc["serve"]
+    if not isinstance(doc, dict) or "queue" not in doc or "window" not in doc:
+        raise ValueError(f"{path}: not a serve snapshot")
+    return doc
+
+
+def serve_rows(snapshots: Sequence[Dict]) -> List[Dict]:
+    """Flatten host serve snapshots into per-host load rows."""
+    rows = []
+    for snap in snapshots:
+        q = snap.get("queue", {})
+        verdicts = q.get("verdicts", {})
+        shed_reasons = verdicts.get("shed_reasons", {})
+        # health reads RECENCY: sheds since the tier last kept up (an old
+        # scrape without the field falls back to the lifetime counter)
+        recent = snap.get("recent_sheds", verdicts.get("shed", 0))
+        rows.append({
+            "host": snap.get("host", "?"),
+            "sessions": snap.get("sessions", 0),
+            "docs": snap.get("docs", 0),
+            "depth": f"{q.get('depth', 0)}/{q.get('max_depth', 0)}",
+            "peak": q.get("peak", 0),
+            "admitted": verdicts.get("admitted", 0),
+            "delayed": verdicts.get("delayed", 0),
+            "shed": verdicts.get("shed", 0),
+            "recent_sheds": recent,
+            "degraded": snap.get("degraded_docs", 0),
+            "window_ms": round(
+                snap.get("window", {}).get("seconds", 0.0) * 1e3, 2
+            ),
+            "overloaded": "YES" if (
+                snap.get("overloaded") or q.get("backpressure")
+            ) else "",
+            "shed_reasons": ",".join(
+                f"{k}:{v}" for k, v in sorted(shed_reasons.items())
+            ),
+        })
+    rows.sort(key=lambda r: (r["overloaded"] != "YES", -r["recent_sheds"],
+                             r["host"]))
+    return rows
+
+
 def _perf_command(args) -> int:
     """Render/gate the perf ledger (see module doc)."""
     from . import ledger as _ledger
@@ -218,7 +277,7 @@ def _perf_command(args) -> int:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # default command: `python -m peritext_tpu.obs trace.json` == summary
-    if argv and argv[0] not in ("summary", "merge", "fleet", "perf",
+    if argv and argv[0] not in ("summary", "merge", "fleet", "serve", "perf",
                                 "-h", "--help"):
         argv.insert(0, "summary")
     parser = argparse.ArgumentParser(
@@ -239,6 +298,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     p_fleet.add_argument("paths", nargs="+")
     p_fleet.add_argument("--json", action="store_true",
+                         help="machine-readable rows instead of the table")
+    p_serve = sub.add_parser(
+        "serve", help="per-host serving-tier load table from serve.json "
+        "scrapes (exit 1 on overload/shedding)",
+    )
+    p_serve.add_argument("paths", nargs="+")
+    p_serve.add_argument("--json", action="store_true",
                          help="machine-readable rows instead of the table")
     p_perf = sub.add_parser(
         "perf", help="perf-ledger diff table: last record vs its rolling "
@@ -265,6 +331,43 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.cmd == "perf":
         return _perf_command(args)
+
+    if args.cmd == "serve":
+        snapshots = []
+        for p in args.paths:
+            try:
+                snapshots.append(load_serve(p))
+            except (OSError, ValueError, json.JSONDecodeError) as exc:
+                print(f"unreadable serve snapshot {p}: {exc}",
+                      file=sys.stderr)
+                return 2
+        rows = serve_rows(snapshots)
+        # SUSTAINED overload/shedding only: backpressure currently engaged,
+        # or sheds since the tier last kept up — a host that shed during a
+        # past blip and recovered must not latch unhealthy forever
+        total_shed = sum(r["recent_sheds"] for r in rows)
+        overloaded = sum(1 for r in rows if r["overloaded"] == "YES")
+        if args.json:
+            print(json.dumps({
+                "hosts": len(snapshots), "overloaded_hosts": overloaded,
+                "total_shed": total_shed, "rows": rows,
+            }, indent=2))
+        else:
+            print(f"{len(snapshots)} host(s) · {overloaded} overloaded · "
+                  f"{total_shed} frame(s) recently shed")
+            print(render_table(
+                rows,
+                cols=["host", "sessions", "docs", "depth", "peak",
+                      "admitted", "delayed", "shed", "recent_sheds",
+                      "degraded", "window_ms", "overloaded"],
+                left_cols=1,
+            ))
+            for r in rows:
+                if r["shed_reasons"]:
+                    print(f"  {r['host']}: shed {r['shed_reasons']}")
+        # a tier under sustained overload or shedding load is exit 1: the
+        # command doubles as a CI/cron serving-health check
+        return 1 if (overloaded or total_shed) else 0
 
     if args.cmd == "fleet":
         snapshots = []

@@ -14,6 +14,8 @@ Endpoints:
 * ``/trace.json``   — the attached tracer's Chrome trace-event dump
 * ``/devprof.json`` — the attached :class:`~.devprof.DeviceProfiler`
   snapshot (shape buckets, occupancy, memory watermarks)
+* ``/serve.json``   — the attached :class:`~..serve.SessionMux` snapshot
+  (sessions, bounded-queue + typed-verdict state, autotuned round window)
 """
 
 from __future__ import annotations
@@ -45,6 +47,7 @@ def prometheus_text(
     sentinel=None,
     convergence=None,
     devprof=None,
+    serve=None,
 ) -> str:
     """Prometheus text exposition of the process telemetry.  Counter names
     sanitize ``.`` → ``_`` under a ``peritext_`` prefix; histograms emit the
@@ -55,7 +58,11 @@ def prometheus_text(
     fleet-level totals; a :class:`~.devprof.DeviceProfiler` lands as
     per-site ``peritext_device_*`` gauges (distinct compiled shapes,
     dispatches, modeled flops/bytes totals, peak executable memory) plus
-    the bucket-occupancy and device-memory-watermark totals."""
+    the bucket-occupancy and device-memory-watermark totals; a
+    :class:`~..serve.SessionMux` lands as ``peritext_serve_*`` gauges
+    (sessions, bounded-queue depth/peak, backpressure flag, autotuned
+    window) plus the typed-verdict counters, with sheds labelled by
+    reason."""
     counters = counters or GLOBAL_COUNTERS
     histograms = histograms if histograms is not None else GLOBAL_HISTOGRAMS
     lines = []
@@ -157,6 +164,49 @@ def prometheus_text(
                 if value is not None:
                     lines.append(f"# TYPE {m} gauge")
                     lines.append(f"{m} {_fmt(value)}")
+    if serve is not None:
+        snap = serve.snapshot()
+        q = snap["queue"]
+        w = snap["window"]
+        for m, value in (
+            ("peritext_serve_sessions", snap["sessions"]),
+            ("peritext_serve_docs", snap["docs"]),
+            ("peritext_serve_doc_capacity", snap["doc_capacity"]),
+            ("peritext_serve_degraded_docs", snap["degraded_docs"]),
+            ("peritext_serve_rounds", snap["rounds"]),
+            ("peritext_serve_applied_frames", snap["applied_frames"]),
+            ("peritext_serve_buffered_frames", snap["buffered_frames"]),
+            ("peritext_serve_overloaded", int(snap["overloaded"])),
+            ("peritext_serve_queue_depth", q["depth"]),
+            ("peritext_serve_queue_peak", q["peak"]),
+            ("peritext_serve_queue_max_depth", q["max_depth"]),
+            ("peritext_serve_backpressure", int(q["backpressure"])),
+            ("peritext_serve_window_seconds", w["seconds"]),
+            ("peritext_serve_window_p99_round_seconds",
+             w["p99_round_seconds"]),
+        ):
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {_fmt(value)}")
+        verdicts = q["verdicts"]
+        for m, key in (
+            ("peritext_serve_submitted_total", "submitted"),
+            ("peritext_serve_admitted_total", "admitted"),
+            ("peritext_serve_delayed_total", "delayed"),
+        ):
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {_fmt(verdicts[key])}")
+        m = "peritext_serve_shed_total"
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {_fmt(verdicts['shed'])}")
+        # the by-reason breakdown is its OWN family: mixing an unlabelled
+        # total with labelled samples under one name would make a PromQL
+        # sum() double-count every shed
+        m = "peritext_serve_shed_reason_total"
+        lines.append(f"# TYPE {m} counter")
+        for reason, count in verdicts["shed_reasons"].items():
+            quoted = (reason.replace("\\", "\\\\").replace('"', '\\"')
+                      .replace("\n", "\\n"))
+            lines.append(f'{m}{{reason="{quoted}"}} {_fmt(count)}')
     if session is not None:
         health = session.health()
         for key in sorted(health):
@@ -215,12 +265,13 @@ class MetricsServer:
         sentinel=None,
         convergence=None,
         devprof=None,
+        serve=None,
     ) -> None:
         def metrics() -> str:
             return prometheus_text(
                 counters=counters, histograms=histograms,
                 session=session, sentinel=sentinel, convergence=convergence,
-                devprof=devprof,
+                devprof=devprof, serve=serve,
             )
 
         def snapshot() -> str:
@@ -228,7 +279,7 @@ class MetricsServer:
                 health_snapshot(
                     counters=counters, session=session, sentinel=sentinel,
                     histograms=histograms, recorder=recorder,
-                    convergence=convergence, devprof=devprof,
+                    convergence=convergence, devprof=devprof, serve=serve,
                 ),
                 default=str,
             )
@@ -250,6 +301,11 @@ class MetricsServer:
         if devprof is not None:
             routes["/devprof.json"] = (
                 lambda: json.dumps(devprof.snapshot()),
+                "application/json",
+            )
+        if serve is not None:
+            routes["/serve.json"] = (
+                lambda: json.dumps(serve.snapshot()),
                 "application/json",
             )
         self._httpd = ThreadingHTTPServer((host, port), _Handler)

@@ -47,7 +47,7 @@ GLOBAL_COUNTERS = Counters()
 
 #: counter/histogram namespaces that make up the fault-domain health surface
 _HEALTH_PREFIXES = ("streaming.", "transport.", "supervisor.", "merge.",
-                    "jit.", "convergence.")
+                    "jit.", "convergence.", "serve.")
 
 
 def health_snapshot(
@@ -58,6 +58,7 @@ def health_snapshot(
     recorder=None,
     convergence=None,
     devprof=None,
+    serve=None,
 ) -> Dict[str, Any]:
     """One structured dict for a fleet health endpoint: every fault-domain
     counter (quarantines, corrupt frames, transport retries / behind peers,
@@ -76,9 +77,11 @@ def health_snapshot(
     :class:`~.convergence.ConvergenceMonitor`, its per-peer lag watermarks
     and divergence tallies appear under ``convergence``; with a
     :class:`~.devprof.DeviceProfiler`, its shape-bucket / occupancy /
-    memory-watermark snapshot appears under ``devprof``.  Everything in the
-    snapshot is JSON-serializable (the exporter-schema golden test pins
-    this)."""
+    memory-watermark snapshot appears under ``devprof``; with a
+    :class:`~..serve.SessionMux` (or anything exposing the same
+    ``snapshot()``), its session/queue/verdict/window state appears under
+    ``serve``.  Everything in the snapshot is JSON-serializable (the
+    exporter-schema golden test pins this)."""
     from .histograms import GLOBAL_HISTOGRAMS
 
     counters = counters or GLOBAL_COUNTERS
@@ -108,4 +111,6 @@ def health_snapshot(
         out["convergence"] = convergence.snapshot()
     if devprof is not None:
         out["devprof"] = devprof.snapshot()
+    if serve is not None:
+        out["serve"] = serve.snapshot()
     return out

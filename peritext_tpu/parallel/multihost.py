@@ -359,6 +359,7 @@ class ReplicaServer:
         recorder=None,
         metrics_port: Optional[int] = None,
         monitor=None,
+        serve=None,
     ) -> None:
         """``on_changes`` receives each batch of newly-merged decoded
         changes; ``on_frame`` receives the RAW inbound frame bytes whenever
@@ -379,7 +380,10 @@ class ReplicaServer:
         (0 = ephemeral) mounts an :class:`~..obs.MetricsServer` exposing
         ``/metrics`` (Prometheus, with ``peritext_convergence_*`` gauges),
         ``/health.json``, ``/convergence.json`` and ``/trace.json`` — its
-        bound address is :attr:`metrics_address` after :meth:`start`."""
+        bound address is :attr:`metrics_address` after :meth:`start`;
+        ``serve`` (a :class:`~..serve.SessionMux`) additionally mounts
+        ``/serve.json`` and the ``peritext_serve_*`` gauges, so a serving
+        host's replica endpoint and serving telemetry share one scrape."""
         from ..obs import ConvergenceMonitor
 
         self.store = store
@@ -413,6 +417,7 @@ class ReplicaServer:
                     # /devprof.json answers (enabled: false) and the gauges
                     # appear the moment an operator arms GLOBAL_DEVPROF
                     devprof=GLOBAL_DEVPROF,
+                    serve=serve,
                 )
             except OSError:
                 # metrics port unavailable: release the already-bound

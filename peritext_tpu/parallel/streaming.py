@@ -479,10 +479,23 @@ class StreamingMerge:
         read_chunk: int = 8192,
         mesh=None,
         tracer=None,
+        static_rounds: bool = False,
     ) -> None:
         self.num_docs = num_docs
         self.actors = list(actors)
         self.mesh = mesh
+        #: serving-tier shape discipline (serve/ SessionMux): commit every
+        #: round through the PADDED (D, K) apply at the configured widths —
+        #: one XLA apply shape for the session's whole lifetime (plus the
+        #: log2 slot-window ladder) instead of the adaptive width / flat
+        #: stream-bucket / fused-depth variant space.  Trickle rounds pay
+        #: padded staging they don't fill, but a latency-SLO tier would
+        #: rather waste bucket occupancy than eat a multi-second XLA
+        #: compile inside a client's p99.  Meshless sessions only; sized
+        #: for serving hosts (thousands of docs), not 100K-doc analytics
+        #: sessions (whose block-chunked flat path exists for exactly the
+        #: opposite trade).
+        self.static_rounds = bool(static_rounds)
         #: pipeline-span producer (obs/spans.py).  Spans always measure, so
         #: per-round MergeStats work even with tracing off; they are only
         #: retained when the tracer is enabled or has sinks (e.g. the
@@ -1102,8 +1115,9 @@ class StreamingMerge:
         # streams already transfer only real ops, and at 100K-doc scale each
         # extra (width-set x stream-bucket) shape is a multi-second XLA
         # compile of the apply program — one shape amortizes across every
-        # block and round.
-        if self._padded_docs <= self._read_chunk:
+        # block and round.  static_rounds sessions (the serving tier) keep
+        # them fixed too: their whole point is ONE apply shape.
+        if self._padded_docs <= self._read_chunk and not self.static_rounds:
             ki, kd, km, kp = self._round_widths(pool, obj_streams, ki, kd, km, kp)
 
         enc = _RoundBuffers(self._padded_docs, ki, kd, km, kp)
@@ -1159,6 +1173,7 @@ class StreamingMerge:
         fuse = (
             len(batch) > 1
             and self.mesh is None
+            and not self.static_rounds
             and self._padded_docs <= self._read_chunk
         )
         if fuse:
@@ -1188,6 +1203,18 @@ class StreamingMerge:
                 arrays = encoded_arrays_of(enc)
                 arrays = shard_docs(arrays, self.mesh)
                 self.state = apply_batch_jit(self.state, arrays)
+            elif self.static_rounds:
+                # serving-tier static path: the padded (D, K) staging at
+                # the session's fixed widths — one apply shape forever
+                # (the slot-window bound stays pow-2 bucketed, a log2(S)
+                # ladder); see the __init__ note for the trade
+                s_cap = int(self.state.elem_id.shape[1])
+                bound = _width_bucket(int(self._cum_ins.max()))
+                self._apply_blocks = None
+                self.state = apply_batch_jit(
+                    self.state, encoded_arrays_of(enc),
+                    insert_loop_slots=bound if bound < s_cap else None,
+                )
             else:
                 # single-device path: flat streams proportional to real
                 # ops, padded layout rebuilt on device (_pad_from_flat)

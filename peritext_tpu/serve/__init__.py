@@ -1,0 +1,83 @@
+"""peritext_tpu.serve — the multi-tenant serving tier.
+
+The layer the ROADMAP's "production serving tier" item names: everything
+below this package converges documents (``parallel/streaming``), heals
+replicas (``parallel/gossip``) and measures itself (``obs/``), but nothing
+accepted *client sessions*, shed load when ingest outran device rounds, or
+traded latency for batch occupancy on purpose.  Three cooperating pieces:
+
+* :mod:`.admission` — typed admission verdicts (``admit`` / ``delay(hint)``
+  / ``shed(reason)``) over a bounded ingest queue with watermark-driven
+  backpressure.  A client op is NEVER silently dropped: every submission
+  either enters the queue or comes back with a typed verdict, and the
+  accounting identity ``submitted == admitted + delayed + shed`` is an
+  invariant the chaos harness asserts under 2x overload.
+* :mod:`.mux` — :class:`SessionMux`: many client sessions multiplexed onto
+  one :class:`~..parallel.streaming.StreamingMerge`'s slot buckets, behind
+  the existing ``InputOperation``/``Patch`` boundary (clients submit wire
+  frames or ``Change`` lists; they read per-session ``Patch`` streams).
+  The round-open window is autotuned from the rolling round-latency
+  histogram (:class:`BatchWindowTuner` — the batching-window sibling of
+  the PR-3 supervisor deadline autotuner), and sustained per-session
+  overload degrades through the PR-1 quarantine/fallback ladder
+  (``force_fallback``: scalar replay, degraded but correct) instead of
+  shedding one hot doc's writes forever.
+* :mod:`.traffic` — the sustained OPEN-LOOP traffic generator behind
+  ``bench.py --mode serve``: arrival times are fixed by the offered rate,
+  never by service completions, so queue growth under saturation is
+  visible instead of self-throttled; the ladder sweeps the rate until the
+  p99 apply-latency SLO breaks and reports docs/s at the SLO.  Also the
+  reconnect-storm workload (ROADMAP scenario item).
+
+Doc *placement* across a serving fleet is deliberately NOT here: the
+:class:`~..parallel.router.FleetRouter` lives in merge scope
+(``parallel/``) because placement must be a deterministic function of the
+observed load/lag state — graftlint's PTL006 guards it against wall-clock
+or RNG reads, while this package (wall-clock timing, queues, sleeps) sits
+outside merge scope by design.
+"""
+
+from .admission import (
+    ADMIT,
+    AdmissionController,
+    DELAY,
+    SHED,
+    SHED_CAPACITY,
+    SHED_DEGRADED,
+    SHED_OVERLOAD,
+    SHED_QUEUE_FULL,
+    SHED_REASONS,
+    SHED_SESSION_QUOTA,
+    SHED_UNKNOWN_SESSION,
+    Verdict,
+)
+from .mux import BatchWindowTuner, SessionMux
+from .traffic import (
+    LadderRung,
+    OpenLoopResult,
+    build_arrivals,
+    run_open_loop,
+    sustained_ladder,
+)
+
+__all__ = [
+    "ADMIT",
+    "AdmissionController",
+    "BatchWindowTuner",
+    "DELAY",
+    "LadderRung",
+    "OpenLoopResult",
+    "SHED",
+    "SHED_CAPACITY",
+    "SHED_DEGRADED",
+    "SHED_OVERLOAD",
+    "SHED_QUEUE_FULL",
+    "SHED_REASONS",
+    "SHED_SESSION_QUOTA",
+    "SHED_UNKNOWN_SESSION",
+    "SessionMux",
+    "Verdict",
+    "build_arrivals",
+    "run_open_loop",
+    "sustained_ladder",
+]

@@ -1,0 +1,404 @@
+"""Session multiplexing: many client sessions on one device pipeline.
+
+A :class:`SessionMux` is the serving tier's front door for ONE host: it
+maps client sessions onto a :class:`~..parallel.streaming.StreamingMerge`'s
+slot buckets, runs admission control over a bounded ingest queue
+(:mod:`.admission`), batches admitted frames into device rounds on an
+autotuned round-open window, and hands each session back its incremental
+``Patch`` stream — the same ``InputOperation``/``Patch`` vocabulary the
+ProseMirror bridge speaks, so an editor client cannot tell the mux from a
+direct session.
+
+**The round-open window** is the latency/occupancy dial: the mux collects
+arrivals for ``window`` seconds before closing a round, so a longer window
+means fuller padded op streams (better padding efficiency — the
+bucket-occupancy tables' metric) at the cost of per-op latency.
+:class:`BatchWindowTuner` picks it from the rolling round-latency
+percentile exactly the way the PR-3 supervisor picks its watchdog
+deadline — ``clamp(margin * rolling_p99(round_seconds), floor, ceiling)``
+— but clamps to the FLOOR when empty (lowest latency is the safe direction
+for a batching window; the deadline autotuner's empty-clamp is the
+ceiling, the safe direction for a watchdog).  The derivation: dispatching
+rounds faster than the device retires them only queues dispatches, so the
+window tracks what a round actually costs; a low-rate tenant mix produces
+cheap rounds and the window collapses to the floor (interactive latency),
+a saturating mix produces expensive rounds and the window stretches toward
+the ceiling (batch occupancy).  The window-movement test pins exactly that
+divergence.
+
+**Degradation** integrates the PR-1 quarantine/fallback ladder: a session
+whose quota sheds persist for ``degrade_after`` consecutive submissions is
+demoted via ``force_fallback`` (scalar replay — degraded but correct, off
+the device round budget) and its writes keep flowing; shedding is a
+pressure signal, never a silent write loss.
+
+Wall-clock reads are legal here (``serve/`` is outside graftlint's PTL006
+merge scope), but every read goes through the injected ``clock`` callable
+so tests drive the window deterministically.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..core.types import Change, Patch
+from ..obs import Counters, GLOBAL_COUNTERS, GLOBAL_HISTOGRAMS, Histogram
+from ..parallel.codec import encode_frame
+from ..parallel.streaming import REASON_CAPACITY, StreamingMerge
+from .admission import (
+    ADMIT,
+    AdmissionController,
+    SHED,
+    SHED_CAPACITY,
+    SHED_SESSION_QUOTA,
+    SHED_UNKNOWN_SESSION,
+    Verdict,
+)
+
+
+class BatchWindowTuner:
+    """Round-open window from the rolling round-latency percentile.
+
+    ``window_seconds() == clamp(margin * rolling_p{quantile}(round wall),
+    floor, ceiling)``; empty clamps to ``floor`` (see module doc for why
+    the empty direction inverts the supervisor's).  Observations come from
+    the mux's own committed rounds (measured around ``session.drain()``),
+    so the tuner adapts to THIS host's device and workload, not a global
+    histogram another session may be feeding.
+    """
+
+    def __init__(
+        self,
+        floor: float = 0.002,
+        ceiling: float = 0.25,
+        margin: float = 1.0,
+        quantile: float = 0.99,
+        window: int = 64,
+    ) -> None:
+        if not 0 < floor <= ceiling:
+            raise ValueError(
+                f"need 0 < floor <= ceiling, got {floor}/{ceiling}"
+            )
+        self.floor = float(floor)
+        self.ceiling = float(ceiling)
+        self.margin = float(margin)
+        self.quantile = float(quantile)
+        #: rolling window of recent committed-round walls (private
+        #: histogram: the tuner must see THIS mux's rounds only)
+        self.round_seconds = Histogram(window=window)
+
+    def observe(self, round_wall_seconds: float) -> None:
+        self.round_seconds.observe(round_wall_seconds)
+
+    def window_seconds(self) -> float:
+        if self.round_seconds.count == 0:
+            return self.floor
+        tuned = self.margin * self.round_seconds.percentile(self.quantile)
+        return float(min(self.ceiling, max(self.floor, tuned)))
+
+    def snapshot(self) -> Dict:
+        return {
+            "seconds": round(self.window_seconds(), 6),
+            "floor": self.floor,
+            "ceiling": self.ceiling,
+            "margin": self.margin,
+            "quantile": self.quantile,
+            "p99_round_seconds": round(
+                self.round_seconds.percentile(self.quantile), 6
+            ),
+            "rounds_observed": self.round_seconds.count,
+        }
+
+
+@dataclass
+class ClientSession:
+    """One multiplexed client session: a stable id, its doc slot, and its
+    verdict/degradation bookkeeping."""
+
+    session_id: int
+    client: str
+    doc_index: int
+    submitted: int = 0
+    admitted: int = 0
+    shed: int = 0
+    delayed: int = 0
+    #: consecutive quota sheds — ``degrade_after`` of them demotes the doc
+    quota_shed_streak: int = 0
+    degraded: bool = False
+    closed: bool = False
+    extras: Dict = field(default_factory=dict)
+
+    def to_json(self) -> Dict:
+        return {
+            "client": self.client,
+            "doc": self.doc_index,
+            "submitted": self.submitted,
+            "admitted": self.admitted,
+            "delayed": self.delayed,
+            "shed": self.shed,
+            "degraded": self.degraded,
+            "closed": self.closed,
+        }
+
+
+class SessionMux:
+    """Multiplexes client sessions onto one streaming device pipeline.
+
+    ``session`` is the backing :class:`StreamingMerge` (its ``num_docs`` is
+    the slot budget); sessions claim doc slots append-only — a closed
+    session's doc state stays resident (CRDT state is history, not a
+    buffer), so slot reuse is a placement concern for the
+    :class:`~..parallel.router.FleetRouter`, not the mux.  ``clock`` is
+    monotonic seconds (injected for tests).  All submission paths return a
+    typed :class:`~.admission.Verdict`; nothing is ever silently dropped.
+    """
+
+    def __init__(
+        self,
+        session: StreamingMerge,
+        admission: Optional[AdmissionController] = None,
+        tuner: Optional[BatchWindowTuner] = None,
+        degrade_after: int = 8,
+        clock: Callable[[], float] = time.monotonic,
+        counters: Optional[Counters] = None,
+        host: str = "local",
+    ) -> None:
+        self.session = session
+        self.admission = admission if admission is not None else AdmissionController()
+        self.tuner = tuner if tuner is not None else BatchWindowTuner()
+        self.degrade_after = int(degrade_after)
+        self.clock = clock
+        self.counters = counters if counters is not None else GLOBAL_COUNTERS
+        self.host = host
+        self._sessions: Dict[int, ClientSession] = {}
+        self._next_session = 0
+        self._next_doc = 0
+        #: the open round's buffered admitted frames:
+        #: (session_id, doc, frame_bytes, enqueue_clock)
+        self._buffer: List[Tuple[int, int, bytes, float]] = []
+        self._window_opened: Optional[float] = None
+        self.rounds = 0
+        self.applied = 0
+        self.degraded_docs = 0
+        #: when a list, per-frame apply latencies (enqueue -> committed
+        #: round) are appended here — the traffic generator's per-rung
+        #: percentile source (the histograms keep the fleet-wide view)
+        self.latency_sink: Optional[List[float]] = None
+        #: shed count at the last committed round — snapshot()'s
+        #: ``recent_sheds`` (sheds since the tier last kept up) derives
+        #: from it, so a host that shed once during a blip and then ran
+        #: clean rounds stops reporting unhealthy (the ``obs serve``
+        #: health check reads recency, not the process-lifetime counter)
+        self._shed_mark = 0
+
+    # -- session lifecycle ----------------------------------------------------
+
+    def open_session(self, client: str) -> Tuple[Optional[int], Verdict]:
+        """Claim a doc slot for a new client session.  Returns
+        ``(session_id, verdict)`` — ``session_id`` is None when the slot
+        budget is exhausted (typed ``capacity`` shed; the fleet router's
+        cue to place the doc on another host)."""
+        if self._next_doc >= self.session.num_docs:
+            return None, self.admission.shed_out_of_band(SHED_CAPACITY)
+        sid = self._next_session
+        self._next_session += 1
+        doc = self._next_doc
+        self._next_doc += 1
+        self._sessions[sid] = ClientSession(
+            session_id=sid, client=client, doc_index=doc,
+        )
+        self.counters.add("serve.sessions_opened")
+        return sid, Verdict(kind=ADMIT, queue_depth=self.admission.depth)
+
+    def close_session(self, session_id: int) -> None:
+        sess = self._sessions.get(session_id)
+        if sess is not None and not sess.closed:
+            sess.closed = True
+            self.counters.add("serve.sessions_closed")
+
+    def sessions(self) -> Dict[int, ClientSession]:
+        return dict(self._sessions)
+
+    # -- the ingest surface ---------------------------------------------------
+
+    def submit(self, session_id: int, frame: bytes) -> Verdict:
+        """Submit one wire frame for a session's doc.  ``admit`` buffers it
+        into the open round; ``delay``/``shed`` buffer nothing and the
+        client owns the retry.  A degraded session's frames are ingested
+        IMMEDIATELY on admit (scalar fallback replays host-side; holding
+        them for the device window would only add latency to a path that
+        no longer batches)."""
+        sess = self._sessions.get(session_id)
+        if sess is None or sess.closed:
+            return self.admission.shed_out_of_band(SHED_UNKNOWN_SESSION)
+        sess.submitted += 1
+        verdict = self.admission.offer(
+            session_id, cost=1, degraded=sess.degraded
+        )
+        if verdict.kind == ADMIT:
+            sess.admitted += 1
+            sess.quota_shed_streak = 0
+            now = self.clock()
+            if sess.degraded:
+                self.session.ingest_frame(
+                    sess.doc_index, frame, on_corrupt="quarantine"
+                )
+                self.admission.mark_applied(session_id, 1)
+                self.applied += 1
+            else:
+                if self._window_opened is None:
+                    self._window_opened = now
+                self._buffer.append((session_id, sess.doc_index, frame, now))
+        elif verdict.kind == SHED:
+            sess.shed += 1
+            if verdict.reason == SHED_SESSION_QUOTA:
+                sess.quota_shed_streak += 1
+                if (not sess.degraded
+                        and sess.quota_shed_streak >= self.degrade_after):
+                    self._degrade(sess)
+            else:
+                sess.quota_shed_streak = 0
+        else:
+            sess.delayed += 1
+        return verdict
+
+    def submit_changes(self, session_id: int,
+                       changes: Sequence[Change]) -> Verdict:
+        """The object-boundary form of :meth:`submit`: a batch of
+        ``Change`` objects (what ``bridge.Editor.dispatch_input_ops``
+        mints from ``InputOperation`` dicts) submitted as one frame."""
+        return self.submit(session_id, encode_frame(list(changes)))
+
+    def _degrade(self, sess: ClientSession) -> None:
+        """The quarantine/fallback rung for a hot session: sustained quota
+        shedding means the doc's ingest outruns its fair device-round
+        share, so it leaves the device path (scalar replay, correct but
+        degraded) and its writes keep flowing — typed quarantine evidence
+        included, never a silent drop."""
+        sess.degraded = True
+        self.degraded_docs += 1
+        self.counters.add("serve.degraded_sessions")
+        self.session.force_fallback(
+            sess.doc_index, REASON_CAPACITY,
+            "serve: sustained session-quota shedding "
+            f"({sess.quota_shed_streak} consecutive)",
+        )
+
+    # -- the round pump -------------------------------------------------------
+
+    def window_seconds(self) -> float:
+        return self.tuner.window_seconds()
+
+    def window_expired(self) -> bool:
+        """Whether the open round should close: its window elapsed, or
+        backpressure engaged (a queue above the high watermark must drain
+        NOW, not at the window's leisure)."""
+        if not self._buffer:
+            return False
+        if self.admission.backpressure:
+            return True
+        assert self._window_opened is not None
+        return (self.clock() - self._window_opened) >= self.window_seconds()
+
+    def pump(self, force: bool = False) -> int:
+        """Close the open round if its window expired (or ``force``) and
+        drain it through the device: bulk-ingest the buffered frames
+        (corrupt frames quarantine their doc — per-doc fault isolation,
+        never an exception out of the serving loop), run device rounds to
+        empty, release queue space, and feed the window tuner + latency
+        histograms.  Returns the number of frames applied."""
+        if not self._buffer or not (force or self.window_expired()):
+            return 0
+        batch, self._buffer = self._buffer, []
+        self._window_opened = None
+        t0 = self.clock()
+        self.session.ingest_frames(
+            [(doc, frame) for _, doc, frame, _ in batch],
+            on_corrupt="quarantine",
+        )
+        self.session.drain()
+        t1 = self.clock()
+        wall = max(0.0, t1 - t0)
+        self.rounds += 1
+        self.applied += len(batch)
+        self.tuner.observe(wall)
+        self.admission.observe_drain(len(batch), wall)
+        for sid, _, _, enq in batch:
+            self.admission.mark_applied(sid, 1)
+            lat = max(0.0, t1 - enq)
+            GLOBAL_HISTOGRAMS.observe("serve.apply_seconds", lat)
+            if self.latency_sink is not None:
+                self.latency_sink.append(lat)
+        GLOBAL_HISTOGRAMS.observe("serve.round_seconds", wall)
+        self.counters.add("serve.rounds")
+        self.counters.add("serve.applied_frames", len(batch))
+        if not self.admission.backpressure:
+            # the tier is keeping up again: sheds before this round are
+            # history, not current health
+            self._shed_mark = self.admission.stats.shed
+        return len(batch)
+
+    def flush(self) -> int:
+        """Force-close the open round regardless of its window (shutdown,
+        test sync points, the traffic generator's end-of-rung drain)."""
+        return self.pump(force=True)
+
+    def queue_depth(self) -> int:
+        return self.admission.depth
+
+    # -- the read surface -----------------------------------------------------
+
+    def patches(self, session_id: int) -> List[Patch]:
+        """The session's incremental ``Patch`` stream since its previous
+        call (first call builds the doc from empty) — the same vocabulary
+        the scalar path and the ProseMirror bridge emit."""
+        sess = self._require(session_id)
+        return self.session.read_patches(sess.doc_index)
+
+    def read(self, session_id: int):
+        """The session doc's resolved ``FormatSpan`` list."""
+        sess = self._require(session_id)
+        return self.session.read(sess.doc_index)
+
+    def _require(self, session_id: int) -> ClientSession:
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            raise KeyError(f"unknown serve session {session_id}")
+        return sess
+
+    # -- health ---------------------------------------------------------------
+
+    @property
+    def overloaded(self) -> bool:
+        """Sustained-overload flag: backpressure currently engaged, or the
+        open buffer alone can't drain (queue at max)."""
+        return self.admission.backpressure
+
+    def snapshot(self) -> Dict:
+        """The ``/serve.json`` body (golden-shape test pins these keys):
+        session table, bounded-queue state + typed verdict accounting,
+        autotuned window state, and the round/apply tallies."""
+        open_sessions = [s for s in self._sessions.values() if not s.closed]
+        return {
+            "host": self.host,
+            "sessions": len(open_sessions),
+            "sessions_total": len(self._sessions),
+            "docs": self._next_doc,
+            "doc_capacity": self.session.num_docs,
+            "degraded_docs": self.degraded_docs,
+            "rounds": self.rounds,
+            "applied_frames": self.applied,
+            "buffered_frames": len(self._buffer),
+            "overloaded": self.overloaded,
+            "recent_sheds": max(
+                0, self.admission.stats.shed - self._shed_mark
+            ),
+            "queue": self.admission.snapshot(),
+            "window": self.tuner.snapshot(),
+            "session_table": {
+                str(sid): s.to_json()
+                for sid, s in sorted(self._sessions.items())
+            },
+        }

