@@ -7,7 +7,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test fuzz fuzz-differential fuzz-frames fuzz-crash chaos weak-scaling \
 	bench bench-smoke bench-streaming entry dryrun lint lint-baseline clean obs \
-	fleet perf-gate serve-smoke bench-serve
+	fleet perf-gate serve-smoke bench-serve paged-smoke bench-longdoc
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -56,6 +56,18 @@ serve-smoke:
 bench-serve:
 	$(PY) bench.py --mode serve
 
+# paged-storage smoke (mirrors the CI paged-smoke job): small long-tail
+# session through both layouts — byte equality (spans/patches/digests),
+# occupancy improvement direction, peritext_page_* gauges + /devprof.json
+# page_pool section (artifacts land in /tmp/pt-paged)
+paged-smoke:
+	$(CPU_ENV) $(PY) scripts/paged_smoke.py --out /tmp/pt-paged
+
+# long-tail paged-vs-padded comparison row: one essay among a tweet fleet,
+# both layouts measured, byte equality asserted, waste ratio reported
+bench-longdoc:
+	$(PY) bench.py --mode longdoc
+
 # streaming frame ingest vs oracle (spans + incremental patch streams)
 fuzz-frames:
 	$(CPU_ENV) $(PY) -m peritext_tpu.testing.fuzz --differential-frames
@@ -77,7 +89,7 @@ bench-engine:  # device-only streaming replay: the engine limit vs the link
 # ledger, then gated with per-row tolerance bands (exit 1 on regression)
 perf-gate:
 	cp perf/reference_ledger.jsonl /tmp/pt-perf-gate.jsonl
-	PT_BENCH_LADDER_ROWS="streaming,wire,serve_sustained" $(PY) bench.py \
+	PT_BENCH_LADDER_ROWS="streaming,wire,serve_sustained,batch_longdoc" $(PY) bench.py \
 		--mode ladder --smoke --platform cpu --devprof \
 		--ledger /tmp/pt-perf-gate.jsonl
 	$(PY) -m peritext_tpu.obs perf /tmp/pt-perf-gate.jsonl --gate

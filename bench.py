@@ -629,6 +629,7 @@ def orchestrate(args, passthrough) -> int:
             "batch": "crdt_ops_per_sec_per_chip",
             "serve": "serve_sustained_docs_per_sec",
             "storm": "reconnect_storm_drain_ops_per_sec",
+            "longdoc": "longdoc_paged_ops_per_sec",
         }
         print(json.dumps({
             "metric": metric_of_mode.get(args.mode, "crdt_ops_per_sec_per_chip"),
@@ -1153,6 +1154,108 @@ def run_storm(args) -> dict:
     }
 
 
+def run_longdoc(args) -> dict:
+    """Long-tail workload family (ISSUE 8): one giant essay among a fleet
+    of tweets — the distribution the padded (doc x op) layout is worst at,
+    because every tweet pays the essay's stream width and slot bucket.
+
+    The SAME workload merges through the padded DocBatch (the byte-equality
+    oracle) and the paged DocBatch (store/: page pool + per-doc page
+    tables, size-bucketed groups); the row asserts byte equality, then
+    reports both layouts' wall clock and padded-op waste.  Headline =
+    paged throughput; ``vs_baseline`` = paged/padded speedup; the waste
+    ratio (absolute padded ops burned, padded / paged) is the number the
+    ROADMAP item is gated on.  ``--docs`` sizes the tweet fleet,
+    ``--ops-per-doc`` the essay."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    from peritext_tpu.api.batch import DocBatch
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    d_small, big_ops, small_ops = args.docs, args.ops_per_doc, 8
+    gen_start = time.perf_counter()
+    workloads = generate_workload(seed=args.seed + 1, num_docs=d_small,
+                                  ops_per_doc=small_ops)
+    workloads += generate_workload(seed=args.seed + 90_001, num_docs=1,
+                                   ops_per_doc=big_ops)
+    gen_time = time.perf_counter() - gen_start
+    total_ops = sum(
+        len(ch.ops) for w in workloads for log in w.values() for ch in log
+    )
+
+    # slot capacity: power of two covering the essay (both layouts share
+    # it — the padded layout must pay it for EVERY doc, which is the row's
+    # whole point; paged pays it only in the essay's page table).  Rounded
+    # to a page multiple so an odd --slots can't pass the padded half and
+    # then crash the paged half's alignment check.
+    from peritext_tpu.store import DEFAULT_PAGE_SIZE
+
+    slots = args.slots or 256
+    while slots < big_ops:
+        slots *= 2
+    slots = -(-slots // DEFAULT_PAGE_SIZE) * DEFAULT_PAGE_SIZE
+    marks = args.marks or max(64, big_ops // 4)
+
+    def measure(layout):
+        batch = DocBatch(slot_capacity=slots, mark_capacity=marks,
+                         layout=layout)
+        report = batch.merge(workloads)  # warmup (compiles)
+        t_best = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            report = batch.merge(workloads)
+            dt = time.perf_counter() - t0
+            t_best = dt if t_best is None or dt < t_best else t_best
+        return batch, report, t_best
+
+    padded_batch, padded, wall_padded = measure("padded")
+    paged_batch, paged, wall_paged = measure("paged")
+    assert padded.spans == paged.spans, "paged layout diverged from padded"
+    assert padded.roots == paged.roots, "paged roots diverged from padded"
+    assert padded.fallback_docs == paged.fallback_docs
+
+    # padded-op waste: absolute padded stream ops burned per layout (the
+    # devprof occupancy quantity, derivable here from padding_efficiency)
+    def wasted(report):
+        eff = report.stats.padding_efficiency
+        real = report.stats.device_ops + report.stats.fallback_ops
+        capacity = real / eff if eff else 0.0
+        return capacity - real, capacity
+
+    waste_padded, cap_padded = wasted(padded)
+    waste_paged, cap_paged = wasted(paged)
+    pool = paged_batch.last_store.pool_stats()
+    value = total_ops / wall_paged
+    return {
+        "metric": "longdoc_paged_ops_per_sec",
+        "value": round(value, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(wall_padded / wall_paged, 2),
+        "baseline_impl": "same long-tail workload through the padded layout",
+        "docs": d_small + 1,
+        "small_doc_ops": small_ops,
+        "big_doc_ops": big_ops,
+        "total_ops": total_ops,
+        "slot_capacity": slots,
+        "byte_equal": True,
+        "padded_ops_per_sec": round(total_ops / wall_padded, 1),
+        "wall_padded_s": round(wall_padded, 3),
+        "wall_paged_s": round(wall_paged, 3),
+        "stream_capacity_padded": round(cap_padded),
+        "stream_capacity_paged": round(cap_paged),
+        "padded_ops_wasted": round(waste_padded),
+        "paged_ops_wasted": round(waste_paged),
+        "waste_ratio": round(waste_padded / waste_paged, 2) if waste_paged else None,
+        "state_slots_padded": (d_small + 1) * slots,
+        "state_slots_paged": pool["pages_in_use"] * pool["page_size"],
+        "page_pool": pool,
+        "workload_gen_seconds": round(gen_time, 1),
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def run_sweep(args) -> dict:
     """Full-corpus sweep row (BASELINE config 5b, VERDICT r3 task 5): build
     an N-doc converged session on carried device state (the scale demo's
@@ -1182,6 +1285,7 @@ def run_sweep(args) -> dict:
         slot_capacity=512, mark_capacity=160, tomb_capacity=192,
         round_insert_capacity=192, round_delete_capacity=96,
         round_mark_capacity=96,
+        layout=args.layout,
     )
     t0 = time.perf_counter()
     for frame in frames:
@@ -1212,6 +1316,7 @@ def run_sweep(args) -> dict:
         "value": round(d / sweep, 1),
         "unit": "docs/s",
         "vs_baseline": None,
+        "layout": args.layout,
         "docs": d,
         "ops_per_doc_session": sum(len(c.ops) for c in changes),
         "total_ops": total_ops,
@@ -1245,10 +1350,13 @@ def ladder_rows(platform: str):
         ("batch_128_cpu", "2", ["--mode", "batch", "--docs", "128"], "cpu", t),
         ("serve_sustained", "-", ["--mode", "serve"], platform, t),
         ("reconnect_storm", "-", ["--mode", "storm"], platform, t),
-        ("batch_longdoc", "4b",
-         ["--mode", "batch", "--docs", "2048", "--ops-per-doc", "4096",
-          "--slots", "6144", "--marks", "640"], platform, t),
+        ("batch_longdoc", "4b", ["--mode", "longdoc"], platform, t),
         ("sweep_100k",   "5b", ["--mode", "sweep"], platform, max(t, 1800.0)),
+        # the paged-vs-padded sweep comparison: same 100K-doc corpus, paged
+        # resident storage — gate history is per row name, so regressions
+        # in EITHER layout's sweep show up independently
+        ("sweep_paged",  "5b", ["--mode", "sweep", "--layout", "paged"],
+         platform, max(t, 1800.0)),
     ]
 
 
@@ -1447,7 +1555,7 @@ def main() -> None:
     parser.add_argument(
         "--mode",
         choices=("batch", "streaming", "engine", "wire", "sweep", "baselines",
-                 "fleet", "serve", "storm", "ladder"),
+                 "fleet", "serve", "storm", "longdoc", "ladder"),
         default=None,
         help="batch = one-shot converge (configs 2-4); streaming = config 5 "
              "end-to-end; engine = device-only streaming replay (the engine "
@@ -1456,7 +1564,9 @@ def main() -> None:
              "baselines only; fleet = partition-heal time-to-convergence "
              "(ISSUE 4); serve = sustained open-loop serving ladder (docs/s "
              "at a p99 apply-latency SLO, ISSUE 7); storm = reconnect-storm "
-             "backlog drain under serving load; ladder = every row as "
+             "backlog drain under serving load; longdoc = long-tail "
+             "paged-vs-padded comparison (one essay among a tweet fleet, "
+             "ISSUE 8); ladder = every row as "
              "bounded sub-workers (the default when invoked with no mode "
              "and no --smoke)",
     )
@@ -1473,6 +1583,11 @@ def main() -> None:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--platform", default=None, help="force a jax platform (e.g. cpu)"
+    )
+    parser.add_argument(
+        "--layout", choices=("padded", "paged"), default="padded",
+        help="resident-state storage layout for the sweep row (the longdoc "
+             "row always measures both; other rows are padded-only)",
     )
     parser.add_argument(
         "--profile", default=None, metavar="DIR",
@@ -1509,6 +1624,10 @@ def main() -> None:
         # only the streaming runner consumes it; anything else would both
         # skip the default ladder AND silently write no trace
         parser.error("--trace-out requires --mode streaming")
+    if args.layout != "padded" and args.mode != "sweep":
+        # only the sweep runner consumes it (longdoc measures both layouts
+        # itself); anything else would silently measure the padded layout
+        parser.error("--layout requires --mode sweep")
 
     explicit_sizing = (
         any(v is not None for v in (args.docs, args.ops_per_doc, args.slots,
@@ -1544,6 +1663,9 @@ def main() -> None:
         defaults = (16, 48, 0, 0) if args.smoke else (64, 96, 0, 0)
     elif args.mode == "storm":
         defaults = (4, 30, 0, 0) if args.smoke else (8, 64, 0, 0)
+    elif args.mode == "longdoc":
+        # --docs = the tweet fleet, --ops-per-doc = the essay
+        defaults = (64, 512, 0, 0) if args.smoke else (1024, 8192, 0, 0)
     elif args.mode in ("streaming", "engine"):
         defaults = (64, 96, 256, 64) if args.smoke else (2048, 192, 384, 96)
     else:
@@ -1555,7 +1677,8 @@ def main() -> None:
 
     runners = {"streaming": run_streaming, "engine": run_engine, "batch": run,
                "wire": run_wire, "sweep": run_sweep, "baselines": run_baselines,
-               "fleet": run_fleet_heal, "serve": run_serve, "storm": run_storm}
+               "fleet": run_fleet_heal, "serve": run_serve, "storm": run_storm,
+               "longdoc": run_longdoc}
     if args.devprof:
         # arm the process profiler before any jit dispatches; cost capture
         # on — the worker is a bounded measurement run, and the AOT

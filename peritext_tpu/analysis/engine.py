@@ -30,7 +30,7 @@ class LintConfig:
 
     #: directory names whose files are "merge/convergence scope" (PTL001,
     #: PTL004's shape checks, PTL006)
-    merge_scope_dirs: frozenset = frozenset({"core", "ops", "parallel"})
+    merge_scope_dirs: frozenset = frozenset({"core", "ops", "parallel", "store"})
     #: functions that route a raw length into the padded-shape tables;
     #: shapes wrapped in one of these never recompile (streaming.py's
     #: ``_width_bucket`` is the canonical instance)

@@ -84,7 +84,28 @@ class DocBatch:
         mesh=None,
         guard: bool = False,
         tracer=None,
+        layout: str = "padded",
+        page_size: Optional[int] = None,
     ) -> None:
+        #: storage layout: "padded" (one (D, S) batch, every doc at the
+        #: widest bucket — the byte-equality oracle) or "paged" (store/
+        #: page pool + per-doc page tables; docs group by size bucket so
+        #: stream padding AND element-plane memory scale with real ops).
+        if layout not in ("padded", "paged"):
+            raise ValueError(f"unknown layout: {layout!r}")
+        if layout == "paged" and mesh is not None:
+            raise ValueError("layout='paged' does not support a mesh yet")
+        self.layout = layout
+        if page_size is None:
+            from ..store import DEFAULT_PAGE_SIZE
+
+            page_size = DEFAULT_PAGE_SIZE
+        self.page_size = int(page_size)
+        if layout == "paged" and slot_capacity % self.page_size:
+            raise ValueError(
+                f"slot_capacity {slot_capacity} must be a multiple of "
+                f"page_size {self.page_size} under layout='paged'"
+            )
         #: pipeline-span producer (obs/spans.py): merge() opens a
         #: ``batch.merge`` span with encode/apply/resolve/decode children,
         #: whose durations also feed MergeStats — one clock, two surfaces
@@ -109,6 +130,8 @@ class DocBatch:
         # kernel for every DocBatch.
         self._apply = apply_batch_jit if jit else apply_batch
         self._resolve = resolve_jit if jit else resolve
+        #: the page store of the most recent paged merge (telemetry/tests)
+        self.last_store = None
 
     # -- device pipeline ---------------------------------------------------
 
@@ -161,7 +184,10 @@ class DocBatch:
         device (ops/resolve.resolve_cursors); fallback docs via the oracle.
         """
         with self.tracer.span("batch.merge", docs=len(workloads)) as sp:
-            report = self._merge(workloads, cursors)
+            if self.layout == "paged":
+                report = self._merge_paged(workloads, cursors)
+            else:
+                report = self._merge(workloads, cursors)
         GLOBAL_HISTOGRAMS.observe("merge.seconds", sp.duration)
         return report
 
@@ -285,6 +311,262 @@ class DocBatch:
             )
             GLOBAL_DEVPROF.sample_memory()
         GLOBAL_COUNTERS.add("merge.calls")
+        GLOBAL_COUNTERS.add("merge.device_ops", device_ops)
+        GLOBAL_COUNTERS.add("merge.fallback_docs", len(fallback))
+        return MergeReport(
+            spans=spans,
+            fallback_docs=sorted(fallback),
+            device_ops=device_ops,
+            stats=stats,
+            cursor_positions=cursor_positions,
+            roots=roots,
+        )
+
+    # -- paged layout (store/) ----------------------------------------------
+
+    def _merge_paged(
+        self,
+        workloads: Sequence[Workload],
+        cursors: Optional[Sequence[Sequence[dict]]],
+    ) -> MergeReport:
+        """merge() under ``layout="paged"`` (store/paged.py): docs group
+        into power-of-two page-count buckets; each bucket encodes, applies
+        and resolves at ITS OWN widths through the page pool's gather-based
+        apply (ops/kernel.apply_batch_paged), so stream padding and
+        element-plane memory scale with real ops instead of every doc
+        paying the widest doc's bucket.  The padded path is the
+        byte-equality oracle — the differential tests pin spans / roots /
+        cursors equality across both layouts on every fuzz seed."""
+        from types import SimpleNamespace
+
+        from ..ops.decode import decode_block_spans, decode_doc_root
+        from ..ops.encode import _EMPTY_STREAMS, encode_doc_streams, pad_doc_streams
+        from ..store.paged import (
+            PagedDocStore,
+            _pow2,
+            group_stream_arrays,
+        )
+
+        stats = MergeStats(docs=len(workloads))
+        d_total = len(workloads)
+        with self.tracer.span("batch.encode") as sp:
+            per_doc, fb_encode, actor_tables, attr_tables, map_tables = (
+                encode_doc_streams(workloads)
+            )
+            fb_set = set(fb_encode)
+            # capacity fallback happens HERE, not in pad_doc_streams: group
+            # streams size to the subgroup max (that is the point of the
+            # layout), so the configured capacities act as per-doc fallback
+            # thresholds exactly as they do on the padded path — same docs
+            # fall back under both layouts
+            empty = _EMPTY_STREAMS
+            for d in range(d_total):
+                s = per_doc[d]
+                over = len(s.marks) > self.mark_capacity
+                if self.op_capacity is not None:
+                    over = over or len(s.ins) > self.op_capacity \
+                        or len(s.dels) > self.op_capacity
+                if over:
+                    fb_set.add(d)
+            # two-component size bucketing: page need (inserts drive slots —
+            # the delete/mark/register tables stay dense aux rows) AND a
+            # power-of-two total-op bucket.  The second component matters
+            # below one page: without it every sub-page tweet pads its
+            # streams to the widest tweet's op count, which is most of the
+            # long-tail waste the paged layout exists to kill.  Fallback
+            # docs carry no streams and ride the smallest bucket as no-ops.
+            max_pages = max(1, self.slot_capacity // self.page_size)
+            buckets: Dict[tuple, List[int]] = {}
+            for d in range(d_total):
+                s = empty if d in fb_set else per_doc[d]
+                ops = len(s.ins) + len(s.dels) + len(s.marks) + len(s.maps)
+                g = min(
+                    _pow2(-(-max(1, len(s.ins)) // self.page_size)), max_pages
+                )
+                buckets.setdefault((g, _pow2(max(8, ops))), []).append(d)
+            groups = [(g, np.asarray(buckets[(g, sb)], np.int64))
+                      for g, sb in sorted(buckets)]
+            encs = []
+            for g, docs in groups:
+                local_fb = [i for i, d in enumerate(docs) if int(d) in fb_set]
+                enc_g = pad_doc_streams(
+                    [empty if int(d) in fb_set else per_doc[int(d)]
+                     for d in docs],
+                    local_fb,
+                    [actor_tables[int(d)] for d in docs],
+                    [attr_tables[int(d)] for d in docs],
+                    map_tables=[map_tables[int(d)] for d in docs],
+                )
+                encs.append((g, docs, enc_g))
+        stats.encode_seconds = sp.duration
+
+        try:
+            with self.tracer.span("batch.apply") as sp:
+                tomb_cap = max(
+                    (enc.del_target.shape[1] for _, _, enc in encs), default=8
+                )
+                store = PagedDocStore(
+                    d_total,
+                    slot_capacity=self.slot_capacity,
+                    mark_capacity=self.mark_capacity,
+                    tomb_capacity=tomb_cap,
+                    map_capacity=self.map_capacity,
+                    page_size=self.page_size,
+                )
+                self.last_store = store
+                stream_capacity = 0
+                real_ops = 0
+                for g, docs, enc in encs:
+                    ins_counts = (np.asarray(enc.ins_op) != 0).sum(axis=1)
+                    store.ensure_rows(docs, ins_counts)
+                    b = _pow2(len(docs))
+                    store.apply_rows(
+                        docs, g, group_stream_arrays(enc, None, b),
+                        pad_rows_to=b,
+                    )
+                    widths = (
+                        enc.ins_op.shape[1], enc.del_target.shape[1],
+                        next(iter(enc.marks.values())).shape[1],
+                        next(iter(enc.map_ops.values())).shape[1],
+                    )
+                    # capacity is what the DISPATCHED program paid: b padded
+                    # rows, not the real group size — the streaming paged
+                    # path and the occupancy table must agree on this
+                    group_cap = b * sum(widths)
+                    stream_capacity += group_cap
+                    real_ops += int(enc.num_ops.sum())
+                    if GLOBAL_DEVPROF.enabled:
+                        GLOBAL_DEVPROF.observe_round(
+                            occupancy_key(b, *widths),
+                            int(enc.num_ops.sum()), group_cap,
+                            origin="batch.merge.paged",
+                        )
+                # host sync: time apply honestly (mirror of _merge)
+                np.asarray(store.aux_field("num_slots"))
+            stats.apply_seconds = sp.duration
+
+            with self.tracer.span("batch.resolve") as sp:
+                resolved_groups = []
+                for g, docs, enc in encs:
+                    # same power-of-two row bucket as the apply: gather,
+                    # resolve and cursor programs compile once per
+                    # (rows-bucket, pages-bucket, widths), never per exact
+                    # group size; padding rows are masked downstream
+                    b = _pow2(len(docs))
+                    state_g = store.materialize_rows(docs, g, pad_rows_to=b)
+                    res_dev = self._resolve(state_g, self.comment_capacity)
+                    res_np = type(res_dev)(*(np.asarray(x) for x in res_dev))
+                    resolved_groups.append((docs, enc, state_g, res_dev, res_np))
+            stats.resolve_seconds = sp.duration
+        except Exception as exc:  # graftlint: boundary(guarded merge: ANY device-path failure degrades to the scalar oracle; re-raised when unguarded)
+            if not self.guard:
+                raise
+            return self._degraded_merge(workloads, cursors, stats, exc)
+
+        fallback = set(fb_encode)
+        for docs, enc, _, _, res_np in resolved_groups:
+            fallback.update(int(docs[i]) for i in enc.fallback_docs)
+            # only the REAL rows: padding rows clamp-gather a neighbor's aux
+            # and may carry its overflow flag
+            fallback.update(
+                int(docs[int(i)])
+                for i in np.nonzero(res_np.overflow[: len(docs)])[0]
+            )
+
+        oracle_docs: Dict[int, Doc] = {}
+
+        def oracle_doc_for(d: int) -> Doc:
+            if d not in oracle_docs:
+                oracle_docs[d] = _oracle_doc(workloads[d])
+            return oracle_docs[d]
+
+        cursor_positions: Optional[List[List[int]]] = None
+        if cursors is not None:
+            from ..ops.resolve import (
+                oracle_cursor_positions,
+                pack_cursor_rows,
+                resolve_cursors_jit,
+            )
+
+            cursor_positions = [[] for _ in range(d_total)]
+            for docs, enc, state_g, res_dev, _ in resolved_groups:
+                local_map = {
+                    i: list(cursors[int(d)])
+                    for i, d in enumerate(docs)
+                    if int(d) not in fallback
+                }
+                if not any(local_map.values()):
+                    continue
+                cursor_elem = pack_cursor_rows(
+                    local_map, int(state_g.elem_id.shape[0]),
+                    lambda i: enc.actor_tables[i],
+                )
+                positions = np.asarray(
+                    resolve_cursors_jit(state_g, res_dev.visible, cursor_elem)
+                )
+                for i, d in enumerate(docs):
+                    if int(d) not in fallback:
+                        cursor_positions[int(d)] = [
+                            int(p) for p in positions[i, : len(cursors[int(d)])]
+                        ]
+            for d in sorted(fallback):
+                cursor_positions[d] = oracle_cursor_positions(
+                    oracle_doc_for(d), cursors[d]
+                )
+
+        with self.tracer.span("batch.decode") as sp:
+            spans: List[Optional[List[FormatSpan]]] = [None] * d_total
+            roots: List[Optional[dict]] = [None] * d_total
+            device_ops = 0
+            fallback_ops = 0
+            for docs, enc, state_g, _, res_np in resolved_groups:
+                mask = np.zeros(res_np.visible.shape[0], bool)
+                mask[: len(docs)] = [int(d) not in fallback for d in docs]
+                block_spans = decode_block_spans(
+                    res_np,
+                    lambda i: enc.attr_tables[i],
+                    lambda i: enc.attr_tables[i],
+                    doc_mask=mask,
+                )
+                regs = SimpleNamespace(
+                    r_obj=np.asarray(state_g.r_obj),
+                    r_key=np.asarray(state_g.r_key),
+                    r_op=np.asarray(state_g.r_op),
+                    r_kind=np.asarray(state_g.r_kind),
+                    r_val=np.asarray(state_g.r_val),
+                    num_regs=np.asarray(state_g.num_regs),
+                )
+                for i, d in enumerate(docs):
+                    d = int(d)
+                    if d in fallback:
+                        doc = oracle_doc_for(d)
+                        spans[d] = doc.get_text_with_formatting(["text"])
+                        roots[d] = doc.root
+                        fallback_ops += int(enc.num_ops[i])
+                    else:
+                        spans[d] = block_spans[i]
+                        roots[d] = decode_doc_root(
+                            regs, res_np, i, enc.map_tables[i]
+                        )
+                        device_ops += int(enc.num_ops[i])
+        stats.decode_seconds = sp.duration
+
+        stats.device_ops = device_ops
+        stats.fallback_ops = fallback_ops
+        stats.fallback_docs = len(fallback)
+        stats.device_docs = d_total - len(fallback)
+        stats.padding_efficiency = (
+            real_ops / stream_capacity if stream_capacity else 0.0
+        )
+        pool = store.pool_stats()
+        stats.extras["layout_paged"] = 1.0
+        stats.extras["page_pool_utilization"] = pool["pool_utilization"]
+        stats.extras["page_internal_frag_ratio"] = pool["internal_frag_ratio"]
+        if GLOBAL_DEVPROF.enabled:
+            GLOBAL_DEVPROF.observe_page_pool(pool)
+            GLOBAL_DEVPROF.sample_memory()
+        GLOBAL_COUNTERS.add("merge.calls")
+        GLOBAL_COUNTERS.add("merge.paged_calls")
         GLOBAL_COUNTERS.add("merge.device_ops", device_ops)
         GLOBAL_COUNTERS.add("merge.fallback_docs", len(fallback))
         return MergeReport(

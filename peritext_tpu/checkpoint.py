@@ -156,6 +156,13 @@ def save_session(session, directory: str | Path) -> Dict[str, Any]:
     tables, and fallback routing exactly — no device-state serialization to
     keep consistent.  Frames are duplicate-tolerant, so overlap between a
     checkpoint and post-checkpoint redelivery is harmless.
+
+    Layout-agnostic by the same token: a paged session (store/) checkpoints
+    as the identical frame history — pages, page tables and the pool are
+    derived state the restore rebuilds — and the ``layout`` (plus
+    ``page_size``) rides in the config, so restore constructs the same
+    backend.  The top-level ``layout`` key mirrors it for scrapers that
+    read checkpoint metadata without parsing the config.
     """
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
@@ -171,6 +178,7 @@ def save_session(session, directory: str | Path) -> Dict[str, Any]:
         "actors": list(session.actors),
         "rounds": session.rounds,
         "frames": total,
+        "layout": getattr(session, "layout", "padded"),
         "config": session.config,
     }
     (directory / "session.json").write_text(json.dumps(meta, indent=2))

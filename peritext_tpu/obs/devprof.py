@@ -147,6 +147,8 @@ class DeviceProfiler:
         self._mem_last: Optional[int] = None
         self._mem_peak: Optional[int] = None
         self._mem_backend_peak: Optional[int] = None
+        self._page_pool: Optional[Dict[str, Any]] = None
+        self._page_pool_peak_util = 0.0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -167,6 +169,8 @@ class DeviceProfiler:
             self._mem_last = None
             self._mem_peak = None
             self._mem_backend_peak = None
+            self._page_pool = None
+            self._page_pool_peak_util = 0.0
 
     def __enter__(self) -> "DeviceProfiler":
         return self.enable()
@@ -267,6 +271,18 @@ class DeviceProfiler:
             occ.real_ops += int(real_ops)
             occ.padded_capacity += int(padded_capacity)
 
+    def observe_page_pool(self, stats: Dict[str, Any]) -> None:
+        """Fold one page-pool snapshot (store/paged.PagedDocStore
+        ``pool_stats()``) in: the latest snapshot is kept whole (pool
+        utilization, pages in use, internal fragmentation per doc-size
+        decile) plus a peak-utilization watermark across the profiled
+        region — the paged layout's waste story, sampled at round
+        boundaries like the memory watermarks."""
+        with self._lock:
+            self._page_pool = dict(stats)
+            util = float(stats.get("pool_utilization") or 0.0)
+            self._page_pool_peak_util = max(self._page_pool_peak_util, util)
+
     # -- device-memory watermarks -------------------------------------------
 
     def sample_memory(self) -> Optional[int]:
@@ -332,6 +348,12 @@ class DeviceProfiler:
                     else self._mem_peak
                 ),
             }
+            page_pool = (
+                dict(self._page_pool,
+                     peak_utilization=round(self._page_pool_peak_util, 4))
+                if self._page_pool is not None
+                else None
+            )
         return {
             "enabled": self.enabled,
             "capture_costs": self.capture_costs,
@@ -344,6 +366,9 @@ class DeviceProfiler:
                 "padding_waste": round(1.0 - real / padded, 4) if padded else 0.0,
             },
             "memory": memory,
+            # None until a paged store reports in — padded-only processes
+            # export no page section (the golden-shape test pins both forms)
+            "page_pool": page_pool,
         }
 
 

@@ -155,6 +155,29 @@ def prometheus_text(
         ):
             lines.append(f"# TYPE {m} gauge")
             lines.append(f"{m} {_fmt(value)}")
+        pp = dp.get("page_pool")
+        if pp:
+            # paged-storage gauges (store/paged.PagedDocStore.pool_stats):
+            # pool occupancy + internal fragmentation, with the per-decile
+            # fragmentation breakdown as a labelled family
+            for m, value in (
+                ("peritext_page_pool_pages", pp["pool_pages"]),
+                ("peritext_page_pages_in_use", pp["pages_in_use"]),
+                ("peritext_page_pool_utilization", pp["pool_utilization"]),
+                ("peritext_page_pool_peak_utilization",
+                 pp.get("peak_utilization", pp["pool_utilization"])),
+                ("peritext_page_pool_growths", pp["growths"]),
+                ("peritext_page_docs_resident", pp["docs_resident"]),
+                ("peritext_page_internal_frag_slots", pp["internal_frag_slots"]),
+                ("peritext_page_internal_frag_ratio", pp["internal_frag_ratio"]),
+                ("peritext_page_size_slots", pp["page_size"]),
+            ):
+                lines.append(f"# TYPE {m} gauge")
+                lines.append(f"{m} {_fmt(value)}")
+            m = "peritext_page_frag_ratio"
+            lines.append(f"# TYPE {m} gauge")
+            for decile, value in sorted(pp.get("frag_by_decile", {}).items()):
+                lines.append(f'{m}{{decile="{decile}"}} {_fmt(value)}')
         mem = dp["memory"]
         if mem["available"]:
             for m, value in (

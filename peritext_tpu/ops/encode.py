@@ -270,14 +270,23 @@ def _round8(n: int) -> int:
     return max(8, -(-n // 8) * 8)
 
 
-def encode_workloads(
+#: shared all-empty stream set: the stand-in for capacity-fallback docs in
+#: grouped (paged) encoding — their real streams must not inflate a group's
+#: widths, and their rows stay all-zero no-ops
+_EMPTY_STREAMS = _DocStreams()
+
+
+def encode_doc_streams(
     workloads: Sequence[Dict[str, List[Change]]],
-    insert_capacity: Optional[int] = None,
-    delete_capacity: Optional[int] = None,
-    mark_capacity: Optional[int] = None,
-    map_capacity: Optional[int] = None,
-) -> EncodedBatch:
-    """Encode a batch of per-doc change-log sets (dict actor -> [Change])."""
+):
+    """The per-doc half of :func:`encode_workloads`: causal sort + intern +
+    stream split for every doc, WITHOUT padding into a shared (D, K) shape.
+    Returns ``(per_doc, fallback, actor_tables, attr_tables, map_tables)``.
+
+    Exposed separately so the paged layout (api/batch.py ``layout="paged"``)
+    can group docs by size BEFORE padding — each size bucket pads to its own
+    widths via :func:`pad_doc_streams` instead of every doc paying the
+    widest doc's stream width."""
     per_doc: List[Optional[_DocStreams]] = []
     actor_tables: List[OrderedActorTable] = []
     attr_tables: List[Interner] = []
@@ -310,6 +319,20 @@ def encode_workloads(
         attr_tables.append(attrs)
         map_tables.append(keys)
 
+    return per_doc, fallback, actor_tables, attr_tables, map_tables
+
+
+def encode_workloads(
+    workloads: Sequence[Dict[str, List[Change]]],
+    insert_capacity: Optional[int] = None,
+    delete_capacity: Optional[int] = None,
+    mark_capacity: Optional[int] = None,
+    map_capacity: Optional[int] = None,
+) -> EncodedBatch:
+    """Encode a batch of per-doc change-log sets (dict actor -> [Change])."""
+    per_doc, fallback, actor_tables, attr_tables, map_tables = (
+        encode_doc_streams(workloads)
+    )
     return pad_doc_streams(
         per_doc,
         fallback,

@@ -265,6 +265,113 @@ def apply_batch(
     return state
 
 
+# -- paged storage (store/): gather-based apply through a page table --------
+#
+# The paged layout (store/paged.py) keeps the element planes in a global
+# (N_pages, P) pool with per-doc page tables instead of a padded (D, S)
+# batch.  The apply path gathers ONLY the dispatched docs' pages into a
+# dense (B, G*P) group — G the group's power-of-two page-count bucket — runs
+# the exact same phase pipeline (apply_batch; byte-identical math), and
+# scatters the element pages + aux rows back.  Page 0 is the reserved NULL
+# page: page-table padding slots gather zeros from it, their scatters all
+# land on it, and the program re-zeroes it last so padding can never leak
+# state between docs.  Per-round device work therefore scales with
+# sum(touched docs x their own bucket width), not docs x widest-doc width.
+
+#: PackedDocs fields that stay dense per-doc rows under the paged layout
+#: (tombstones/marks/registers/scalars are small; the element planes are
+#: where the padded waste lives)
+PAGED_AUX_FIELDS = tuple(
+    f for f in PackedDocs._fields if f not in ("elem_id", "char")
+)
+
+
+def paged_state_of(pool_elem, pool_char, aux, row_idx, page_rows) -> PackedDocs:
+    """Dense (B, G*P) PackedDocs view of ``row_idx``'s docs, gathered from
+    the page pool through ``page_rows`` (B, G) and the dense aux rows.
+    Out-of-range padding in ``row_idx`` clamps (jit gather semantics) to a
+    real row whose streams are all-zero no-ops at apply time."""
+    b, g = page_rows.shape
+    p = pool_elem.shape[1]
+    elem = pool_elem[page_rows].reshape(b, g * p)
+    char = pool_char[page_rows].reshape(b, g * p)
+    sub = {f: a[row_idx] for f, a in zip(PAGED_AUX_FIELDS, aux)}
+    return PackedDocs(elem_id=elem, char=char, **sub)
+
+
+_gather_paged_jit = jax.jit(paged_state_of)
+
+
+def gather_paged_state_jit(pool_elem, pool_char, aux, row_idx, page_rows) -> PackedDocs:
+    """jit-compiled :func:`paged_state_of` — the materialization program the
+    paged read/digest paths dispatch (one program per (B, G) bucket)."""
+    args = (pool_elem, pool_char, aux, row_idx, page_rows)
+    if GLOBAL_DEVPROF.enabled:
+        _note_dispatch("gather_paged_state", _gather_paged_jit, args)
+    return _gather_paged_jit(*args)
+
+
+def apply_batch_paged(
+    pool_elem,
+    pool_char,
+    aux,  # tuple of dense (D, ...) arrays in PAGED_AUX_FIELDS order
+    row_idx,  # (B,) int32 doc rows (padding >= D: gathers clamp, scatters drop)
+    page_rows,  # (B, G) int32 page ids (padding entries = 0, the null page)
+    encoded_arrays,  # the apply_batch stream tuple with (B, ...) doc axes
+    *,
+    insert_impl: str = "auto",
+    insert_loop_slots: int | None = None,
+):
+    """Gather-through-page-table apply: the paged twin of
+    :func:`apply_batch`.  Returns ``(pool_elem, pool_char, aux)`` updated.
+
+    The math is exactly :func:`apply_batch` on the gathered dense view, so
+    a paged backend is byte-identical to the padded one by construction —
+    the layouts differ only in where the slots live between rounds."""
+    state = paged_state_of(pool_elem, pool_char, aux, row_idx, page_rows)
+    state = apply_batch(
+        state, encoded_arrays,
+        insert_impl=insert_impl, insert_loop_slots=insert_loop_slots,
+    )
+    b, g = page_rows.shape
+    p = pool_elem.shape[1]
+    flat = page_rows.reshape(-1)
+    pool_elem = pool_elem.at[flat].set(state.elem_id.reshape(b * g, p))
+    pool_char = pool_char.at[flat].set(state.char.reshape(b * g, p))
+    # padding page-table entries all scattered onto the null page; restore it
+    pool_elem = pool_elem.at[0].set(0)
+    pool_char = pool_char.at[0].set(0)
+    aux = tuple(
+        a.at[row_idx].set(getattr(state, f))
+        for f, a in zip(PAGED_AUX_FIELDS, aux)
+    )
+    return pool_elem, pool_char, aux
+
+
+_apply_batch_paged_jit = jax.jit(
+    apply_batch_paged, static_argnames=("insert_impl", "insert_loop_slots")
+)
+
+
+def apply_batch_paged_jit(pool_elem, pool_char, aux, row_idx, page_rows,
+                          encoded_arrays, *, insert_impl: str = "auto",
+                          insert_loop_slots: int | None = None):
+    """jit-compiled :func:`apply_batch_paged` (``"auto"`` resolved at the
+    boundary from the pool arrays' placement, as in :func:`apply_batch_jit`)."""
+    if insert_impl == "auto":
+        insert_impl = resolve_insert_impl(pool_elem)
+    if GLOBAL_DEVPROF.enabled:
+        _note_dispatch(
+            "apply_batch_paged", _apply_batch_paged_jit,
+            (pool_elem, pool_char, aux, row_idx, page_rows, encoded_arrays),
+            dict(insert_impl=insert_impl, insert_loop_slots=insert_loop_slots),
+        )
+    return _apply_batch_paged_jit(
+        pool_elem, pool_char, aux, row_idx, page_rows, encoded_arrays,
+        insert_impl=insert_impl, insert_loop_slots=insert_loop_slots,
+    )
+
+
 def _pad_from_flat(flat, counts, width: int):
     """(N,) flat per-doc-concatenated values + (D,) counts -> (D, width)
     zero-padded rows, reconstructed on device with ONE gather (host->device

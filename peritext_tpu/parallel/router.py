@@ -49,24 +49,50 @@ class HostSlot:
     #: replication lag (ops behind the observing frontier) — the
     #: ConvergenceMonitor watermark, folded in by :meth:`FleetRouter.observe`
     lag_ops: int = 0
+    #: paged-storage load (store/): pages the host's pool holds, from a
+    #: paged session's ``reshard()["page_load"]`` / ``health()["page_pool"]``.
+    #: Once observed — even at 0, a fresh empty pool — the host is marked
+    #: ``paged`` and pages ARE its device dimension: a paged host's scarce
+    #: resource is pool pages, and slot-unit estimates would overweight
+    #: long docs that actually share pages with nobody.  Unit contract:
+    #: placement ``size`` for a paged host is in PAGES, and a MIXED fleet
+    #: (paged + padded hosts in one router) must feed page-normalized
+    #: sizes/loads on the padded side too — the greedy compares the
+    #: dimensions directly and never converts units.
+    page_load: int = 0
+    #: latched by the first ``observe(page_load=...)`` — see above
+    paged: bool = False
     #: a draining host accepts no new docs (operator decommission, or the
     #: serving tier reacting to sustained overload)
     draining: bool = False
     #: per-doc placed sizes (doc_key -> size), the rebalance input
     placed: Dict[str, int] = field(default_factory=dict)
+    #: doc_keys whose placed size was counted into ``page_load`` (placed
+    #: AFTER the paged latch): _unassign must only subtract from the
+    #: dimension the size was added to, or a pre-latch slot-unit doc would
+    #: wipe the page estimate on eviction
+    page_counted: set = field(default_factory=set)
     #: doc_keys currently host-bound (quarantined/fallback) on this host
     bound_docs: Dict[str, int] = field(default_factory=dict)
 
+    def device_load(self) -> int:
+        """The device-dimension load: reported pool pages for paged hosts
+        (a fresh empty pool counts as 0, not as "fall back to slots"),
+        slot load otherwise (see ``page_load``)."""
+        return self.page_load if self.paged else self.slot_load
+
     def effective_load(self, lag_weight: int) -> int:
-        """Device-dimension placement load: slot load plus the lag penalty
+        """Device-dimension placement load: device load plus the lag penalty
         (a behind host is 'fuller' — new docs would read stale there)."""
-        return self.slot_load + lag_weight * self.lag_ops
+        return self.device_load() + lag_weight * self.lag_ops
 
     def to_json(self) -> Dict:
         return {
             "capacity": self.capacity,
             "docs": self.docs,
             "slot_load": self.slot_load,
+            "page_load": self.page_load,
+            "paged": self.paged,
             "host_bound_load": self.host_bound_load,
             "lag_ops": self.lag_ops,
             "draining": self.draining,
@@ -118,11 +144,14 @@ class FleetRouter:
         slot_load: Optional[int] = None,
         host_bound_load: Optional[int] = None,
         lag_ops: Optional[int] = None,
+        page_load: Optional[int] = None,
     ) -> None:
         """Fold one host's measured state in: ``slot_load`` /
         ``host_bound_load`` from its session's ``reshard()`` dimensions or
         health snapshot, ``lag_ops`` from a ConvergenceMonitor watermark
-        (``peers()[host].ops_behind`` as observed by the routing frontend).
+        (``peers()[host].ops_behind`` as observed by the routing frontend),
+        ``page_load`` from a paged session's ``reshard()["page_load"]`` sum
+        (pages become the device dimension — see ``HostSlot.page_load``).
         Measurements REPLACE the router's accumulated estimates — the
         estimate is only the prior between observations."""
         rec = self._hosts[name]
@@ -132,6 +161,9 @@ class FleetRouter:
             rec.host_bound_load = int(host_bound_load)
         if lag_ops is not None:
             rec.lag_ops = int(lag_ops)
+        if page_load is not None:
+            rec.page_load = int(page_load)
+            rec.paged = True
 
     def observe_monitor(self, monitor) -> None:
         """Fold every registered host's lag watermark from one
@@ -183,6 +215,12 @@ class FleetRouter:
         self._doc_host[doc_key] = host.name
         host.docs += 1
         host.slot_load += size
+        if host.paged:
+            # paged host: size is in PAGES (the caller sizes docs off the
+            # paged reshard dimensions); keep the active dimension moving
+            # between observations so the greedy stays monotone
+            host.page_load += size
+            host.page_counted.add(doc_key)
         host.placed[doc_key] = size
         if host_bound:
             host.host_bound_load += size
@@ -194,6 +232,9 @@ class FleetRouter:
         size = host.placed.pop(doc_key)
         host.docs -= 1
         host.slot_load -= size
+        if doc_key in host.page_counted:
+            host.page_counted.discard(doc_key)
+            host.page_load = max(0, host.page_load - size)
         bound = doc_key in host.bound_docs
         if bound:
             host.host_bound_load -= host.bound_docs.pop(doc_key)

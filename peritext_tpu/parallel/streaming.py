@@ -461,7 +461,28 @@ class StreamingMerge:
     ``actors`` declares the replica set whose changes may arrive (needed up
     front: packed op-ID order requires a complete ordered actor table; an
     undeclared actor demotes that doc to scalar-replay fallback).
+
+    ``layout`` selects the resident-state storage: ``"padded"`` (this
+    class: one (D, S) element batch, every doc at the slot capacity) or
+    ``"paged"`` (store/session.PagedStreamingMerge: a global op-page pool
+    + per-doc page tables, gathered per round at each doc's own size
+    bucket).  The constructor is the factory — ``StreamingMerge(...,
+    layout="paged")`` builds the paged subclass; the padded layout remains
+    the byte-equality oracle.
     """
+
+    #: storage layout of this class (the paged subclass overrides)
+    _layout = "padded"
+
+    def __new__(cls, *args, **kwargs):
+        layout = kwargs.get("layout", "padded")
+        if layout not in ("padded", "paged"):
+            raise ValueError(f"unknown layout: {layout!r}")
+        if cls is StreamingMerge and layout == "paged":
+            from ..store.session import PagedStreamingMerge
+
+            return super().__new__(PagedStreamingMerge)
+        return super().__new__(cls)
 
     def __init__(
         self,
@@ -480,10 +501,18 @@ class StreamingMerge:
         mesh=None,
         tracer=None,
         static_rounds: bool = False,
+        layout: str = "padded",
     ) -> None:
         self.num_docs = num_docs
         self.actors = list(actors)
         self.mesh = mesh
+        # static capacities as plain attributes: the paged layout has no
+        # (D, S) self.state to read shapes off, so every capacity consumer
+        # (compact width caps, digest pad terms, config) uses these
+        self._slot_capacity = int(slot_capacity)
+        self._mark_capacity = int(mark_capacity)
+        self._tomb_capacity = int(tomb_capacity)
+        self._map_capacity = int(map_capacity)
         #: serving-tier shape discipline (serve/ SessionMux): commit every
         #: round through the PADDED (D, K) apply at the configured widths —
         #: one XLA apply shape for the session's whole lifetime (plus the
@@ -614,9 +643,14 @@ class StreamingMerge:
         # the occupied prefix, not the whole slot capacity.  Maintained at
         # every admission site; reshard() permutes it with the rows.
         self._cum_ins = np.zeros(self._padded_docs, np.int64)
-        state = empty_docs(self._padded_docs, slot_capacity, mark_capacity,
-                           tomb_capacity, map_capacity=map_capacity)
-        self.state: PackedDocs = shard_docs(state, mesh) if mesh is not None else state
+        if self._layout == "padded":
+            state = empty_docs(self._padded_docs, slot_capacity, mark_capacity,
+                               tomb_capacity, map_capacity=map_capacity)
+            self.state: PackedDocs = shard_docs(state, mesh) if mesh is not None else state
+        else:
+            # paged layout: the element planes live in the page pool the
+            # subclass builds after this init; there is no (D, S) batch
+            self.state = None
 
     # -- ingestion ---------------------------------------------------------
 
@@ -1779,11 +1813,16 @@ class StreamingMerge:
         if bool(resolved.overflow[local]):
             return doc_chars_scalar(_replay_doc(self._replay_changes(sess)))
         attrs, comments = self._attr_tables(sess, doc_index)
+        # the doc's element row comes from the same BLOCK the resolution
+        # used (layout-independent: the paged backend materializes blocks
+        # at their page-bucketed width, and the elem row must align with
+        # the resolved planes' slot axis)
+        bi = int(self._row_of[doc_index]) // self._read_chunk
         return doc_chars_device(
             resolved,
             local,
             attrs,
-            np.asarray(self.state.elem_id[int(self._row_of[doc_index])]),
+            np.asarray(self._state_block(bi).elem_id[local]),
             self._actor_table,
             comments,
         )
@@ -1915,7 +1954,7 @@ class StreamingMerge:
         if width is None:
             width = min(
                 _width_bucket(int(_max_visible_jit(entry.device.visible))),
-                self.state.slot_capacity,
+                self._slot_capacity,
             )
             self._compact_width[-1] = width
         self._compact_width[block_index] = width
@@ -1941,10 +1980,14 @@ class StreamingMerge:
         if live.any():
             need = int(c.n_vis[live].max())
             if need > width:
-                wide = min(_width_bucket(need), self.state.slot_capacity)
+                wide = min(_width_bucket(need), self._slot_capacity)
+                entry = self._resolution(block_index)
+                # never wider than the block's resolved planes: the paged
+                # backend materializes blocks below slot capacity, and an
+                # over-wide take would silently truncate the packed layout
+                wide = min(wide, int(entry.device.char.shape[1]))
                 self._compact_width[block_index] = wide
                 self._compact_width[-1] = max(self._compact_width.get(-1) or 0, wide)
-                entry = self._resolution(block_index)
                 buf = _compact_packed_jit(
                     entry.device,
                     self._state_block(block_index).elem_id, wide,
@@ -2105,7 +2148,7 @@ class StreamingMerge:
         if self._padded_docs % n_shards:
             raise ValueError("padded doc axis must divide the shard count")
         rows_per_shard = self._padded_docs // n_shards
-        sizes = np.asarray(self.state.num_slots)[self._row_of[: self.num_docs]]
+        sizes = self._reshard_sizes()
         host_bound = {
             d for d in range(self.num_docs)
             if self.docs[d].fallback or d in self._quarantine
@@ -2167,9 +2210,7 @@ class StreamingMerge:
             for r in range(self._padded_docs):
                 if src[r] < 0:
                     src[r] = next(spare)
-            idx = jnp.asarray(src)
-            state = PackedDocs(*(jnp.take(x, idx, axis=0) for x in self.state))
-            self.state = shard_docs(state, self.mesh) if self.mesh is not None else state
+            self._permute_rows(src)
             self._cum_ins = self._cum_ins[src]  # occupancy bound rides the rows
             self._row_of = new_row
             self._doc_at = np.full(self._padded_docs, -1, np.int64)
@@ -2188,6 +2229,20 @@ class StreamingMerge:
                 host_bound_load[s] += int(sizes[d])
         return {"moved": moved, "shard_load": shard_load,
                 "host_bound_load": host_bound_load}
+
+    def _reshard_sizes(self) -> np.ndarray:
+        """(num_docs,) per-doc load for reshard's balancing — live device
+        slots under the padded layout; the paged subclass balances PAGES
+        (the resource its pool actually spends)."""
+        return np.asarray(self.state.num_slots)[self._row_of[: self.num_docs]]
+
+    def _permute_rows(self, src: np.ndarray) -> None:
+        """Move physical doc rows per ``src`` (new row r takes old row
+        src[r]) — one gather over the padded layout's doc axis; the paged
+        subclass permutes page TABLES and aux rows instead."""
+        idx = jnp.asarray(src)
+        state = PackedDocs(*(jnp.take(x, idx, axis=0) for x in self.state))
+        self.state = shard_docs(state, self.mesh) if self.mesh is not None else state
 
     def _digest_tables_rows(self, rows: np.ndarray, n_real: int):
         """Digest hash tables for a GATHERED row subset (the sub-batch
@@ -2383,7 +2438,7 @@ class StreamingMerge:
                     for r in np.nonzero(ov & on_device_all[lo:hi])[0]
                     if int(self._doc_at[int(r) + lo]) >= 0
                 )
-        s_cap = self.state.slot_capacity
+        s_cap = self._slot_capacity
         for i in replay_docs:
             doc = _replay_doc(self._replay_changes(self.docs[i]))
             cps, slots = _doc_char_slots(doc)
@@ -2551,18 +2606,21 @@ class StreamingMerge:
         """Constructor-shape configuration (for checkpoint restore)."""
         return {
             "num_docs": self.num_docs,
-            "slot_capacity": self.state.slot_capacity,
-            "mark_capacity": self.state.mark_capacity,
-            "tomb_capacity": self.state.tomb_capacity,
+            "slot_capacity": self._slot_capacity,
+            "mark_capacity": self._mark_capacity,
+            "tomb_capacity": self._tomb_capacity,
             "round_insert_capacity": self.round_caps[0],
             "round_delete_capacity": self.round_caps[1],
             "round_mark_capacity": self.round_caps[2],
             "round_map_capacity": self.round_caps[3],
             "comment_capacity": self.comment_capacity,
-            "map_capacity": self.state.map_capacity,
+            "map_capacity": self._map_capacity,
             # the REQUESTED value: a mesh session's effective block is its
             # whole padded batch, but a meshless restore must block reads
             "read_chunk": self._read_chunk_requested,
+            # the storage layout rides in the config so checkpoint restore
+            # (and serve snapshots) rebuild the same backend
+            "layout": self._layout,
         }
 
     def frontier(self) -> Clock:
@@ -2596,6 +2654,17 @@ class StreamingMerge:
     def pending_count(self) -> int:
         pooled = sum(int(self._frame_mode[d].sum()) for d, _ in self._pool)
         return pooled + sum(len(s.pending) for s in self.docs)
+
+    @property
+    def layout(self) -> str:
+        """Resident-state storage layout ("padded" or "paged")."""
+        return self._layout
+
+    def sync_device(self) -> None:
+        """Block until all dispatched device work has completed (a cheap
+        host fetch of one per-doc scalar plane) — the layout-independent
+        sync point the supervisor's guarded rounds use."""
+        np.asarray(self.state.num_slots)
 
 
 def _doc_char_slots(doc: Doc):
@@ -2684,7 +2753,7 @@ class _PendingDigest:
         )
         from .mesh import doc_digest_host
 
-        s_cap = s.state.slot_capacity
+        s_cap = s._slot_capacity
         for i in replay_docs:
             doc = _replay_doc(s._replay_changes(s.docs[i]))
             cps, slots = _doc_char_slots(doc)

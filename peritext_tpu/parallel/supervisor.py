@@ -246,7 +246,7 @@ class GuardedSession:
         # dispatch (or here, before the next checkpoint), and rollback
         # restores the same checkpoint+journal state either way.
         if self._rounds_since_checkpoint + 1 >= self.checkpoint_every:
-            np.asarray(session.state.num_slots)
+            session.sync_device()
         return scheduled
 
     def _run_guarded(self, fn: Callable[[], int],
@@ -412,7 +412,7 @@ class GuardedSession:
         rounds = 0
         while session.drain() > 0:
             rounds += 1
-        np.asarray(session.state.num_slots)
+        session.sync_device()
         return rounds
 
     # -- pass-throughs ------------------------------------------------------

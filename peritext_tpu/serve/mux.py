@@ -381,8 +381,12 @@ class SessionMux:
         session table, bounded-queue state + typed verdict accounting,
         autotuned window state, and the round/apply tallies."""
         open_sessions = [s for s in self._sessions.values() if not s.closed]
-        return {
+        snap = {
             "host": self.host,
+            # the backing session's storage layout — a fleet scrape must be
+            # able to tell paged serving hosts (page-pool gauges live) from
+            # padded ones without a second endpoint
+            "layout": getattr(self.session, "layout", "padded"),
             "sessions": len(open_sessions),
             "sessions_total": len(self._sessions),
             "docs": self._next_doc,
@@ -402,3 +406,7 @@ class SessionMux:
                 for sid, s in sorted(self._sessions.items())
             },
         }
+        pool = getattr(self.session, "store", None)
+        if pool is not None:
+            snap["page_pool"] = pool.pool_stats()
+        return snap

@@ -1,0 +1,46 @@
+"""peritext_tpu.store — paged document storage.
+
+The padded ``(D docs x S slots)`` layout pays the widest doc's cost for
+every doc: one 500K-op essay among 100K tweets forces every row to the
+essay's slot capacity, and the PR-5 bucket-occupancy tables measure exactly
+how much compute and memory that burns.  This package replaces the padded
+element planes with the TPU-native recipe Ragged Paged Attention uses for
+ragged KV caches: a device-resident global pool of FIXED-SIZE op pages
+plus a per-doc page table, gathered into dense work groups at dispatch
+time — so resident memory and per-round device work scale with real ops,
+not with the widest doc's bucket.
+
+Pieces:
+
+* :mod:`.alloc` — :class:`PageAllocator`: the deterministic free-list
+  allocator (lowest-page-id-first, sorted walks, no wall clock/RNG —
+  ``store/`` is graftlint merge scope ON PURPOSE: two replicas allocating
+  for the same ingest order must build identical page tables) with
+  ``grow`` / ``compact`` / ``evacuate`` and the typed
+  :class:`PoolExhausted` error.
+* :mod:`.paged` — :class:`PagedDocStore`: the device pool (element planes
+  paged; the small per-doc aux tables — tombstones, marks, registers —
+  stay dense rows), page-table bookkeeping, bucketed group planning, and
+  the materialize/apply plumbing over :func:`ops.kernel.apply_batch_paged`.
+* :mod:`.session` — :class:`PagedStreamingMerge`: ``StreamingMerge``
+  with the paged store as its resident state (selected via
+  ``StreamingMerge(layout="paged")``); commits gather only the touched
+  docs at their size bucket, reads/digests materialize per block at
+  page-bucketed width with the pad-term corrected so digests stay
+  bit-equal to a padded session.
+
+The padded layout remains the default AND the byte-equality oracle: every
+fuzz seed and recorded trace must produce identical docs, patches and
+digests under both layouts (tests/test_store.py).
+"""
+
+from .alloc import PageAllocator, PoolExhausted
+from .paged import DEFAULT_PAGE_SIZE, PagedDocStore, plan_page_groups
+
+__all__ = [
+    "DEFAULT_PAGE_SIZE",
+    "PageAllocator",
+    "PagedDocStore",
+    "PoolExhausted",
+    "plan_page_groups",
+]

@@ -1,0 +1,424 @@
+"""PagedStreamingMerge: StreamingMerge over the page pool.
+
+Selected via ``StreamingMerge(layout="paged")``.  The host half of every
+round (causal admission, frame scheduling, staging buffers) is shared with
+the padded engine verbatim; what changes is WHERE device state lives and
+WHAT each round dispatches:
+
+* **Commit** — instead of applying all D docs at the session slot capacity,
+  the round's touched rows group into power-of-two page-count buckets and
+  each group dispatches one gather→apply→scatter program
+  (ops/kernel.apply_batch_paged) at its own width, so per-round device work
+  is ``sum(touched docs x their bucket)`` — one 500K-op essay among 100K
+  tweets costs its own pages, not everyone's.
+* **Reads/digests** — blocks materialize on demand from the pool at the
+  block's page-bucketed width (cached per round like the padded block
+  cache).  The per-doc full-state hash includes a pad-slot term
+  (mesh.per_doc_text_digest hashes ``slot_capacity - n_visible`` pad
+  slots), so every paged digest program adds the missing
+  ``(S - W) * avalanche(PAD_SEED)`` per live doc — digests are BIT-EQUAL
+  to a padded session's, which is what lets mixed-layout fleets compare
+  frontiers and the byte-equality oracle pin the layouts against each
+  other.
+* **reshard()** — balances PAGES (the resource the pool actually spends):
+  page tables and aux rows permute, pages never move.  The return gains a
+  ``page_load`` dimension for the FleetRouter.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..obs import (
+    GLOBAL_COUNTERS,
+    GLOBAL_DEVPROF,
+    GLOBAL_HISTOGRAMS,
+    MergeStats,
+    SIZE_BUCKETS,
+    note_jit_dispatch,
+    occupancy_key,
+)
+from ..ops.packed import PackedDocs
+from ..ops.resolve import resolve
+from ..parallel import mesh as _mesh
+from ..parallel.streaming import (
+    StreamingMerge,
+    _BlockResolution,
+    _doc_char_slots,
+    _per_doc_full_digest,
+    _replay_doc,
+    _width_bucket,
+)
+from .paged import (
+    DEFAULT_PAGE_SIZE,
+    PagedDocStore,
+    _pow2,
+    group_stream_arrays,
+    plan_page_groups,
+)
+
+
+def _pad_unit() -> int:
+    """Host value of one pad slot's digest contribution —
+    avalanche(PAD_SEED), the same constant mesh.per_doc_text_digest folds
+    per non-visible slot (and doc_digest_host multiplies by the pad
+    count)."""
+    x = (_mesh._PAD_SEED * _mesh._KF) & 0xFFFFFFFF
+    return x ^ (x >> 15)
+
+
+_PAD_UNIT = _pad_unit()
+
+
+@partial(jax.jit, static_argnums=1)
+def _resolve_block_digest_paged_jit(
+    state: PackedDocs, comment_capacity: int, row_mask, pad_slots,
+    sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
+):
+    """The paged twin of streaming._resolve_block_digest_jit: resolution at
+    the block's materialized width W plus the per-doc full-state hash, with
+    the ``pad_slots = S - W`` pad-term correction folded in so the hash
+    equals what the padded layout computes at width S."""
+    resolved = resolve(state, comment_capacity, with_comments=True)
+    per_doc = _per_doc_full_digest(
+        state, resolved, row_mask,
+        sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
+    )
+    mask = row_mask & ~resolved.overflow
+    per_doc = jnp.where(
+        mask, per_doc + pad_slots * jnp.uint32(_PAD_UNIT), jnp.uint32(0)
+    )
+    return resolved, per_doc
+
+
+@partial(jax.jit, static_argnums=1)
+def _rows_digest_paged_jit(
+    sub: PackedDocs, comment_capacity: int, row_mask, pad_slots,
+    sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
+):
+    """Paged twin of streaming._rows_digest_jit (gathered dirty-row
+    sub-batch), pad-term corrected."""
+    resolved = resolve(sub, comment_capacity, with_comments=True)
+    per_doc = _per_doc_full_digest(
+        sub, resolved, row_mask,
+        sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
+    )
+    mask = row_mask & ~resolved.overflow
+    per_doc = jnp.where(
+        mask, per_doc + pad_slots * jnp.uint32(_PAD_UNIT), jnp.uint32(0)
+    )
+    return per_doc, resolved.overflow
+
+
+@partial(jax.jit, static_argnums=1)
+def _resolve_digest_paged_jit(
+    state: PackedDocs, comment_capacity: int, row_mask, pad_slots
+):
+    """Paged twin of streaming._resolve_digest_jit (TEXT-ONLY digest),
+    pad-term corrected per contributing doc."""
+    resolved = resolve(state, comment_capacity, with_comments=False)
+    mask = row_mask & ~resolved.overflow
+    per_doc = _mesh.per_doc_text_digest(resolved.char, resolved.visible)
+    per_doc = jnp.where(
+        mask, per_doc + pad_slots * jnp.uint32(_PAD_UNIT), jnp.uint32(0)
+    )
+    return jnp.sum(per_doc, dtype=jnp.uint32), resolved.overflow
+
+
+class PagedStreamingMerge(StreamingMerge):
+    """StreamingMerge whose resident element planes live in a page pool
+    (module doc).  Meshless sessions only for now; ``static_rounds`` (the
+    serving tier's one-shape discipline) stays on the padded layout."""
+
+    _layout = "paged"
+
+    def __init__(self, num_docs, actors, *args,
+                 layout: str = "paged",
+                 page_size: int = DEFAULT_PAGE_SIZE,
+                 pool_pages: Optional[int] = None,
+                 max_pool_pages: Optional[int] = None,
+                 **kwargs) -> None:
+        if layout != "paged":
+            raise ValueError(f"PagedStreamingMerge is layout='paged', got {layout!r}")
+        if kwargs.get("mesh") is not None:
+            raise ValueError("layout='paged' does not support a mesh yet")
+        if kwargs.get("static_rounds"):
+            raise ValueError(
+                "layout='paged' is incompatible with static_rounds: the "
+                "serving shape discipline is exactly the padded one-shape "
+                "apply; use the padded layout for static-round serving"
+            )
+        self.page_size = int(page_size)
+        super().__init__(num_docs, actors, *args, layout="paged", **kwargs)
+        if self._slot_capacity % self.page_size:
+            raise ValueError(
+                f"slot_capacity {self._slot_capacity} must be a multiple of "
+                f"page_size {self.page_size} under layout='paged'"
+            )
+        self._store = PagedDocStore(
+            self._padded_docs,
+            slot_capacity=self._slot_capacity,
+            mark_capacity=self._mark_capacity,
+            tomb_capacity=self._tomb_capacity,
+            map_capacity=self._map_capacity,
+            page_size=self.page_size,
+            initial_pages=pool_pages,
+            max_pool_pages=max_pool_pages,
+        )
+        #: per-(round, epoch) materialized-block cache (<= 2 blocks, the
+        #: paged analog of the padded path's _apply_blocks reuse)
+        self._mat_cache: tuple = ((-1, -1), {})
+        #: per-round-buffer dispatched stream capacity (feeds the stats
+        #: override: padded capacity is what the GROUPS paid, not D x K)
+        self._commit_caps: Dict[int, int] = {}
+
+    # -- store access --------------------------------------------------------
+
+    @property
+    def store(self) -> PagedDocStore:
+        return self._store
+
+    @property
+    def config(self) -> Dict[str, int]:
+        cfg = dict(StreamingMerge.config.fget(self))
+        cfg["page_size"] = self.page_size
+        return cfg
+
+    def sync_device(self) -> None:
+        np.asarray(self._store.aux_field("num_slots"))
+
+    def health(self) -> Dict:
+        h = super().health()
+        h["layout"] = "paged"
+        h["page_pool"] = self._store.pool_stats()
+        return h
+
+    # -- the paged device half of a round ------------------------------------
+
+    def _commit_rounds(self, batch) -> None:
+        """Dispatch scheduled rounds through the page pool: per round, the
+        touched rows (and only them) group by page bucket and each group
+        runs one gather-apply-scatter program at its own width."""
+        for enc, widths in batch:
+            self._cum_ins += enc.ins_count
+            rows = np.nonzero(enc.num_ops)[0]
+            if len(rows):
+                self._store.ensure_rows(rows, self._cum_ins[rows])
+                self._dispatch_paged_round(enc, widths, rows)
+                self._digest_row_valid[rows] = False
+            self.rounds += 1
+            GLOBAL_COUNTERS.add("streaming.rounds")
+        if GLOBAL_DEVPROF.enabled:
+            GLOBAL_DEVPROF.observe_page_pool(self._store.pool_stats())
+
+    def _dispatch_paged_round(self, enc, widths, rows: np.ndarray) -> None:
+        groups = plan_page_groups(
+            rows, self._store.num_pages, self._store.max_doc_pages
+        )
+        cap_total = 0
+        for g, g_rows in groups:
+            b = _pow2(len(g_rows))
+            self._store.apply_rows(
+                g_rows, g, group_stream_arrays(enc, g_rows, b),
+                pad_rows_to=b,
+            )
+            cap = b * sum(widths)
+            cap_total += cap
+            if GLOBAL_DEVPROF.enabled:
+                GLOBAL_DEVPROF.observe_round(
+                    occupancy_key(b, *widths),
+                    int(enc.num_ops[g_rows].sum()), cap,
+                    origin="streaming.paged",
+                )
+        self._commit_caps[id(enc)] = cap_total
+
+    def _emit_round_stats(self, batch, scheduled: int,
+                          schedule_s: float, apply_s: float) -> None:
+        """Padded capacity under the paged layout is what the dispatched
+        GROUPS paid (rows-bucket x widths per bucket), recorded at commit
+        time — the base accounting's D x widths would charge the whole
+        session for every trickle round."""
+        touched: set = set()
+        real = 0
+        capacity = 0
+        for enc, _ in batch:
+            touched.update(int(r) for r in np.nonzero(enc.num_ops)[0])
+            real += int(enc.num_ops.sum())
+            capacity += self._commit_caps.pop(id(enc), 0)
+        if GLOBAL_DEVPROF.enabled:
+            GLOBAL_DEVPROF.sample_memory()
+        stats = MergeStats(
+            docs=len(touched),
+            device_docs=len(touched),
+            device_ops=real,
+            encode_seconds=schedule_s,
+            apply_seconds=apply_s,
+            padding_efficiency=real / capacity if capacity else 0.0,
+            extras={"rounds": len(batch), "scheduled_changes": scheduled,
+                    "layout_paged": 1.0},
+        )
+        self.last_round_stats = stats
+        self._pad_real_ops += real
+        self._pad_capacity += capacity
+        GLOBAL_HISTOGRAMS.observe("streaming.round_seconds", schedule_s + apply_s)
+        GLOBAL_HISTOGRAMS.observe(
+            "streaming.round_scheduled_changes", scheduled, buckets=SIZE_BUCKETS
+        )
+
+    # -- reads: block materialization ----------------------------------------
+
+    def _state_block(self, block_index: int) -> PackedDocs:
+        """Materialize one read block from the pool at the block's
+        page-bucketed width (cached per (round, epoch), <= 2 resident)."""
+        stamp = (self.rounds, self._placement_epoch)
+        key_stamp, cache = self._mat_cache
+        if key_stamp != stamp:
+            cache = {}
+            self._mat_cache = (stamp, cache)
+        hit = cache.get(block_index)
+        if hit is not None:
+            return hit
+        lo, hi = self._block_bounds(block_index)
+        rows = np.arange(lo, hi)
+        state = self._store.materialize_rows(
+            rows, self._store.width_for_rows(rows)
+        )
+        if len(cache) >= 2:
+            cache.pop(next(iter(cache)))
+        cache[block_index] = state
+        return state
+
+    def _resolution(self, block_index: int) -> _BlockResolution:
+        """Base _resolution with the paged fused program: resolution at the
+        block's width plus the pad-corrected per-doc hash vector."""
+        stamp, cache = self._resolved_cache
+        if stamp != self.rounds:
+            cache = {}
+            self._resolved_cache = (self.rounds, cache)
+        if block_index in cache:
+            entry = cache.pop(block_index)  # re-insert: LRU, not FIFO
+            cache[block_index] = entry
+            return entry
+        lo, hi = self._block_bounds(block_index)
+        on_device = self._block_fallback_mask(block_index)
+        with self.tracer.span("streaming.resolve", block=block_index):
+            state = self._state_block(block_index)
+            pad_slots = self._slot_capacity - int(state.elem_id.shape[1])
+            dispatch_args = (
+                state, self.comment_capacity,
+                jnp.asarray(on_device), jnp.uint32(pad_slots),
+                *self._digest_tables(lo, hi),
+            )
+            if GLOBAL_DEVPROF.enabled:
+                note_jit_dispatch(
+                    "_resolve_block_digest_paged_jit",
+                    _resolve_block_digest_paged_jit, dispatch_args,
+                )
+            resolved, digest_dev = _resolve_block_digest_paged_jit(*dispatch_args)
+        entry = _BlockResolution(resolved, digest_dev, on_device)
+        if len(cache) >= 2:
+            cache.pop(next(iter(cache)))
+        cache[block_index] = entry
+        return entry
+
+    def _dispatch_compact(self, block_index: int):
+        """Base _dispatch_compact with the visible-prefix width capped at
+        the block's MATERIALIZED width: the session-wide width prior may
+        come from a wider block, and an over-wide take_along_axis would
+        silently truncate the packed buffer's layout math."""
+        from ..parallel.streaming import _compact_packed_jit
+
+        entry = self._resolution(block_index)
+        width = self._compact_width_for(block_index, entry)
+        width = min(width, int(entry.device.char.shape[1]))
+        buf = _compact_packed_jit(
+            entry.device, self._state_block(block_index).elem_id, width
+        )
+        return buf, width
+
+    # -- digests -------------------------------------------------------------
+
+    def _schedule_rows_digest(self, rest: np.ndarray):
+        k = _width_bucket(len(rest))
+        rows_idx = np.zeros(k, np.int32)
+        rows_idx[: len(rest)] = rest
+        mask = np.zeros(k, bool)
+        mask[: len(rest)] = True
+        g = self._store.width_for_rows(rest)
+        sub = self._store.materialize_rows(rest, g, pad_rows_to=k)
+        pad_slots = self._slot_capacity - g * self.page_size
+        dispatch_args = (
+            sub, self.comment_capacity, jnp.asarray(mask),
+            jnp.uint32(pad_slots),
+            *self._digest_tables_rows(rows_idx, len(rest)),
+        )
+        if GLOBAL_DEVPROF.enabled:
+            note_jit_dispatch(
+                "_rows_digest_paged_jit", _rows_digest_paged_jit, dispatch_args,
+            )
+        return _rows_digest_paged_jit(*dispatch_args)
+
+    def _digest(self, full: bool, refresh: bool) -> int:
+        if full:
+            # the carried-plane path: _resolution/_schedule_rows_digest above
+            # already fold the pad correction into every hash they produce
+            return super()._digest(True, refresh)
+        from ..parallel.mesh import doc_digest_host
+
+        if refresh:
+            self._digest_row_valid[:] = False
+            self._resolved_cache = (-1, {})
+        replay_docs = [i for i, s in enumerate(self.docs) if s.fallback]
+        on_device_all = self._on_device_mask()
+        total = 0
+        n_blocks = -(-self._padded_docs // self._read_chunk)
+        for bi in range(n_blocks):
+            lo, hi = self._block_bounds(bi)
+            state = self._state_block(bi)
+            pad_slots = self._slot_capacity - int(state.elem_id.shape[1])
+            digest, overflow = _resolve_digest_paged_jit(
+                state, self.comment_capacity,
+                jnp.asarray(on_device_all[lo:hi]), jnp.uint32(pad_slots),
+            )
+            total = (total + int(digest)) & 0xFFFFFFFF
+            ov = np.asarray(overflow)
+            replay_docs.extend(
+                int(self._doc_at[int(r) + lo])
+                for r in np.nonzero(ov & on_device_all[lo:hi])[0]
+                if int(self._doc_at[int(r) + lo]) >= 0
+            )
+        s_cap = self._slot_capacity
+        for i in replay_docs:
+            doc = _replay_doc(self._replay_changes(self.docs[i]))
+            cps, slots = _doc_char_slots(doc)
+            total = (total + doc_digest_host(cps, slots, s_cap)) & 0xFFFFFFFF
+        return total
+
+    # -- placement: pages are the load dimension -----------------------------
+
+    def _reshard_sizes(self) -> np.ndarray:
+        """Balance PAGES: the pool spends pages, so a shard's load is the
+        pages its docs hold (a host-bound doc's replay cost still balances
+        through the host_bound dimension exactly as in the base)."""
+        return self._store.page_loads()[self._row_of[: self.num_docs]]
+
+    def _permute_rows(self, src: np.ndarray) -> None:
+        self._store.permute_rows(src)
+
+    def reshard(self, assignment=None) -> dict:
+        out = super().reshard(assignment)
+        n_shards = max(len(out["shard_load"]), 1)
+        rows_per_shard = max(self._padded_docs // n_shards, 1)
+        page_load = [0] * n_shards
+        pages = self._store.page_loads()
+        for d in range(self.num_docs):
+            row = int(self._row_of[d])
+            page_load[min(row // rows_per_shard, n_shards - 1)] += int(pages[row])
+        out["page_load"] = page_load
+        return out

@@ -1,0 +1,28 @@
+"""Corpus case: a paged-store allocator that violates the determinism
+contract two ways.  ``store/`` is merge scope ON PURPOSE — page placement
+is replicated state (two replicas ingesting the same frames must build
+identical page tables), so PTL001 must fire on the unsorted free-SET walk
+and PTL006 on the wall-clock allocation stamp."""
+
+import time
+
+
+class SloppyPageAllocator:
+    def __init__(self, total_pages):
+        self.free = set(range(1, total_pages))
+        self.pages = {}
+        self.stamps = {}
+
+    def alloc(self, doc, n):
+        grabbed = []
+        for page in self.free:  # PTL001: set iteration orders the page table
+            grabbed.append(page)
+            if len(grabbed) == n:
+                break
+        for page in grabbed:
+            self.free.discard(page)
+        self.pages.setdefault(doc, []).extend(grabbed)
+        # PTL006: wall clock in a merge region — allocation stamps diverge
+        # across replicas and make page-table fuzz failures unreproducible
+        self.stamps[doc] = time.time()
+        return grabbed

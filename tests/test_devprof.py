@@ -216,7 +216,7 @@ def test_bucket_table_distinct_shapes_match_sentinel(recompile_sentinel,
 
 GOLDEN_DEVPROF_KEYS = {
     "enabled", "capture_costs", "sites", "occupancy", "occupancy_totals",
-    "memory",
+    "memory", "page_pool",
 }
 GOLDEN_SITE_KEYS = {"distinct_shapes", "dispatches", "buckets"}
 GOLDEN_BUCKET_KEYS = {"dispatches", "sig", "cost", "memory"}

@@ -330,8 +330,8 @@ class TestFleetRouter:
         assert set(snap) == {"hosts", "docs", "placements", "moves",
                              "lag_weight"}
         assert set(snap["hosts"]["hostA"]) == {
-            "capacity", "docs", "slot_load", "host_bound_load", "lag_ops",
-            "draining",
+            "capacity", "docs", "slot_load", "page_load", "paged",
+            "host_bound_load", "lag_ops", "draining",
         }
         json.dumps(snap)
 
@@ -484,10 +484,12 @@ class TestSessionMux:
         mux.open_session("a")
         snap = mux.snapshot()
         assert set(snap) == {
-            "host", "sessions", "sessions_total", "docs", "doc_capacity",
-            "degraded_docs", "rounds", "applied_frames", "buffered_frames",
-            "overloaded", "recent_sheds", "queue", "window", "session_table",
+            "host", "layout", "sessions", "sessions_total", "docs",
+            "doc_capacity", "degraded_docs", "rounds", "applied_frames",
+            "buffered_frames", "overloaded", "recent_sheds", "queue",
+            "window", "session_table",
         }
+        assert snap["layout"] == "padded"  # paged muxes add "page_pool"
         assert snap["host"] == "h9"
         assert set(snap["session_table"]["0"]) == {
             "client", "doc", "submitted", "admitted", "delayed", "shed",
