@@ -6,8 +6,9 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test fuzz fuzz-differential fuzz-frames fuzz-crash chaos weak-scaling \
-	bench bench-smoke bench-streaming entry dryrun lint lint-baseline clean obs \
-	fleet perf-gate serve-smoke bench-serve paged-smoke bench-longdoc
+	bench bench-smoke bench-streaming bench-fused entry dryrun lint lint-baseline \
+	clean obs fleet perf-gate serve-smoke bench-serve paged-smoke bench-longdoc \
+	fused-smoke
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -68,6 +69,13 @@ paged-smoke:
 bench-longdoc:
 	$(PY) bench.py --mode longdoc
 
+# fused-pipeline smoke (mirrors the CI fused-smoke job): fused vs
+# per-round byte equality across both layouts, staging-overlap direction,
+# zero steady-state compiles, fused devprof sites (artifacts in
+# /tmp/pt-fused)
+fused-smoke:
+	$(CPU_ENV) $(PY) scripts/fused_smoke.py --out /tmp/pt-fused
+
 # streaming frame ingest vs oracle (spans + incremental patch streams)
 fuzz-frames:
 	$(CPU_ENV) $(PY) -m peritext_tpu.testing.fuzz --differential-frames
@@ -81,6 +89,11 @@ bench-smoke:
 bench-streaming:
 	$(PY) bench.py --mode streaming
 
+# fused device-resident round pipeline vs per-round dispatch (same
+# workload, byte equality asserted in-row on every measured seed)
+bench-fused:
+	$(PY) bench.py --mode streaming-fused
+
 bench-engine:  # device-only streaming replay: the engine limit vs the link
 	$(PY) bench.py --mode engine
 
@@ -89,7 +102,7 @@ bench-engine:  # device-only streaming replay: the engine limit vs the link
 # ledger, then gated with per-row tolerance bands (exit 1 on regression)
 perf-gate:
 	cp perf/reference_ledger.jsonl /tmp/pt-perf-gate.jsonl
-	PT_BENCH_LADDER_ROWS="streaming,wire,serve_sustained,batch_longdoc" $(PY) bench.py \
+	PT_BENCH_LADDER_ROWS="streaming,streaming_fused,wire,serve_sustained,batch_longdoc" $(PY) bench.py \
 		--mode ladder --smoke --platform cpu --devprof \
 		--ledger /tmp/pt-perf-gate.jsonl
 	$(PY) -m peritext_tpu.obs perf /tmp/pt-perf-gate.jsonl --gate

@@ -372,6 +372,69 @@ def apply_batch_paged_jit(pool_elem, pool_char, aux, row_idx, page_rows,
     )
 
 
+def apply_batch_paged_groups(
+    pool_elem,
+    pool_char,
+    aux,
+    group_inputs,  # tuple of per-group (row_idx, page_rows, encoded_arrays)
+    *,
+    loop_slots_seq,  # static tuple of per-group insert_loop_slots
+    insert_impl: str = "auto",
+):
+    """One round's page-bucket groups chained inside ONE program — the
+    paged half of the fused round pipeline.  Each per-group dispatch of
+    :func:`apply_batch_paged` reads and functionally rewrites the WHOLE
+    pool (the ``.at[].set`` scatter allocates a fresh pool copy per group
+    without donation), so a round touching several buckets paid one pool
+    copy per bucket; chained + donated (the jit wrapper donates all three
+    pool operands), XLA updates the pool in place across every group."""
+    if len(group_inputs) != len(loop_slots_seq):
+        raise ValueError("paged groups: inputs/loop_slots length mismatch")
+    for (row_idx, page_rows, encoded_arrays), loop_slots in zip(
+            group_inputs, loop_slots_seq):
+        pool_elem, pool_char, aux = apply_batch_paged(
+            pool_elem, pool_char, aux, row_idx, page_rows, encoded_arrays,
+            insert_impl=insert_impl, insert_loop_slots=loop_slots,
+        )
+    return pool_elem, pool_char, aux
+
+
+_apply_paged_groups_jit = jax.jit(
+    apply_batch_paged_groups,
+    static_argnames=("loop_slots_seq", "insert_impl"),
+    donate_argnums=(0, 1, 2),
+)
+_apply_paged_groups_jit_nodonate = jax.jit(
+    apply_batch_paged_groups,
+    static_argnames=("loop_slots_seq", "insert_impl"),
+)
+
+
+def apply_batch_paged_groups_jit(pool_elem, pool_char, aux, group_inputs, *,
+                                 loop_slots_seq, insert_impl: str = "auto",
+                                 donate: bool | None = None):
+    """jit-compiled :func:`apply_batch_paged_groups`; the pool operands
+    (``pool_elem``/``pool_char``/``aux``) are donated per
+    :func:`resolve_state_donation` (or the explicit ``donate``) — rebind
+    to the returned triple either way."""
+    if insert_impl == "auto":
+        insert_impl = resolve_insert_impl(pool_elem)
+    if donate is None:
+        donate = resolve_state_donation(pool_elem)
+    fn = (_apply_paged_groups_jit if donate
+          else _apply_paged_groups_jit_nodonate)
+    statics = dict(loop_slots_seq=tuple(loop_slots_seq),
+                   insert_impl=insert_impl)
+    if GLOBAL_DEVPROF.enabled:
+        _note_dispatch(
+            "apply_batch_paged_groups", fn,
+            (pool_elem, pool_char, aux, tuple(group_inputs)), statics,
+        )
+    return fn(
+        pool_elem, pool_char, aux, tuple(group_inputs), **statics,
+    )
+
+
 def _pad_from_flat(flat, counts, width: int):
     """(N,) flat per-doc-concatenated values + (D,) counts -> (D, width)
     zero-padded rows, reconstructed on device with ONE gather (host->device
@@ -492,6 +555,181 @@ _apply_rounds_jit = jax.jit(
     apply_batch_compact_rounds,
     static_argnames=("widths_seq", "loop_slots_seq", "insert_impl"),
 )
+
+
+def apply_batch_staged_rounds(
+    state: PackedDocs,
+    counts_all,  # (K, 4, D) int32: per-round (ins, del, mark, map) counts
+    ins_all,  # (ref, op, char) each (sum ins_lens,) int32
+    del_all,  # (sum del_lens,) int32
+    mark_all,  # dict col -> (sum mark_lens,) int32
+    map_all,  # dict col -> (sum map_lens,) int32
+    *,
+    widths_seq,  # static tuple of per-round (ki, kd, km, kp)
+    loop_slots_seq,  # static tuple of per-round insert_loop_slots
+    ins_lens,  # static tuple: per-round pow-2 bucket of each flat stream —
+    del_lens,  # the in-program slice boundaries (static starts, so XLA
+    mark_lens,  # lowers them to free constant-offset slices)
+    map_lens,
+    insert_impl: str = "auto",
+) -> PackedDocs:
+    """K causally-ordered rounds from ONE staged tensor set (the fused
+    device-resident round pipeline's apply half).
+
+    Functionally :func:`apply_batch_compact_rounds`, but the host ships one
+    concatenated tensor per stream kind for the WHOLE batch instead of ~20
+    arrays per round: the per-round flat streams (each pow-2 padded to its
+    static entry in ``*_lens``) concatenate along their only axis, and the
+    per-doc count vectors stack into one (K, 4, D) tensor — so a deep drain
+    pays one host->device staging transfer set and one dispatch no matter
+    how many rounds it fused.  The jit wrapper donates ``state``: XLA
+    updates the 21-leaf resident state in place instead of allocating (and
+    copying) a fresh copy per commit."""
+    if not (len(widths_seq) == len(loop_slots_seq) == counts_all.shape[0]
+            == len(ins_lens) == len(del_lens) == len(mark_lens)
+            == len(map_lens)):
+        raise ValueError("staged rounds: per-round static/tensor length mismatch")
+    io = do = mo = po = 0
+    for r in range(len(widths_seq)):
+        counts = tuple(counts_all[r, j] for j in range(4))
+        li, ld, lm, lp = ins_lens[r], del_lens[r], mark_lens[r], map_lens[r]
+        ins = tuple(a[io:io + li] for a in ins_all)
+        dels = del_all[do:do + ld]
+        marks = {c: a[mo:mo + lm] for c, a in mark_all.items()}
+        maps = {c: a[po:po + lp] for c, a in map_all.items()}
+        state = apply_batch_compact(
+            state, counts, ins, dels, marks, maps,
+            widths=widths_seq[r], insert_impl=insert_impl,
+            insert_loop_slots=loop_slots_seq[r],
+        )
+        io, do, mo, po = io + li, do + ld, mo + lm, po + lp
+    return state
+
+
+def resolve_state_donation(*arrays, platform: str | None = None) -> bool:
+    """Whether the fused-pipeline programs should DONATE their resident
+    state operands, resolved from where the data lives (the
+    :func:`resolve_insert_impl` sniffing discipline).
+
+    On TPU donation is the point of the fused pipeline: XLA aliases the
+    21-leaf state (or the page pool) in place instead of allocating and
+    copying a fresh resident copy per commit, and dispatch stays async.
+    On XLA CPU a donated dispatch BLOCKS until the donated input's pending
+    producer has finished (measured ~40x the async dispatch wall: 4.3 ms
+    vs 0.11 ms per commit on the smoke shape), which would serialize the
+    exact host/device overlap the pipeline exists to create — so CPU runs
+    the undonated twin of the same program."""
+    if platform is None:
+        for a in arrays:
+            sharding = getattr(a, "sharding", None)
+            device_set = getattr(sharding, "device_set", None)
+            if device_set:
+                platform = next(iter(device_set)).platform
+                break
+    if platform is None:
+        platform = jax.default_backend()
+    return platform == "tpu"
+
+
+_STAGED_ROUNDS_STATICS = ("widths_seq", "loop_slots_seq", "ins_lens",
+                          "del_lens", "mark_lens", "map_lens", "insert_impl")
+_apply_staged_rounds_jit = jax.jit(
+    apply_batch_staged_rounds,
+    static_argnames=_STAGED_ROUNDS_STATICS,
+    donate_argnums=0,
+)
+_apply_staged_rounds_jit_nodonate = jax.jit(
+    apply_batch_staged_rounds,
+    static_argnames=_STAGED_ROUNDS_STATICS,
+)
+
+
+def apply_batch_staged_rounds_jit(state, counts_all, ins_all, del_all,
+                                  mark_all, map_all, *, widths_seq,
+                                  loop_slots_seq, ins_lens, del_lens,
+                                  mark_lens, map_lens,
+                                  insert_impl: str = "auto",
+                                  donate: bool | None = None) -> PackedDocs:
+    """jit-compiled :func:`apply_batch_staged_rounds`.  With ``donate``
+    (default: :func:`resolve_state_donation`) the caller's input state
+    buffer is consumed in place (reads of the old reference raise) —
+    rebind to the returned state either way.  ``"auto"`` resolves at the
+    boundary, as in :func:`apply_batch_jit`."""
+    if insert_impl == "auto":
+        insert_impl = resolve_insert_impl(state.elem_id)
+    if donate is None:
+        donate = resolve_state_donation(state.elem_id)
+    fn = _apply_staged_rounds_jit if donate else _apply_staged_rounds_jit_nodonate
+    statics = dict(widths_seq=tuple(widths_seq),
+                   loop_slots_seq=tuple(loop_slots_seq),
+                   ins_lens=tuple(ins_lens), del_lens=tuple(del_lens),
+                   mark_lens=tuple(mark_lens), map_lens=tuple(map_lens),
+                   insert_impl=insert_impl)
+    if GLOBAL_DEVPROF.enabled:
+        _note_dispatch(
+            "apply_batch_staged_rounds", fn,
+            (state, counts_all, ins_all, del_all, mark_all, map_all), statics,
+        )
+    return fn(
+        state, counts_all, ins_all, del_all, mark_all, map_all, **statics,
+    )
+
+
+def apply_batch_stacked_rounds(
+    state: PackedDocs,
+    stacked,  # the apply_batch 8-tuple with a leading round axis R
+    *,
+    loop_slots_seq,  # static tuple of per-round insert_loop_slots
+    insert_impl: str = "auto",
+) -> PackedDocs:
+    """K rounds of the PADDED (D, K) apply chained in one donated program —
+    the fused pipeline's static-rounds form (serve/ shape discipline: every
+    round at the session's fixed widths, so the only variant axes are the
+    fused depth R and the log2 slot-window ladder)."""
+    (ins_ref, ins_op, ins_char, del_t, marks, mark_count, maps,
+     map_count) = stacked
+    for r in range(len(loop_slots_seq)):
+        arrays = (
+            ins_ref[r], ins_op[r], ins_char[r], del_t[r],
+            {c: a[r] for c, a in marks.items()}, mark_count[r],
+            {c: a[r] for c, a in maps.items()}, map_count[r],
+        )
+        state = apply_batch(
+            state, arrays, insert_impl=insert_impl,
+            insert_loop_slots=loop_slots_seq[r],
+        )
+    return state
+
+
+_apply_stacked_rounds_jit = jax.jit(
+    apply_batch_stacked_rounds,
+    static_argnames=("loop_slots_seq", "insert_impl"),
+    donate_argnums=0,
+)
+_apply_stacked_rounds_jit_nodonate = jax.jit(
+    apply_batch_stacked_rounds,
+    static_argnames=("loop_slots_seq", "insert_impl"),
+)
+
+
+def apply_batch_stacked_rounds_jit(state, stacked, *, loop_slots_seq,
+                                   insert_impl: str = "auto",
+                                   donate: bool | None = None) -> PackedDocs:
+    """jit-compiled :func:`apply_batch_stacked_rounds`; ``state`` donated
+    per :func:`resolve_state_donation` (or the explicit ``donate``)."""
+    if insert_impl == "auto":
+        insert_impl = resolve_insert_impl(state.elem_id)
+    if donate is None:
+        donate = resolve_state_donation(state.elem_id)
+    fn = (_apply_stacked_rounds_jit if donate
+          else _apply_stacked_rounds_jit_nodonate)
+    statics = dict(loop_slots_seq=tuple(loop_slots_seq),
+                   insert_impl=insert_impl)
+    if GLOBAL_DEVPROF.enabled:
+        _note_dispatch(
+            "apply_batch_stacked_rounds", fn, (state, stacked), statics,
+        )
+    return fn(state, stacked, **statics)
 
 
 def apply_batch_compact_rounds_jit(state, rounds, *, widths_seq,

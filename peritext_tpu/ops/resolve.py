@@ -26,13 +26,19 @@ slot is needed, i.e. a (C, S) plane per document.  Three measures keep that
 off the critical path: (a) the "val trick" — the carried state per key is the
 single uint32 ``(op_id << 1) | is_add`` maximum, whose low bit is the
 add/remove verdict, halving both carries and reductions; (b) the per-chunk
-per-id reduction is a dense (J, C, S) masked max that XLA fuses into plain
-reductions (measured faster on TPU than a segment_max scatter, which
-serializes); (c) resolution is compiled with a static ``with_comments``
-flag, and the paths that never read comment state (convergence digests,
-cursor resolution, overflow counting) compile with it off, so the comment
-work vanishes from those programs entirely.  The output plane is bit-packed
-(``comment_bits``), shrinking the device->host read transfer 32x.
+per-id reduction is PLATFORM-ADAPTIVE (:func:`comment_reduce_impl`): on TPU
+a dense (J, C, S) masked max that XLA fuses into plain reductions (measured
+faster there than a segment_max scatter, which serializes), elsewhere a
+batched scatter-max over the comment-id axis — the dense product is O(JxCxS)
+of mostly-masked work and measured ~150x slower than the scatter on XLA CPU
+(93 ms vs sub-ms on the 64-doc smoke block), which made the with-comments
+resolve the whole smoke digest cost; the two forms are bit-identical (max
+over the same masked values, out-of-range ids dropped both ways); (c)
+resolution is compiled with a static ``with_comments`` flag, and the paths
+that never read comment state (convergence digests, cursor resolution,
+overflow counting) compile with it off, so the comment work vanishes from
+those programs entirely.  The output plane is bit-packed (``comment_bits``),
+shrinking the device->host read transfer 32x.
 
 Visibility is also computed here: a slot is visible iff occupied and its
 element id is absent from the tombstone table (one vectorized any-match).
@@ -63,6 +69,19 @@ LINK_TYPE = MARK_INDEX["link"]
 #: rows) resolve in a single carry-free pass; long-doc tables loop with
 #: (C, S) carries only between chunks.
 MARK_CHUNK = 128
+
+
+def comment_reduce_impl() -> str:
+    """Per-chunk comment-winner reduction implementation: ``"dense"`` (the
+    (J, C, S) masked max — fuses into plain reductions on TPU, where
+    scatters serialize) or ``"scatter"`` (a batched scatter-max over the
+    comment-id axis — O(JxS) work, ~150x faster on XLA CPU).  Read at TRACE
+    time from the default backend: both forms lower everywhere and are
+    bit-identical, so a mixed-platform process (TPU plugin registered, CPU
+    mesh computing) merely picks a slower-but-correct form — the same
+    posture as :func:`..kernel.resolve_insert_impl`, minus the correctness
+    stakes that force that one to the jit boundary."""
+    return "dense" if jax.default_backend() == "tpu" else "scatter"
 
 
 class ResolvedDocs(NamedTuple):
@@ -181,18 +200,27 @@ def resolve_single(
                 )
             val_rows.append(jnp.maximum(carry.lww_val[t], chunk_val))
 
-        # Comments: per interned comment id, a masked (J, C, S) winner-val
-        # max.  Dense beats a segment-max scatter on TPU (scatters serialize;
-        # the dense product fuses into plain reductions), and the val trick
-        # halves it to a single product.
+        # Comments: per interned comment id, the winner-val max over the
+        # chunk's covering comment rows — dense (J, C, S) masked max on TPU,
+        # batched scatter-max elsewhere (see comment_reduce_impl; the two
+        # are bit-identical, and a non-comment or out-of-range row
+        # contributes 0 / drops under both forms).
         if with_comments:
-            sel_c = (
-                attr[:, None] == jnp.arange(comment_capacity, dtype=jnp.int32)[None, :]
-            )  # (J, C)
             data = jnp.where(cover & is_comment[:, None], val_col, 0)  # (J, S)
-            chunk_c = jnp.max(
-                jnp.where(sel_c[:, :, None], data[:, None, :], 0), axis=0
-            )  # (C, S)
+            if comment_reduce_impl() == "dense":
+                sel_c = (
+                    attr[:, None]
+                    == jnp.arange(comment_capacity, dtype=jnp.int32)[None, :]
+                )  # (J, C)
+                chunk_c = jnp.max(
+                    jnp.where(sel_c[:, :, None], data[:, None, :], 0), axis=0
+                )  # (C, S)
+            else:
+                chunk_c = (
+                    jnp.zeros((comment_capacity, s_cap), jnp.uint32)
+                    .at[attr]
+                    .max(data, mode="drop")
+                )
             c_val = jnp.maximum(carry.c_val, chunk_c)
         else:
             c_val = carry.c_val

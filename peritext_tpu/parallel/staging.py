@@ -1,0 +1,158 @@
+"""Double-buffered async host→device staging lane (ops/frames → device).
+
+The fused round pipeline (parallel/streaming.py ``drain``) splits a round
+batch's host half into SCHEDULE (causal admission into staging buffers —
+mutates session clocks, so it must stay on the session's thread) and STAGE
+(flatten the staged buffers into the fused program's concatenated tensors
+and ``jax.device_put`` them — pure reads of buffers the batch exclusively
+owns).  This module runs the STAGE half on a worker thread so batch k's
+flatten + upload overlaps batch k+1's schedule on the main thread and batch
+k-1's device math behind the async dispatch queue: the host parse/transfer
+cost the streaming-vs-engine gap attributed (ISSUE 9 / FusionStitching's
+host-boundary stitching) hides behind device compute instead of serializing
+with it.
+
+``depth`` bounds the in-flight staged batches (default 2 — the double
+buffer): ``submit`` blocks when the lane is full, so a deep drain can never
+pile unbounded staged tensors onto the host or device.
+
+Determinism posture (this module lives in graftlint merge scope ON
+PURPOSE): staging jobs are pure functions of their already-scheduled batch
+— the worker introduces NO ordering freedom (handles resolve FIFO, commits
+wait each handle in submission order), reads no clocks and draws no
+randomness; timing telemetry is the caller's via obs spans.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional, Tuple
+
+#: worker idle lifetime: a lane whose owner stopped draining (an abandoned
+#: watchdog session, a dropped StreamingMerge) self-reaps instead of leaking
+#: a thread per session; the next submit respawns transparently
+IDLE_TIMEOUT_SECONDS = 10.0
+
+
+class StagedHandle:
+    """One staged batch's future: ``wait()`` returns the staging function's
+    result (the device-resident input tensors) or re-raises its failure on
+    the waiting thread — a staging fault surfaces inside the guarded commit
+    that consumes it, never on a daemon thread."""
+
+    __slots__ = ("_done", "_value", "_error")
+
+    def __init__(self) -> None:
+        self._done = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+
+    def _resolve(self, value) -> None:
+        self._value = value
+        self._done.set()
+
+    def _reject(self, error: BaseException) -> None:
+        self._error = error
+        self._done.set()
+
+    def ready(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self):
+        self._done.wait()
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class FrameStager:
+    """The staging lane: a single worker thread consuming a bounded FIFO of
+    ``(fn, args)`` jobs, each resolved into a :class:`StagedHandle`.
+
+    One lane per session (lazily built by the fused drain); the worker is a
+    daemon with an idle timeout, so abandoned sessions cost a bounded wait,
+    not a leaked thread.  ``stats()`` exports job/error counters for the
+    bench row's overlap accounting.
+    """
+
+    def __init__(self, depth: int = 2) -> None:
+        if depth < 1:
+            raise ValueError(f"stager depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._queue: "queue.Queue[Optional[Tuple]]" = queue.Queue(maxsize=depth)
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self.staged = 0
+        self.errors = 0
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, fn: Callable, *args) -> StagedHandle:
+        """Enqueue one staging job; blocks while ``depth`` jobs are already
+        in flight (the double-buffer bound).  Returns the job's handle."""
+        if self._closed:
+            raise RuntimeError("FrameStager is closed")
+        handle = StagedHandle()
+        # enqueue BEFORE ensuring the worker: the idle-timeout retire path
+        # re-checks queue emptiness under the lock, so a job published first
+        # either keeps the racing worker alive or is picked up by the fresh
+        # worker spawned below — a job can never land on a worker-less lane
+        self._queue.put((fn, args, handle))
+        self._ensure_worker()
+        return handle
+
+    def _ensure_worker(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="peritext-stager", daemon=True
+                )
+                self._thread.start()
+
+    # -- the worker ----------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            try:
+                job = self._queue.get(timeout=IDLE_TIMEOUT_SECONDS)
+            except queue.Empty:
+                with self._lock:
+                    # re-check under the lock: a submit may have raced the
+                    # timeout; if so keep serving, else retire this worker
+                    if self._queue.empty():
+                        if self._thread is threading.current_thread():
+                            self._thread = None
+                        return
+                continue
+            if job is None:  # close() sentinel
+                return
+            fn, args, handle = job
+            try:
+                value = fn(*args)
+            except BaseException as exc:  # graftlint: boundary(staging worker forwards every failure to the committing waiter verbatim)
+                self.errors += 1
+                handle._reject(exc)
+            else:
+                # count BEFORE resolving: a consumer reading stats() right
+                # after handle.wait() returns must never see an undercount
+                self.staged += 1
+                handle._resolve(value)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop accepting jobs and let the worker drain then exit.  Already-
+        submitted handles still resolve; idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._lock:
+            alive = self._thread is not None and self._thread.is_alive()
+        if alive:
+            self._queue.put(None)
+
+    def stats(self) -> dict:
+        return {"staged": self.staged, "errors": self.errors,
+                "depth": self.depth}

@@ -74,7 +74,12 @@ from ..ops.frames import (
     schedule_split,
 )
 from ..schema import MARK_INDEX
-from ..ops.kernel import apply_batch_jit, encoded_arrays_of
+from ..ops.kernel import (
+    apply_batch_jit,
+    apply_batch_staged_rounds_jit,
+    apply_batch_stacked_rounds_jit,
+    encoded_arrays_of,
+)
 from ..ops.packed import PackedDocs, empty_docs
 from ..ops.resolve import resolve, resolve_jit
 from ..utils.interning import Interner, OrderedActorTable
@@ -635,6 +640,25 @@ class StreamingMerge:
         # when a list, _apply_compact records each round's device-ready
         # inputs (engine-limit bench replay; see bench.py run_engine)
         self._capture_rounds: Optional[list] = None
+        #: lazy double-buffered staging lane (parallel/staging.FrameStager):
+        #: the pipelined drain flattens + uploads batch k's fused inputs on
+        #: its worker while batch k+1 schedules here
+        self._stager = None
+        #: fused-pipeline digest accumulation: when True, a pipelined drain
+        #: ends by PRE-DISPATCHING the fused resolve+digest block program
+        #: (async, with an async host copy of the per-doc hash vector), so
+        #: the next digest()/read is one readback of already-computed
+        #: device results instead of a dispatch+compute sync.  Off by
+        #: default: a drain whose caller neither digests nor reads before
+        #: the next commit would pay a wasted resolve per drain.  The bench
+        #: fused row and the serving mux (reads follow every pump) turn it
+        #: on.
+        self.prefetch_digest = False
+        #: compat switch: False restores the pre-fusion per-round dispatch
+        #: discipline (one compact apply dispatch per round, per-round
+        #: device_put staging, unpipelined drain) — the bench fused row's
+        #: comparison arm and the equivalence tests' oracle side
+        self.fused_pipeline = True
         # Per-ROW cumulative admitted inserts: a host-side upper bound on
         # device slot occupancy (slots only grow, one per admitted insert;
         # device-side convergence dedup can only make the true count
@@ -1035,12 +1059,16 @@ class StreamingMerge:
         return scheduled
 
     def _emit_round_stats(self, batch, scheduled: int,
-                          schedule_s: float, apply_s: float) -> None:
+                          schedule_s: float, apply_s: float,
+                          origin: str = "streaming.round") -> None:
         """Per-commit MergeStats + histograms: the streaming path's analog
         of ``DocBatch.merge``'s report — the slowest bench row is no longer
         the least instrumented.  ``apply_seconds`` is host DISPATCH wall
         (device work is async; reads are the sync points), which is exactly
-        the quantity the per-dispatch-floor analysis needs."""
+        the quantity the per-dispatch-floor analysis needs.  ``origin``
+        labels the devprof occupancy rows ("streaming.fused" for pipelined
+        drain commits), so the observability stack attributes per-fused-
+        round cost to the fused sites."""
         touched: set = set()
         real = 0
         capacity = 0
@@ -1056,7 +1084,7 @@ class StreamingMerge:
                 # — the per-bucket generalization of padding_efficiency
                 GLOBAL_DEVPROF.observe_round(
                     occupancy_key(self._padded_docs, *widths),
-                    round_real, round_cap, origin="streaming.round",
+                    round_real, round_cap, origin=origin,
                 )
         if GLOBAL_DEVPROF.enabled:
             # round-boundary device-memory watermark (one sample per
@@ -1196,39 +1224,35 @@ class StreamingMerge:
     #: the compile-cache variant space and the staged host memory
     FUSE_MAX_ROUNDS = 8
 
-    def _commit_rounds(self, batch) -> None:
-        """The DEVICE half: dispatch scheduled rounds ``[(enc, widths),
-        ...]`` — one fused program when several rounds are pending (the
-        axon platform charges ~11 ms per dispatch of the 21-leaf state
-        program no matter its compute; see kernel
-        .apply_batch_compact_rounds) — plus the per-round digest/round
-        bookkeeping.  Mesh and block-chunked sessions commit per round
-        (their dispatch paths are shape-disciplined differently)."""
-        fuse = (
-            len(batch) > 1
+    def _fused_eligible(self) -> bool:
+        """Whether commits route through the fused device-resident round
+        pipeline: meshless (sharded sessions commit per round — their
+        dispatch is shape-disciplined over the mesh), single-block (the
+        donated state program covers the whole doc axis), and not an
+        engine-capture session (capture records per-ROUND device inputs,
+        the replay contract bench.run_engine/engine_profile consume)."""
+        return (
+            self.fused_pipeline
             and self.mesh is None
-            and not self.static_rounds
+            and self._capture_rounds is None
             and self._padded_docs <= self._read_chunk
         )
-        if fuse:
-            from ..ops.kernel import apply_batch_compact_rounds_jit
 
-            rounds, widths_seq, loop_seq = [], [], []
-            for enc, widths in batch:
-                self._cum_ins += enc.ins_count
-                round_inputs, loop_slots = self._device_round_inputs(
-                    enc, widths)
-                rounds.append(round_inputs)
-                widths_seq.append(widths)
-                loop_seq.append(loop_slots)
-            self._apply_blocks = None
-            self.state = apply_batch_compact_rounds_jit(
-                self.state, rounds, widths_seq=widths_seq,
-                loop_slots_seq=loop_seq)
-            for enc, _ in batch:
-                self._digest_row_valid[np.nonzero(enc.num_ops)[0]] = False
-                self.rounds += 1
-                GLOBAL_COUNTERS.add("streaming.rounds")
+    def _commit_rounds(self, batch) -> None:
+        """The DEVICE half: dispatch scheduled rounds ``[(enc, widths),
+        ...]`` — for fused-eligible sessions as ONE donated device program
+        per batch (kernel.apply_batch_staged_rounds: round state updates in
+        place, the whole batch ships as one staged tensor set; static-round
+        serving sessions chain through the stacked fixed-width twin so the
+        one-shape discipline holds) — plus the per-round digest/round
+        bookkeeping.  Mesh, block-chunked and engine-capture sessions
+        commit per round (their dispatch paths are shape-disciplined
+        differently; see kernel.apply_batch_compact_rounds for the replay
+        fuse)."""
+        if self._fused_eligible():
+            statics = self._prep_fused_batch(batch)
+            inputs = self._stage_fused_batch(batch, statics)
+            self._dispatch_fused_batch(batch, statics, inputs)
             return
         for enc, widths in batch:
             self._cum_ins += enc.ins_count
@@ -1313,6 +1337,189 @@ class StreamingMerge:
             # device engine with zero host parse/schedule/transfer
             self._capture_rounds.append((round_inputs, widths, loop_slots))
         return round_inputs, loop_slots
+
+    # -- the fused device-resident round pipeline ---------------------------
+    #
+    # A committed batch is one donated device program: the per-round flat
+    # streams concatenate into ONE staged tensor per stream kind (static
+    # per-round slice boundaries), the 21-leaf resident state is donated so
+    # XLA updates it in place, and under drain() the flatten+upload of
+    # batch k runs on the staging lane's worker while batch k+1 schedules
+    # on this thread and batch k-1 computes behind the async dispatch
+    # queue.  Split into prep (main thread: mutates _cum_ins, derives the
+    # static signature) / stage (worker-safe: pure reads of the batch's own
+    # staging buffers + jax.device_put) / dispatch (main thread: the
+    # donated jit call + round bookkeeping) so the pipelined drain can
+    # overlap them.
+
+    def _prep_fused_batch(self, batch):
+        """Main-thread half of staging: advance the cumulative-insert
+        plane, derive each round's slot-window bound and the fused
+        program's static signature.  Returns the statics tuple handed to
+        ``_stage_fused_batch``/``_dispatch_fused_batch`` (tagged with the
+        program form: flat staged tensors, or the stacked fixed-width form
+        for static-round serving sessions)."""
+        from ..ops.kernel import resolve_state_donation
+
+        s_cap = self._slot_capacity
+        loop_seq = []
+        for enc, _ in batch:
+            self._cum_ins += enc.ins_count
+            bound = _width_bucket(int(self._cum_ins.max()))
+            loop_seq.append(bound if bound < s_cap else None)
+        if self.static_rounds:
+            if (len(batch) == 1
+                    and not resolve_state_donation(self.state.elem_id)):
+                # single-round serving commit, non-donating platform: the
+                # legacy one-shape padded apply IS the program (shared
+                # compile with the pre-fusion static path)
+                return ("static1", loop_seq[0])
+            return ("stacked", tuple(loop_seq))
+        if len(batch) == 1 and not resolve_state_donation(self.state.elem_id):
+            # single-round commit on a non-donating platform: stage and
+            # dispatch through the SAME compact apply program the
+            # per-round discipline (and the capture/oracle paths) use —
+            # K=1 chaining buys nothing without donation, and sharing the
+            # compiled program keeps the suite-wide variant count where
+            # the pre-fusion path left it.  Donating platforms route K=1
+            # through the staged program so state still updates in place.
+            return ("compact1", loop_seq[0], batch[0][1])
+        widths_seq = tuple(widths for _, widths in batch)
+        # SHARED per-kind stream buckets across the batch (the block-chunk
+        # idiom): every round pads to the batch's max bucket, so the
+        # compile signature carries ONE length per stream kind instead of
+        # a per-round combination — the variant space stays (K x 4 bucket
+        # scalars), not their product
+        k = len(batch)
+        ib = _width_bucket(max(int(enc.ins_count.sum()) for enc, _ in batch))
+        db = _width_bucket(max(int(enc.del_count.sum()) for enc, _ in batch))
+        mb = _width_bucket(max(int(enc.mark_count.sum()) for enc, _ in batch))
+        pb = _width_bucket(max(int(enc.map_count.sum()) for enc, _ in batch))
+        return ("flat", tuple(loop_seq), widths_seq,
+                (ib,) * k, (db,) * k, (mb,) * k, (pb,) * k)
+
+    def _stage_fused_batch(self, batch, statics):
+        """Worker-safe half: flatten the batch into its single staged
+        tensor set and upload everything with ONE ``jax.device_put`` of the
+        whole pytree.  Touches only the batch's own staging buffers (never
+        session state), so the pipelined drain may run it on the staging
+        lane while this thread schedules the next batch."""
+        d = self._padded_docs
+        k = len(batch)
+        if statics[0] == "compact1":
+            # the shared-program single-round form: flat streams pow-2
+            # padded exactly as _device_round_inputs stages them
+            _, _, widths = statics
+            enc = batch[0][0]
+            counts, ins, dels, marks, maps = self._flatten_round(
+                enc, widths, 0, d)
+
+            def pad(v):
+                out = np.zeros(_width_bucket(len(v)), np.int32)
+                out[: len(v)] = v
+                return out
+
+            return jax.device_put((
+                tuple(np.ascontiguousarray(c) for c in counts),
+                tuple(pad(v) for v in ins),
+                pad(dels),
+                {c: pad(v) for c, v in marks.items()},
+                {c: pad(v) for c, v in maps.items()},
+            ))
+        if statics[0] == "static1":
+            enc = batch[0][0]
+            return jax.device_put((
+                enc.ins_ref, enc.ins_op, enc.ins_char, enc.del_target,
+                {c: enc.marks[c] for c in MARK_COLS}, enc.mark_count,
+                {c: enc.map_ops[c] for c in MAP_STREAM_COLS}, enc.map_count,
+            ))
+        if statics[0] == "stacked":
+            # static-round serving form: the padded (D, K) staging rows at
+            # the session's fixed widths, stacked along a leading round axis
+            ins_ref = np.stack([enc.ins_ref for enc, _ in batch])
+            ins_op = np.stack([enc.ins_op for enc, _ in batch])
+            ins_char = np.stack([enc.ins_char for enc, _ in batch])
+            del_t = np.stack([enc.del_target for enc, _ in batch])
+            marks = {
+                col: np.stack([enc.marks[col] for enc, _ in batch])
+                for col in MARK_COLS
+            }
+            mark_count = np.stack([enc.mark_count for enc, _ in batch])
+            maps = {
+                col: np.stack([enc.map_ops[col] for enc, _ in batch])
+                for col in MAP_STREAM_COLS
+            }
+            map_count = np.stack([enc.map_count for enc, _ in batch])
+            return jax.device_put(
+                (ins_ref, ins_op, ins_char, del_t, marks, mark_count,
+                 maps, map_count)
+            )
+        _, _, widths_seq, ins_lens, del_lens, mark_lens, map_lens = statics
+        counts_all = np.zeros((k, 4, d), np.int32)
+        ins_all = [np.zeros(sum(ins_lens), np.int32) for _ in range(3)]
+        del_all = np.zeros(sum(del_lens), np.int32)
+        mark_all = {col: np.zeros(sum(mark_lens), np.int32)
+                    for col in MARK_COLS}
+        map_all = {col: np.zeros(sum(map_lens), np.int32)
+                   for col in MAP_STREAM_COLS}
+        io = do = mo = po = 0
+        for r, (enc, widths) in enumerate(batch):
+            counts, ins, dels, marks, maps = self._flatten_round(
+                enc, widths, 0, d)
+            for j in range(4):
+                counts_all[r, j] = counts[j]
+            for a, v in zip(ins_all, ins):
+                a[io:io + len(v)] = v
+            del_all[do:do + len(dels)] = dels
+            for col in MARK_COLS:
+                mark_all[col][mo:mo + len(marks[col])] = marks[col]
+            for col in MAP_STREAM_COLS:
+                map_all[col][po:po + len(maps[col])] = maps[col]
+            io += ins_lens[r]
+            do += del_lens[r]
+            mo += mark_lens[r]
+            po += map_lens[r]
+        return jax.device_put(
+            (counts_all, tuple(ins_all), del_all, mark_all, map_all)
+        )
+
+    def _dispatch_fused_batch(self, batch, statics, inputs) -> None:
+        """Dispatch half: ONE donated program applies the whole batch (the
+        old state buffer is consumed in place), then the per-round digest
+        and round bookkeeping."""
+        self._apply_blocks = None
+        if statics[0] == "compact1":
+            from ..ops.kernel import apply_batch_compact_jit
+
+            _, loop_slots, widths = statics
+            counts, ins, dels, marks, maps = inputs
+            self.state = apply_batch_compact_jit(
+                self.state, counts, ins, dels, marks, maps,
+                widths=widths, insert_loop_slots=loop_slots,
+            )
+        elif statics[0] == "static1":
+            self.state = apply_batch_jit(
+                self.state, inputs, insert_loop_slots=statics[1],
+            )
+        elif statics[0] == "stacked":
+            loop_seq = statics[1]
+            self.state = apply_batch_stacked_rounds_jit(
+                self.state, inputs, loop_slots_seq=loop_seq,
+            )
+        else:
+            _, loop_seq, widths_seq, ins_lens, del_lens, mark_lens, \
+                map_lens = statics
+            counts_all, ins_all, del_all, mark_all, map_all = inputs
+            self.state = apply_batch_staged_rounds_jit(
+                self.state, counts_all, ins_all, del_all, mark_all, map_all,
+                widths_seq=widths_seq, loop_slots_seq=loop_seq,
+                ins_lens=ins_lens, del_lens=del_lens,
+                mark_lens=mark_lens, map_lens=map_lens,
+            )
+        for enc, _ in batch:
+            self._digest_row_valid[np.nonzero(enc.num_ops)[0]] = False
+            self.rounds += 1
+            GLOBAL_COUNTERS.add("streaming.rounds")
 
     def _apply_compact(self, enc: _RoundBuffers, widths) -> PackedDocs:
         """Dispatch one round via kernel.apply_batch_compact_jit: the host
@@ -1600,24 +1807,118 @@ class StreamingMerge:
         return scheduled
 
     def drain(self, max_rounds: int = 1_000) -> int:
-        """Step until no pending change is admissible; returns rounds run.
+        """Drain all admissible pending work; returns rounds run.
 
         Scheduling is host-only (causal clocks), so drain schedules every
-        pending round FIRST and commits them as one fused device program
-        (up to FUSE_MAX_ROUNDS per dispatch) — a deep queue pays the
-        ~11 ms/dispatch platform floor once instead of once per round."""
+        pending round FIRST and commits them as fused device programs (up
+        to FUSE_MAX_ROUNDS per dispatch) — a deep queue pays the
+        ~11 ms/dispatch platform floor once instead of once per round.
+
+        Fused-eligible sessions (meshless, single-block) run the PIPELINED
+        form: batch k's flatten + host→device upload happens on the
+        double-buffered staging lane while batch k+1 schedules on this
+        thread and batch k-1's donated program computes behind the async
+        dispatch queue — the host parse/transfer wall hides behind device
+        math instead of serializing with it.  With
+        :attr:`prefetch_digest`, the drain ends by pre-dispatching the
+        fused resolve+digest block program so the caller's next digest or
+        sweep read is one readback.  Byte equality with the per-round
+        ``step`` discipline is pinned by test on every path."""
+        if not self._fused_eligible():
+            return self._drain_serial(max_rounds)
+        rounds = 0
+        committed = False
+        pending = None  # (handle, batch, statics, scheduled, schedule_span)
+        while True:
+            batch, scheduled_total, ssp = self._schedule_batch(
+                rounds, max_rounds
+            )
+            if pending is not None:
+                self._commit_pending(pending)
+                committed = True
+                pending = None
+            if not batch:
+                break
+            statics = self._prep_fused_batch(batch)
+            handle = self._ensure_stager().submit(
+                self._stage_fused_batch, batch, statics
+            )
+            pending = (handle, batch, statics, scheduled_total, ssp)
+            rounds += len(batch)
+        if committed and self.prefetch_digest:
+            self._prefetch_digest()
+        self._sweep_decode_quarantine()
+        return rounds
+
+    def _commit_pending(self, pending) -> None:
+        """Land one staged batch: wait its staging handle (a staging fault
+        surfaces HERE, inside whatever guard wraps the drain) and dispatch
+        the donated program."""
+        handle, batch, statics, scheduled, ssp = pending
+        with self.tracer.span("streaming.apply", rounds=len(batch)) as asp:
+            inputs = handle.wait()
+            self._dispatch_fused_batch(batch, statics, inputs)
+        self._emit_round_stats(
+            batch, scheduled, ssp.duration, asp.duration,
+            origin="streaming.fused",
+        )
+
+    def _ensure_stager(self):
+        """The session's staging lane (lazy; respawned if closed)."""
+        from .staging import FrameStager
+
+        if self._stager is None or self._stager._closed:
+            self._stager = FrameStager()
+        return self._stager
+
+    def _prefetch_digest(self) -> None:
+        """Fused-pipeline digest accumulation: dispatch the fused
+        resolve+digest program for the (single) block NOW — async, with an
+        async device→host copy of the per-doc hash vector — so digest()
+        (and, via the shared block cache, the sweep reads) find the round's
+        resolution already computed: one readback per committed drain
+        instead of a dispatch+compute sync at the read point."""
+        self._start_digest_readback(self._digest_resolution(0))
+
+    @staticmethod
+    def _start_digest_readback(entry) -> None:
+        """Start the async device→host copy of a resolved block's digest
+        planes — the ONE spelling shared by the drain-end prefetch and the
+        heavy-block sweep's lookahead (no-op on platforms without async
+        copy)."""
+        for a in (entry.digest_dev, entry.device.overflow):
+            try:
+                a.copy_to_host_async()
+            except AttributeError:  # platform without async copy
+                pass
+
+    def _schedule_batch(self, rounds: int, max_rounds: int):
+        """Schedule the next fused batch (host-only causal admission): up
+        to ``FUSE_MAX_ROUNDS`` rounds within the drain's ``max_rounds``
+        bound.  ONE spelling of the batching policy — both the pipelined
+        and serial drain disciplines call it, so the fused_pipeline=False
+        equality oracle can never diverge on scheduling."""
+        batch = []
+        scheduled_total = 0
+        with self.tracer.span("streaming.schedule") as ssp:
+            while (len(batch) < self.FUSE_MAX_ROUNDS
+                   and rounds + len(batch) < max_rounds):
+                enc, widths, scheduled = self._schedule_round()
+                if not scheduled:
+                    break
+                batch.append((enc, widths))
+                scheduled_total += scheduled
+        return batch, scheduled_total, ssp
+
+    def _drain_serial(self, max_rounds: int) -> int:
+        """Unpipelined drain for mesh / block-chunked / engine-capture
+        sessions: schedule-then-commit per batch through the session's
+        per-round dispatch discipline."""
         rounds = 0
         while rounds < max_rounds:
-            batch = []
-            scheduled_total = 0
-            with self.tracer.span("streaming.schedule") as ssp:
-                while (len(batch) < self.FUSE_MAX_ROUNDS
-                       and rounds + len(batch) < max_rounds):
-                    enc, widths, scheduled = self._schedule_round()
-                    if not scheduled:
-                        break
-                    batch.append((enc, widths))
-                    scheduled_total += scheduled
+            batch, scheduled_total, ssp = self._schedule_batch(
+                rounds, max_rounds
+            )
             if not batch:
                 break
             with self.tracer.span("streaming.apply", rounds=len(batch)) as asp:
@@ -2348,11 +2649,7 @@ class StreamingMerge:
         for j, bi in enumerate(heavy):
             while nxt < len(heavy) and nxt <= j + 1:
                 entry = self._digest_resolution(heavy[nxt])
-                for a in (entry.digest_dev, entry.device.overflow):
-                    try:
-                        a.copy_to_host_async()
-                    except AttributeError:
-                        pass
+                self._start_digest_readback(entry)
                 pending[heavy[nxt]] = entry
                 nxt += 1
             entry = pending.pop(bi)
@@ -2654,6 +2951,24 @@ class StreamingMerge:
     def pending_count(self) -> int:
         pooled = sum(int(self._frame_mode[d].sum()) for d, _ in self._pool)
         return pooled + sum(len(s.pending) for s in self.docs)
+
+    def pending_rounds_estimate(self) -> int:
+        """Upper-bound estimate of the device rounds a full ``drain()``
+        needs: the deepest per-doc pending queue.  Docs drain in parallel
+        and causal admission feeds each doc at least one change per round
+        it participates in, so the deepest queue bounds the round count —
+        the supervisor scales its fused-drain watchdog budget by this so a
+        legitimately deep backlog is not mistaken for a hung device."""
+        if not self.num_docs:
+            return 0
+        per_doc = np.zeros(self.num_docs, np.int64)
+        for doc_of, _ in self._pool:
+            live = np.asarray(doc_of)[self._frame_mode[doc_of]]
+            if live.size:
+                per_doc += np.bincount(live, minlength=self.num_docs)
+        for d, sess in enumerate(self.docs):
+            per_doc[d] += len(sess.pending)
+        return int(per_doc.max())
 
     @property
     def layout(self) -> str:

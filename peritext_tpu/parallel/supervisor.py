@@ -32,6 +32,7 @@ carries the evidence (rollback count, quarantine registry).
 from __future__ import annotations
 
 import threading
+from functools import partial
 from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -72,8 +73,14 @@ class GuardedSession:
     Set ``autotune=False`` for the pre-round-7 static behavior.
 
     ``checkpoint_every`` counts successful guarded rounds between automatic
-    checkpoints (the rollback replay window is at most that many rounds of
-    journal).
+    checkpoints — under the per-round ``step()`` discipline the rollback
+    replay window is at most that many rounds of journal.  ``drain()`` is
+    DIFFERENT by design (ISSUE 9, chaos-pinned): the whole fused drain is
+    one atomic commit that checkpoints at its end, so its replay window is
+    the drained backlog — rollback lands on the pre-fuse boundary, never
+    mid-fuse, and the watchdog budget scales with the same backlog
+    (``_drain_deadline``).  Callers needing a tighter replay bound on a
+    deep backlog can drain in ``max_rounds`` slices.
 
     Observability: the supervisor owns a :class:`~..obs.Tracer` (unless
     given one) and a :class:`~..obs.FlightRecorder` ring dumping JSONL
@@ -329,14 +336,77 @@ class GuardedSession:
         return scheduled
 
     def drain(self, max_rounds: int = 1000) -> int:
-        """Guarded drain: step until no admissible work remains (a rolled-
-        back round either recovered its work or demoted it, so the loop
-        always terminates)."""
-        rounds = 0
-        while rounds < max_rounds:
-            if self.step() == 0:
-                break
-            rounds += 1
+        """Guarded FUSED drain: the session's whole multi-round pipelined
+        drain — staged multi-round commits plus the device-error-surfacing
+        sync — runs as ONE atomic guarded unit against the deadline
+        CEILING (a fused commit is not a single round; the tuned per-round
+        percentile does not describe it).  On watchdog deadline or any
+        device fault anywhere in the fused pipeline, rollback restores the
+        last checkpoint and replays the journal — the event-sourced ingest
+        history — so the recovered session lands on the pre-fuse round
+        boundary, never on a half-applied fused batch (chaos-pinned:
+        testing/chaos.run_fused_drain_kill).  Returns the device rounds the
+        drain committed, 0 when it rolled back (the work recovered on
+        device during rollback, or was demoted to scalar replay)."""
+        sp = None
+        try:
+            if self._inject_failures:
+                raise self._inject_failures.pop(0)
+            deadline = self._drain_deadline(max_rounds)
+            with self.tracer.span(
+                "supervisor.drain",
+                deadline=round(float(deadline), 4),
+            ) as sp:
+                rounds = self._run_guarded(
+                    partial(self._drain_once, max_rounds),
+                    deadline=deadline,
+                )
+        except Exception as exc:  # graftlint: boundary(fused drain is one containment unit: ANY failure inside it rolls the whole commit back to the pre-fuse checkpoint boundary)
+            if sp is not None:
+                # a multi-round drain wall is NOT a round wall: it exports
+                # under its own key so the fleet round-latency distribution
+                # stays honest when step() and drain() usage mix
+                GLOBAL_HISTOGRAMS.observe("supervisor.drain_seconds", sp.duration)
+            self._rollback(exc)
+            return 0
+        GLOBAL_HISTOGRAMS.observe("supervisor.drain_seconds", sp.duration)
+        if rounds:
+            self._rounds_total += rounds
+            self._rounds_since_checkpoint += rounds
+            if self._rounds_since_checkpoint >= self.checkpoint_every:
+                try:
+                    self.checkpoint()
+                except Exception:  # graftlint: boundary(checkpoint save failure tolerated; next round retries)
+                    GLOBAL_COUNTERS.add("supervisor.checkpoint_failures")
+        return rounds
+
+    def _drain_deadline(self, max_rounds: int) -> float:
+        """Watchdog budget for one fused drain: ``deadline_ceiling`` per
+        staged batch (each batch is one dispatch of up to FUSE_MAX_ROUNDS
+        rounds, and the tuned ceiling already covers a full round including
+        its dispatch), scaled by the session's backlog estimate.  A deep
+        but healthy drain gets a proportional budget instead of tripping
+        the per-round ceiling and cascading into scalar degradation; a
+        hung device is still caught within one ceiling per pending batch."""
+        session = self.session
+        fuse = int(getattr(session, "FUSE_MAX_ROUNDS", 1) or 1)
+        est = getattr(session, "pending_rounds_estimate", None)
+        rounds = min(max_rounds, est()) if est is not None else 1
+        batches = max(1, -(-rounds // fuse))
+        return self.deadline_ceiling * batches
+
+    def _drain_once(self, max_rounds: int) -> int:
+        """The guarded fused-drain body (watchdog thread): one session
+        drain — every fused batch dispatch — plus the sync that surfaces
+        async device errors INSIDE this guarded unit, so a poisoned fused
+        program can never leak its fault past the atomic commit."""
+        session = self.session  # zombie-safety: see _round
+        if self._inject_delays:
+            import time
+
+            time.sleep(self._inject_delays.pop(0))
+        rounds = session.drain(max_rounds)
+        session.sync_device()
         return rounds
 
     # -- checkpoint / rollback ---------------------------------------------
@@ -383,7 +453,10 @@ class GuardedSession:
         """Degradation ladder steps 2-4 (see module docstring).  Rollback
         drains run against the deadline CEILING, not the tuned value — a
         restore replays the journal and may recompile, exactly the slow
-        path the warmup exemption exists for."""
+        path the warmup exemption exists for — scaled by the restored
+        backlog (``_drain_deadline``): the re-drain is at least as deep as
+        the drain that faulted, so a flat ceiling would trip the watchdog
+        on a healthy replay and cascade to scalar degradation."""
         self.rollbacks += 1
         GLOBAL_COUNTERS.add("supervisor.rollbacks")
         self.recorder.fault(
@@ -392,7 +465,8 @@ class GuardedSession:
         )
         self.session = self._restore_base()
         try:
-            self._run_guarded(self._drain_device, deadline=self.deadline_ceiling)
+            self._run_guarded(self._drain_device,
+                              deadline=self._drain_deadline(1_000))
         except Exception as exc:  # graftlint: boundary(second-strike containment: a still-sick device path falls back to scalar replay)
             # the device path is still sick: rebuild once more from durable
             # state (a deadline here may have left a zombie thread draining

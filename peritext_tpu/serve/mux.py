@@ -353,13 +353,23 @@ class SessionMux:
     def patches(self, session_id: int) -> List[Patch]:
         """The session's incremental ``Patch`` stream since its previous
         call (first call builds the doc from empty) — the same vocabulary
-        the scalar path and the ProseMirror bridge emit."""
+        the scalar path and the ProseMirror bridge emit.
+
+        The first read also arms the session's fused digest prefetch:
+        this client has PROVEN the pump→read pattern, so from the next
+        pump on, every drain pre-dispatches the fused resolve+digest and
+        the window's host work hides the round's resolution compute (a
+        mux nobody reads from never pays the per-drain resolve)."""
         sess = self._require(session_id)
+        self.session.prefetch_digest = True
         return self.session.read_patches(sess.doc_index)
 
     def read(self, session_id: int):
-        """The session doc's resolved ``FormatSpan`` list."""
+        """The session doc's resolved ``FormatSpan`` list.  Arms the fused
+        digest prefetch like :meth:`patches` (the pump→read pattern is
+        proven)."""
         sess = self._require(session_id)
+        self.session.prefetch_digest = True
         return self.session.read(sess.doc_index)
 
     def _require(self, session_id: int) -> ClientSession:
@@ -387,6 +397,12 @@ class SessionMux:
             # able to tell paged serving hosts (page-pool gauges live) from
             # padded ones without a second endpoint
             "layout": getattr(self.session, "layout", "padded"),
+            # whether serving rounds commit through the fused
+            # device-resident pipeline (donated multi-round programs +
+            # drain-end digest prefetch) — False only on compat sessions
+            "fused_pipeline": bool(
+                getattr(self.session, "fused_pipeline", False)
+            ),
             "sessions": len(open_sessions),
             "sessions_total": len(self._sessions),
             "docs": self._next_doc,

@@ -277,6 +277,18 @@ class PagedDocStore:
             insert_impl=insert_impl, insert_loop_slots=insert_loop_slots,
         )
 
+    def group_plan(self, rows: Sequence[int], bucket_pages: int,
+                   pad_rows_to: Optional[int] = None):
+        """One group's host-side plan pair for the fused group chain
+        (kernel.apply_batch_paged_groups): the padded row-index vector and
+        a SNAPSHOT of the group's page-table slab — taken at plan time so
+        a later round's ``ensure_rows`` growth can never leak into an
+        already-planned group."""
+        b = pad_rows_to if pad_rows_to is not None else len(rows)
+        row_idx = np.full(b, self.num_docs, np.int64)
+        row_idx[: len(rows)] = np.asarray(rows, np.int64)
+        return row_idx, self.page_rows(rows, bucket_pages, pad_rows_to=b)
+
     # -- lifecycle: evacuate / compact / permute -----------------------------
 
     def evacuate_row(self, row: int) -> int:

@@ -202,44 +202,148 @@ class PagedStreamingMerge(StreamingMerge):
     # -- the paged device half of a round ------------------------------------
 
     def _commit_rounds(self, batch) -> None:
-        """Dispatch scheduled rounds through the page pool: per round, the
-        touched rows (and only them) group by page bucket and each group
-        runs one gather-apply-scatter program at its own width."""
+        """Dispatch scheduled rounds through the page pool as ONE donated
+        fused program: per round, the touched rows (and only them) group by
+        page bucket; every (round, group) gather-apply-scatter chains
+        inside the program in causal order, with the pool operands donated
+        so XLA updates pages in place instead of copying the whole pool per
+        group (the fused round pipeline's paged form).  Page growth
+        (``ensure_rows``) stays a per-round HOST decision made in prep, and
+        each group's page-table slab snapshots at plan time, so grouping
+        and gather widths are byte-identical to the per-round discipline."""
+        if not self.fused_pipeline:
+            self._commit_rounds_serial(batch)
+            return
+        statics = self._prep_fused_batch(batch)
+        inputs = self._stage_fused_batch(batch, statics)
+        self._dispatch_fused_batch(batch, statics, inputs)
+
+    def _commit_rounds_serial(self, batch) -> None:
+        """Pre-fusion discipline (``fused_pipeline=False``): one
+        gather-apply-scatter dispatch per (round, group), each paying its
+        own whole-pool copy — the bench fused row's comparison arm and the
+        equivalence tests' oracle side."""
         for enc, widths in batch:
             self._cum_ins += enc.ins_count
             rows = np.nonzero(enc.num_ops)[0]
             if len(rows):
                 self._store.ensure_rows(rows, self._cum_ins[rows])
-                self._dispatch_paged_round(enc, widths, rows)
+                groups = plan_page_groups(
+                    rows, self._store.num_pages, self._store.max_doc_pages
+                )
+                cap_total = 0
+                for g, g_rows in groups:
+                    b = _pow2(len(g_rows))
+                    self._store.apply_rows(
+                        g_rows, g, group_stream_arrays(enc, g_rows, b),
+                        pad_rows_to=b,
+                    )
+                    cap = b * sum(widths)
+                    cap_total += cap
+                    if GLOBAL_DEVPROF.enabled:
+                        GLOBAL_DEVPROF.observe_round(
+                            occupancy_key(b, *widths),
+                            int(enc.num_ops[g_rows].sum()), cap,
+                            origin="streaming.paged",
+                        )
+                self._commit_caps[id(enc)] = cap_total
                 self._digest_row_valid[rows] = False
             self.rounds += 1
             GLOBAL_COUNTERS.add("streaming.rounds")
         if GLOBAL_DEVPROF.enabled:
             GLOBAL_DEVPROF.observe_page_pool(self._store.pool_stats())
 
-    def _dispatch_paged_round(self, enc, widths, rows: np.ndarray) -> None:
-        groups = plan_page_groups(
-            rows, self._store.num_pages, self._store.max_doc_pages
-        )
-        cap_total = 0
-        for g, g_rows in groups:
-            b = _pow2(len(g_rows))
-            self._store.apply_rows(
-                g_rows, g, group_stream_arrays(enc, g_rows, b),
-                pad_rows_to=b,
+    def _prep_fused_batch(self, batch):
+        """Main-thread prep: advance cum-inserts, grow/allocate pages per
+        round, plan that round's page groups and SNAPSHOT their page-table
+        slabs (``PagedDocStore.group_plan``) — everything that reads or
+        mutates allocator state happens here, in round order."""
+        plans = []
+        for enc, widths in batch:
+            self._cum_ins += enc.ins_count
+            rows = np.nonzero(enc.num_ops)[0]
+            if not len(rows):
+                plans.append((widths, []))
+                continue
+            self._store.ensure_rows(rows, self._cum_ins[rows])
+            groups = plan_page_groups(
+                rows, self._store.num_pages, self._store.max_doc_pages
             )
-            cap = b * sum(widths)
-            cap_total += cap
-            if GLOBAL_DEVPROF.enabled:
-                GLOBAL_DEVPROF.observe_round(
-                    occupancy_key(b, *widths),
-                    int(enc.num_ops[g_rows].sum()), cap,
-                    origin="streaming.paged",
+            plan = []
+            for g, g_rows in groups:
+                b = _pow2(len(g_rows))
+                row_idx, table = self._store.group_plan(g_rows, g,
+                                                        pad_rows_to=b)
+                plan.append((g_rows, b, row_idx, table))
+            plans.append((widths, plan))
+        return ("paged", tuple(plans))
+
+    def _stage_fused_batch(self, batch, statics):
+        """Worker-safe staging: slice each group's stream tensors out of
+        its round's staging buffers and upload the whole (round, group)
+        input sequence with one ``jax.device_put``."""
+        _, plans = statics
+        group_inputs = []
+        for (enc, _), (widths, plan) in zip(batch, plans):
+            for g_rows, b, row_idx, table in plan:
+                group_inputs.append(
+                    (row_idx, table, group_stream_arrays(enc, g_rows, b))
                 )
-        self._commit_caps[id(enc)] = cap_total
+        return jax.device_put(tuple(group_inputs))
+
+    def _dispatch_fused_batch(self, batch, statics, inputs) -> None:
+        """Dispatch the donated group chain + per-round bookkeeping and
+        the fused-site occupancy telemetry."""
+        from ..ops.kernel import apply_batch_paged_groups_jit
+
+        from ..ops.kernel import (
+            apply_batch_paged_jit,
+            resolve_state_donation,
+        )
+
+        _, plans = statics
+        store = self._store
+        if len(inputs) == 1 and not resolve_state_donation(store.pool_elem):
+            # single-group commit on a non-donating platform: the legacy
+            # per-group program IS the dispatch (shared compile with the
+            # pre-fusion path — group chaining buys nothing at length 1)
+            row_idx, table, enc_arrays = inputs[0]
+            store.pool_elem, store.pool_char, store.aux = (
+                apply_batch_paged_jit(
+                    store.pool_elem, store.pool_char, store.aux,
+                    row_idx, table, enc_arrays,
+                )
+            )
+        elif inputs:
+            store.pool_elem, store.pool_char, store.aux = (
+                apply_batch_paged_groups_jit(
+                    store.pool_elem, store.pool_char, store.aux, inputs,
+                    loop_slots_seq=(None,) * len(inputs),
+                )
+            )
+        for (enc, _), (widths, plan) in zip(batch, plans):
+            cap_total = 0
+            rows = np.nonzero(enc.num_ops)[0]
+            for g_rows, b, _, _ in plan:
+                cap = b * sum(widths)
+                cap_total += cap
+                if GLOBAL_DEVPROF.enabled:
+                    GLOBAL_DEVPROF.observe_round(
+                        occupancy_key(b, *widths),
+                        int(enc.num_ops[g_rows].sum()), cap,
+                        origin="streaming.paged.fused",
+                    )
+            self._commit_caps[id(enc)] = cap_total
+            if len(rows):
+                self._digest_row_valid[rows] = False
+            self.rounds += 1
+            GLOBAL_COUNTERS.add("streaming.rounds")
+        if GLOBAL_DEVPROF.enabled:
+            GLOBAL_DEVPROF.observe_page_pool(self._store.pool_stats())
 
     def _emit_round_stats(self, batch, scheduled: int,
-                          schedule_s: float, apply_s: float) -> None:
+                          schedule_s: float, apply_s: float,
+                          origin: str = "streaming.paged") -> None:
         """Padded capacity under the paged layout is what the dispatched
         GROUPS paid (rows-bucket x widths per bucket), recorded at commit
         time — the base accounting's D x widths would charge the whole

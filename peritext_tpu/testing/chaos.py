@@ -1241,6 +1241,102 @@ def run_reconnect_storm(
     return report
 
 
+def run_fused_drain_kill(seed: int, checkpoint_root=None) -> Dict:
+    """Kill a fused multi-round drain BETWEEN its staged batch commits and
+    prove recovery is byte-equal: the fused pipeline commits several
+    multi-round device programs per drain, so the nastiest failure point is
+    mid-fuse — some batches landed, one died, the donated state is
+    half-advanced.  The supervisor must treat the whole fused drain as ONE
+    atomic unit: rollback restores the last checkpoint and replays the
+    journal (event-sourced ingest), so the recovered session re-derives
+    device state from the pre-fuse round boundary — it can never resume
+    from a half-applied fused batch.
+
+    Episode: ingest half the workload, drain + checkpoint (the pre-fuse
+    boundary is real state, not an empty session); ingest the rest; arm a
+    one-shot fault that raises inside the SECOND staged-batch dispatch of
+    the next fused drain; guarded drain → watchdog containment → rollback
+    → journal replay → clean re-drain.  Oracle: digest + spans byte-equal
+    to a fault-free twin, zero pending, exactly one rollback, and the kill
+    provably fired mid-fuse (≥ 1 batch committed before it)."""
+    tmp = None
+    if checkpoint_root is None:
+        tmp = tempfile.TemporaryDirectory(prefix="pt-fused-chaos-")
+        checkpoint_root = tmp.name
+    try:
+        docs, opd = 4, 96
+        workloads = generate_workload(seed=seed, num_docs=docs, ops_per_doc=opd)
+
+        def factory():
+            s = _campaign_session(docs, opd)
+            # low round caps + a narrow fuse window force the drain into
+            # SEVERAL staged batches (the mid-fuse failure point needs a
+            # batch boundary to die on)
+            s.round_caps = (8, 8, 8, 8)
+            s.FUSE_MAX_ROUNDS = 2
+            return s
+
+        frames = []
+        for d, w in enumerate(workloads):
+            ch = [c for log in sorted(w) for c in w[log]]
+            half = len(ch) // 2
+            frames.append((encode_frame(ch[:half]), encode_frame(ch[half:])))
+
+        clean = factory()
+        for d, (a, b) in enumerate(frames):
+            clean.ingest_frame(d, a)
+            clean.ingest_frame(d, b)
+        clean.drain()
+
+        guarded = GuardedSession(
+            factory, checkpoint_root, deadline=120.0, checkpoint_every=1000,
+        )
+        for d, (a, _) in enumerate(frames):
+            guarded.ingest_frame(d, a)
+        pre_rounds = guarded.drain()
+        assert pre_rounds > 0, "first half must commit"
+        guarded.checkpoint()  # the pre-fuse boundary rollback must land on
+
+        for d, (_, b) in enumerate(frames):
+            guarded.ingest_frame(d, b)
+        sess = guarded.session
+        orig_dispatch = sess._dispatch_fused_batch
+        calls = {"n": 0}
+
+        def killer(batch, statics, inputs):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("chaos: device died mid-fuse")
+            return orig_dispatch(batch, statics, inputs)
+
+        sess._dispatch_fused_batch = killer
+        rolled = guarded.drain()
+        assert rolled == 0, "a killed fused drain must report a rollback"
+        assert guarded.rollbacks == 1, guarded.rollbacks
+        assert calls["n"] == 2, (
+            f"kill must fire on the second staged batch (mid-fuse), "
+            f"saw {calls['n']} dispatches"
+        )
+        # recovery: rollback's guarded re-drain already converged the
+        # journal replay; the oracle is byte equality with the clean twin
+        assert guarded.pending_count() == 0
+        digest, clean_digest = guarded.digest(), clean.digest()
+        assert digest == clean_digest, (
+            f"mid-fuse kill recovery diverged: {digest:#x} != {clean_digest:#x}"
+        )
+        assert guarded.read_all() == clean.read_all()
+        return {
+            "seed": seed,
+            "rollbacks": guarded.rollbacks,
+            "batches_before_kill": calls["n"] - 1,
+            "pre_fuse_rounds": pre_rounds,
+            "digest": digest,
+        }
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
 def run_campaign(
     seeds: range, num_docs: int = 6, ops_per_doc: int = 40,
     verbose: bool = False, **kw,

@@ -217,6 +217,80 @@ def measure_fine(docs, rounds, opd, slots=384, marks=96):
                 ops_per_sec=round(total_ops / max(total, 1e-9), 1))
 
 
+def measure_fused_pipeline(docs, rounds, opd, slots=384, marks=96):
+    """Fused-pipeline decomposition (ISSUE 9 satellite): how much of the
+    host's parse/schedule wall the pipelined drain actually HIDES behind
+    device compute.
+
+    Three honest measurements over the same live workload:
+
+    * ``pipelined_s`` — the fused discipline end-to-end (pipelined drain:
+      staged batches, async dispatch, staging lane);
+    * ``serialized_s`` — the identical session forced lock-step: a device
+      sync after every drain, so host work and device math strictly
+      alternate (the no-overlap upper bound);
+    * ``host_parse_s`` — the session's own wire-parse wall
+      (``host_parse_seconds``).
+
+    ``overlap_hidden_s = serialized_s - pipelined_s`` is the wall the
+    pipeline removed; ``parse_overlap_ratio = clamp(hidden / host_parse,
+    0, 1)`` expresses it against the parse stage the ISSUE attributes the
+    streaming gap to — the remaining-gap attribution the fused row's
+    throughput alone cannot give."""
+    import time as _time
+
+    from bench import build_arrival
+    from peritext_tpu.parallel.streaming import StreamingMerge
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    workloads = generate_workload(seed=0, num_docs=docs, ops_per_doc=opd)
+    arrival, _ = build_arrival(workloads, rounds, 0)
+    total_ops = sum(len(ch.ops) for w in workloads for log in w.values()
+                    for ch in log)
+
+    def run(serialize: bool):
+        s = StreamingMerge(
+            num_docs=docs, actors=("doc1", "doc2", "doc3"),
+            slot_capacity=slots, mark_capacity=marks, tomb_capacity=slots,
+            round_insert_capacity=64, round_delete_capacity=32,
+            round_mark_capacity=32, round_map_capacity=16,
+        )
+        t0 = _time.perf_counter()
+        for r in range(rounds):
+            s.ingest_frames(
+                (doc, b[r]) for doc, b in enumerate(arrival) if r < len(b))
+            s.drain()
+            if serialize:
+                s.sync_device()
+        digest = s.digest()
+        return _time.perf_counter() - t0, digest, s
+
+    run(False)  # warm compiles
+    run(True)
+    pipe, dg_a, s_pipe = min(
+        (run(False) for _ in range(3)), key=lambda x: x[0])
+    serial, dg_b, _ = min((run(True) for _ in range(3)), key=lambda x: x[0])
+    assert dg_a == dg_b, "overlap must not change the digest"
+    hidden = max(0.0, serial - pipe)
+    parse = max(s_pipe.host_parse_seconds, 1e-9)
+    row = dict(
+        docs=docs, rounds=rounds, staged_rounds=s_pipe.rounds,
+        ops=total_ops, mode="fused",
+        pipelined_s=round(pipe, 4),
+        serialized_s=round(serial, 4),
+        host_parse_s=round(s_pipe.host_parse_seconds, 4),
+        overlap_hidden_s=round(hidden, 4),
+        parse_overlap_ratio=round(min(1.0, hidden / parse), 3),
+        ops_per_sec=round(total_ops / pipe, 1),
+    )
+    print(f"fused pipeline: pipelined {pipe*1e3:7.1f} ms  "
+          f"serialized {serial*1e3:7.1f} ms  "
+          f"parse {s_pipe.host_parse_seconds*1e3:6.1f} ms  "
+          f"hidden {hidden*1e3:6.1f} ms  "
+          f"overlap_ratio {row['parse_overlap_ratio']}")
+    return row
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--fine", action="store_true",
@@ -246,7 +320,10 @@ def main(argv=None):
 
     if args.fine:
         results = [measure_fine(args.docs, args.rounds, args.ops_per_doc,
-                                args.slots, args.marks)]
+                                args.slots, args.marks),
+                   measure_fused_pipeline(args.docs, args.rounds,
+                                          args.ops_per_doc, args.slots,
+                                          args.marks)]
     else:
         shapes = [(args.docs, args.rounds, args.ops_per_doc)]
         if args.sweep:
@@ -277,8 +354,9 @@ def main(argv=None):
         # coarse mode a single-sync pass — distinct row identities so the
         # two never pollute each other's rolling reference
         rows = [
-            dict(row=("engine_profile_fine" if r.get("mode") == "fine"
-                      else "engine_profile")
+            dict(row=({"fine": "engine_profile_fine",
+                       "fused": "fused_pipeline"}.get(r.get("mode"),
+                                                      "engine_profile"))
                  + f"[{r['docs']}x{r['staged_rounds']}]",
                  metric="engine_profile_ops_per_sec", value=r["ops_per_sec"],
                  unit="ops/s", docs=r["docs"], rounds=r["rounds"])

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""fused-pipeline smoke: the fused device-resident round pipeline's CI
+contract (and ``make fused-smoke``).
+
+Asserts, on CPU, the four promises ISSUE 9 makes:
+
+* **byte equality** — the fused pipeline (staged multi-round programs,
+  pipelined drain, staging lane, digest prefetch) is indistinguishable
+  from the per-round dispatch discipline on the same workload: spans,
+  incremental patches and full-state digests bit-equal, padded AND paged
+  layouts, several fuzz seeds;
+* **staging overlaps** — the double-buffered staging lane actually staged
+  the drain's batches off the scheduling thread (lane counters), and the
+  serialized (sync-per-drain) twin is no FASTER than the pipelined drain
+  beyond noise — overlap never costs wall;
+* **zero steady-state compiles** — a fresh session replaying the same
+  workload shapes dispatches only already-compiled fused programs
+  (RecompileSentinel);
+* **observable** — devprof sees the fused dispatch sites
+  (``apply_batch_staged_rounds``) and the fused-origin occupancy rows.
+
+Artifacts (``fused-report.json``, a devprof snapshot) are written for
+upload.  Exit nonzero on any violation.
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _session(layout, fused, static_rounds=False, num_docs=8):
+    from peritext_tpu.parallel.streaming import StreamingMerge
+
+    s = StreamingMerge(
+        num_docs=num_docs, actors=("doc1", "doc2", "doc3"),
+        slot_capacity=256, mark_capacity=96, tomb_capacity=128,
+        round_insert_capacity=24, round_delete_capacity=12,
+        round_mark_capacity=12, round_map_capacity=8,
+        static_rounds=static_rounds, layout=layout,
+    )
+    s.fused_pipeline = fused
+    s.prefetch_digest = fused
+    return s
+
+
+def _feed(s, workloads, seed, chunks=3, per_round=False, sync=False):
+    """One seeded feed plan shared by every arm (fused, per-round oracle,
+    lock-step serialized) — the equality assertions depend on all arms
+    deriving the SAME frame plan.  ``sync`` blocks after each drain (the
+    overlap smoke's serialized arm)."""
+    from peritext_tpu.parallel.codec import encode_frame
+
+    rng = random.Random(seed)
+    plans = []
+    for w in workloads:
+        ch = [c for a in sorted(w) for c in w[a]]
+        rng.shuffle(ch)
+        size = -(-len(ch) // chunks)
+        plans.append([ch[i:i + size] for i in range(0, len(ch), size)])
+    t0 = time.perf_counter()
+    for r in range(chunks):
+        s.ingest_frames(
+            (d, encode_frame(sorted(p[r], key=lambda c: (c.actor, c.seq))))
+            for d, p in enumerate(plans) if r < len(p)
+        )
+        if per_round:
+            while s.step() > 0:
+                pass
+        else:
+            s.drain()
+            if sync:
+                s.sync_device()
+    digest = s.digest()
+    return time.perf_counter() - t0, digest
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seeds", type=int, nargs="*", default=[5, 19])
+    parser.add_argument("--out", default="fused-artifacts",
+                        help="artifact directory")
+    args = parser.parse_args()
+
+    from peritext_tpu.obs import GLOBAL_DEVPROF
+    from peritext_tpu.observability import RecompileSentinel
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    report = {"seeds": args.seeds, "layouts": {}}
+
+    GLOBAL_DEVPROF.reset()
+    with GLOBAL_DEVPROF:
+        # -- equivalence sweep: fused vs per-round, both layouts ------------
+        for layout in ("padded", "paged"):
+            rows = []
+            for seed in args.seeds:
+                wl = generate_workload(seed=seed, num_docs=8, ops_per_doc=48)
+                fused = _session(layout, True)
+                _, dg_f = _feed(fused, wl, seed)
+                oracle = _session(layout, False)
+                _, dg_o = _feed(oracle, wl, seed, per_round=True)
+                assert dg_f == dg_o, (
+                    f"{layout} seed {seed}: fused digest {dg_f:#x} != "
+                    f"per-round {dg_o:#x}"
+                )
+                assert fused.read_all() == oracle.read_all(), (
+                    f"{layout} seed {seed}: span sweep diverged")
+                assert fused.read_patches_all() == oracle.read_patches_all(), (
+                    f"{layout} seed {seed}: patch sweep diverged")
+                assert fused.rounds == oracle.rounds
+                rows.append({"seed": seed, "digest": dg_f,
+                             "rounds": fused.rounds,
+                             "stager": fused._stager.stats()
+                             if fused._stager else None})
+            report["layouts"][layout] = rows
+
+        # -- staging-overlap smoke ------------------------------------------
+        wl = generate_workload(seed=args.seeds[0], num_docs=8, ops_per_doc=48)
+        pipelined = _session("padded", True)
+        t_pipe, dg_a = _feed(pipelined, wl, args.seeds[0])
+        lane = pipelined._stager.stats()
+        assert lane["staged"] > 0, "the staging lane must have staged batches"
+        assert lane["errors"] == 0, lane
+        serial = _session("padded", True)
+        serial.prefetch_digest = False
+        # same feed plan, but lock-step: sync after every drain
+        t_serial, dg_b = _feed(serial, wl, args.seeds[0], sync=True)
+        assert dg_a == dg_b
+        report["staging_overlap"] = {
+            "pipelined_s": round(t_pipe, 4),
+            "serialized_s": round(t_serial, 4),
+            "lane": lane,
+        }
+        # overlap must never COST wall beyond run noise (2x guard: this is
+        # a smoke direction check, not a perf gate — the ledger gates perf)
+        assert t_pipe <= 2.0 * t_serial, report["staging_overlap"]
+
+        # -- zero steady-state compiles -------------------------------------
+        wl = generate_workload(seed=77, num_docs=6, ops_per_doc=40)
+        cold = _session("padded", True, num_docs=6)
+        _, dg_cold = _feed(cold, wl, 77)
+        with RecompileSentinel() as sentinel:
+            sentinel.mark()
+            warm = _session("padded", True, num_docs=6)
+            _, dg_warm = _feed(warm, wl, 77)
+            sentinel.assert_steady_state("fused pipeline repeat workload")
+        assert dg_warm == dg_cold
+        report["steady_state_compiles"] = 0
+
+    snap = GLOBAL_DEVPROF.snapshot()
+    assert any(site.startswith("apply_batch_staged_rounds")
+               for site in snap["sites"]), sorted(snap["sites"])
+    assert any(o["origin"] == "streaming.fused"
+               for o in snap["occupancy"].values()), "fused occupancy origin"
+    report["devprof_sites"] = sorted(snap["sites"])
+
+    (out / "fused-report.json").write_text(json.dumps(report, indent=2))
+    (out / "devprof-snapshot.json").write_text(json.dumps(snap, indent=2))
+    print(json.dumps({"ok": True,
+                      "staging_overlap": report["staging_overlap"],
+                      "layouts": {k: len(v)
+                                  for k, v in report["layouts"].items()}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

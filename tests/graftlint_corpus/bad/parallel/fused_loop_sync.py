@@ -1,0 +1,28 @@
+"""graftlint fixture: the fused-pipeline mistake PTL003 exists for.
+
+The fused round pipeline (parallel/streaming.py drain) chains K rounds
+inside ONE device program precisely so the device never waits on the host
+between rounds.  The tempting "just checking" move is a
+``block_until_ready`` between chained rounds — a host sync INSIDE the
+fused loop, which re-serializes exactly the async dispatch pipeline the
+fusion removed (the FusionStitching defect class: a host boundary stitched
+back into the middle of a device program).  This file is the TRUE POSITIVE
+proving PTL003 fires on that; never "fix" it.
+"""
+
+import jax
+
+
+def _chained_round(state, stream):
+    state = state + stream
+    # PTL003: host sync inside the fused round loop, reachable from the
+    # jit root below through the file-local call graph
+    jax.block_until_ready(state)
+    return state
+
+
+@jax.jit
+def fused_round_pipeline(state, streams):
+    for k in range(4):
+        state = _chained_round(state, streams[k])
+    return state

@@ -399,6 +399,21 @@ class TestGuardedSession:
         assert guarded.digest() == clean.digest()
         self._converged(guarded, workloads)
 
+    def test_fused_drain_kill_recovers_byte_equal(self, tmp_path):
+        """A device fault BETWEEN staged-batch commits of one fused
+        multi-round drain (mid-fuse: earlier batches already advanced the
+        donated state) must roll the WHOLE drain back to the pre-fuse
+        checkpoint boundary and recover byte-equal via journal replay —
+        never resume from a half-applied fused pipeline."""
+        from peritext_tpu.testing.chaos import run_fused_drain_kill
+
+        report = run_fused_drain_kill(seed=101, checkpoint_root=tmp_path)
+        assert report["rollbacks"] == 1
+        # the kill provably fired mid-fuse: at least one staged batch had
+        # already committed inside the killed drain
+        assert report["batches_before_kill"] >= 1
+        assert report["pre_fuse_rounds"] > 0
+
     def test_persistent_failure_degrades_to_scalar_replay(self, tmp_path, monkeypatch):
         workloads = generate_workload(seed=29, num_docs=2, ops_per_doc=OPS)
         rng = random.Random(29)

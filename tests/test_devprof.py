@@ -189,7 +189,9 @@ def test_bucket_table_distinct_shapes_match_sentinel(recompile_sentinel,
 
     compiles = recompile_sentinel.since_mark()
     distinct = global_devprof.distinct_shapes()
-    assert "apply_batch_compact" in distinct  # the workload hit the kernel
+    # the workload hit the fused kernel (the round-13 staged multi-round
+    # program is the streaming commit path now)
+    assert "apply_batch_staged_rounds" in distinct
     for site, shapes in distinct.items():
         assert shapes == compiles.get(site, 0), (
             f"site {site}: {shapes} distinct shape bucket(s) vs "

@@ -60,6 +60,10 @@ class TestRules:
         # the devprof pattern: a cost/memory probe reachable from a
         # merge-scope jit root is a host sync, obs/-scoping or not
         assert ("PTL003", "return jax.block_until_ready(state)") in hits
+        # the fused-pipeline mistake: a host sync INSIDE the fused round
+        # loop (reachable from the jit root through a chained helper)
+        # re-serializes the dispatch pipeline the fusion exists to remove
+        assert ("PTL003", "jax.block_until_ready(state)") in hits
         assert ("PTL005", "except Exception:") in hits
         assert ("PTL006", "rng = random.Random()") in hits
         # the serving-tier placement mistake: a wall-clock read sneaking

@@ -484,12 +484,13 @@ class TestSessionMux:
         mux.open_session("a")
         snap = mux.snapshot()
         assert set(snap) == {
-            "host", "layout", "sessions", "sessions_total", "docs",
-            "doc_capacity", "degraded_docs", "rounds", "applied_frames",
-            "buffered_frames", "overloaded", "recent_sheds", "queue",
-            "window", "session_table",
+            "host", "layout", "fused_pipeline", "sessions", "sessions_total",
+            "docs", "doc_capacity", "degraded_docs", "rounds",
+            "applied_frames", "buffered_frames", "overloaded",
+            "recent_sheds", "queue", "window", "session_table",
         }
         assert snap["layout"] == "padded"  # paged muxes add "page_pool"
+        assert snap["fused_pipeline"] is True  # serving rides the fused path
         assert snap["host"] == "h9"
         assert set(snap["session_table"]["0"]) == {
             "client", "doc", "submitted", "admitted", "delayed", "shed",
