@@ -8,7 +8,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: test fuzz fuzz-differential fuzz-frames fuzz-crash chaos weak-scaling \
 	bench bench-smoke bench-streaming bench-fused entry dryrun lint lint-baseline \
 	clean obs fleet perf-gate serve-smoke bench-serve paged-smoke bench-longdoc \
-	fused-smoke
+	fused-smoke fleet-serve-smoke bench-fleet-serve bench-markheavy
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -76,6 +76,24 @@ bench-longdoc:
 fused-smoke:
 	$(CPU_ENV) $(PY) scripts/fused_smoke.py --out /tmp/pt-fused
 
+# fleet-serve smoke (mirrors the CI fleet-serve-smoke job): a 3-host
+# FleetFrontend under round-robin traffic, one serving host killed
+# mid-traffic — lease death detection, checkpoint+journal failover,
+# typed verdicts only, acked-op survival, post-heal byte equality, and
+# the /fleet.json + peritext_fleet_* exporter surface (artifacts land
+# in /tmp/pt-fleet-serve)
+fleet-serve-smoke:
+	$(CPU_ENV) $(PY) scripts/fleet_serve_smoke.py --out /tmp/pt-fleet-serve
+
+# host-kill failover episode as a measurement: fleet frames applied/s
+# with every failover oracle asserted in-row
+bench-fleet-serve:
+	$(PY) bench.py --mode fleet-serve
+
+# mark-heavy editorial pass (span-overlap explosion) vs the scalar oracle
+bench-markheavy:
+	$(PY) bench.py --mode markheavy
+
 # streaming frame ingest vs oracle (spans + incremental patch streams)
 fuzz-frames:
 	$(CPU_ENV) $(PY) -m peritext_tpu.testing.fuzz --differential-frames
@@ -102,7 +120,7 @@ bench-engine:  # device-only streaming replay: the engine limit vs the link
 # ledger, then gated with per-row tolerance bands (exit 1 on regression)
 perf-gate:
 	cp perf/reference_ledger.jsonl /tmp/pt-perf-gate.jsonl
-	PT_BENCH_LADDER_ROWS="streaming,streaming_fused,wire,serve_sustained,batch_longdoc" $(PY) bench.py \
+	PT_BENCH_LADDER_ROWS="streaming,streaming_fused,wire,serve_sustained,batch_longdoc,markheavy,fleet_serve" $(PY) bench.py \
 		--mode ladder --smoke --platform cpu --devprof \
 		--ledger /tmp/pt-perf-gate.jsonl
 	$(PY) -m peritext_tpu.obs perf /tmp/pt-perf-gate.jsonl --gate

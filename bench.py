@@ -775,6 +775,8 @@ def orchestrate(args, passthrough) -> int:
             "serve": "serve_sustained_docs_per_sec",
             "storm": "reconnect_storm_drain_ops_per_sec",
             "longdoc": "longdoc_paged_ops_per_sec",
+            "markheavy": "markheavy_ops_per_sec",
+            "fleet-serve": "fleet_serve_applied_frames_per_sec",
         }
         print(json.dumps({
             "metric": metric_of_mode.get(args.mode, "crdt_ops_per_sec_per_chip"),
@@ -1401,6 +1403,144 @@ def run_longdoc(args) -> dict:
     }
 
 
+def run_markheavy(args) -> dict:
+    """Mark-heavy editorial-pass row (ISSUE 10 / ROADMAP scenario
+    diversity): the span-overlap-explosion workload family — mostly long
+    overlapping addMark/removeMark spans over a thin insert substrate —
+    streamed through a session with the byte-equality oracle ATTACHED
+    (device spans must equal the scalar oracle's, in-row).  Reports
+    streaming throughput on the mark-heavy mix plus the mark/op ratio; the
+    same family runs as a chaos schedule
+    (testing/chaos.run_markheavy_chaos)."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    from peritext_tpu.api.batch import _oracle_doc
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.testing.fuzz import (
+        _campaign_session, generate_markheavy_workload,
+    )
+
+    d, opd = args.docs, args.ops_per_doc
+    gen_start = time.perf_counter()
+    workloads = generate_markheavy_workload(
+        seed=args.seed + 17, num_docs=d, ops_per_doc=opd,
+    )
+    gen_time = time.perf_counter() - gen_start
+    total_ops = 0
+    mark_ops = 0
+    for w in workloads:
+        for log in w.values():
+            for ch in log:
+                for op in ch.ops:
+                    total_ops += 1
+                    if op.action in ("addMark", "removeMark"):
+                        mark_ops += 1
+    plans = []
+    for w in workloads:
+        changes = [ch for log in sorted(w) for ch in w[log]]
+        plans.append([
+            encode_frame(changes[i:i + 8])
+            for i in range(0, len(changes), 8)
+        ])
+
+    def feed():
+        session = _campaign_session(d, opd)
+        for doc, frames in enumerate(plans):
+            for f in frames:
+                session.ingest_frame(doc, f)
+        while session.drain() > 0:
+            pass
+        session.digest()
+        return session
+
+    feed()  # warmup (compiles)
+    t_best = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        session = feed()
+        dt = time.perf_counter() - t0
+        t_best = dt if t_best is None or dt < t_best else t_best
+
+    # the byte-equality oracle, in-row: spans vs the scalar reference
+    oracle = [_oracle_doc(w).get_text_with_formatting(["text"])
+              for w in workloads]
+    got = session.read_all()
+    for doc in range(d):
+        assert got[doc] == oracle[doc], (
+            f"markheavy doc {doc}: device spans diverge from the scalar "
+            "oracle"
+        )
+    value = total_ops / t_best
+    return {
+        "metric": "markheavy_ops_per_sec",
+        "value": round(value, 1),
+        "unit": "ops/s",
+        "vs_baseline": None,
+        "baseline_impl": "scalar-oracle byte equality asserted in-row",
+        "docs": d,
+        "ops_per_doc": opd,
+        "total_ops": total_ops,
+        "mark_ops": mark_ops,
+        "mark_fraction": round(mark_ops / max(1, total_ops), 3),
+        "byte_equal": True,
+        "wall_seconds": round(t_best, 3),
+        "fallback_docs": sum(1 for s in session.docs if s.fallback),
+        "workload_gen_seconds": round(gen_time, 1),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def run_fleet_serve(args) -> dict:
+    """Fleet-serve row (ISSUE 10 tentpole evidence): the host-kill failover
+    episode as a measurement — a ≥3-host FleetFrontend carries round-robin
+    traffic, one serving host is killed mid-traffic, the lease detects it,
+    failover re-homes the docs from checkpoint + journal, and client
+    retries drain.  All of run_host_kill_failover's oracles (typed
+    verdicts only, acked-op survival, post-heal fleet-wide byte equality)
+    are ASSERTED in-row; the reported value is fleet frames applied per
+    second over the whole episode, with the detection/failover evidence
+    riding along."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    from peritext_tpu.testing.chaos import run_host_kill_failover
+
+    report = run_host_kill_failover(
+        args.seed + 29,
+        hosts=3,
+        num_docs=args.docs,
+        ops_per_doc=args.ops_per_doc,
+        transport=not args.smoke,
+    )
+    value = report.applied_frames / max(report.traffic_seconds, 1e-9)
+    return {
+        "metric": "fleet_serve_applied_frames_per_sec",
+        "value": round(value, 1),
+        "unit": "frames/s",
+        "vs_baseline": None,
+        "baseline_impl": "host-kill failover episode, all oracles asserted",
+        "hosts": report.hosts,
+        "docs": report.num_docs,
+        "ops_per_doc": args.ops_per_doc,
+        "victim": report.victim,
+        "victim_docs": report.victim_docs,
+        "detection_rounds": report.detection_rounds,
+        "failover_docs": report.failover_docs,
+        "offered": report.offered,
+        "admitted": report.admitted,
+        "delayed": report.delayed,
+        "shed": report.shed,
+        "acked_survived": report.acked_survived,
+        "converged": report.converged,
+        "transport": "tcp" if not args.smoke else "in-process",
+        "episode_seconds": round(report.traffic_seconds, 3),
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def run_sweep(args) -> dict:
     """Full-corpus sweep row (BASELINE config 5b, VERDICT r3 task 5): build
     an N-doc converged session on carried device state (the scale demo's
@@ -1497,6 +1637,8 @@ def ladder_rows(platform: str):
         ("serve_sustained", "-", ["--mode", "serve"], platform, t),
         ("reconnect_storm", "-", ["--mode", "storm"], platform, t),
         ("batch_longdoc", "4b", ["--mode", "longdoc"], platform, t),
+        ("markheavy",    "-",  ["--mode", "markheavy"], platform, t),
+        ("fleet_serve",  "-",  ["--mode", "fleet-serve"], "cpu", t),
         ("sweep_100k",   "5b", ["--mode", "sweep"], platform, max(t, 1800.0)),
         # the paged-vs-padded sweep comparison: same 100K-doc corpus, paged
         # resident storage — gate history is per row name, so regressions
@@ -1702,7 +1844,7 @@ def main() -> None:
         "--mode",
         choices=("batch", "streaming", "streaming-fused", "engine", "wire",
                  "sweep", "baselines", "fleet", "serve", "storm", "longdoc",
-                 "ladder"),
+                 "markheavy", "fleet-serve", "ladder"),
         default=None,
         help="batch = one-shot converge (configs 2-4); streaming = config 5 "
              "end-to-end; engine = device-only streaming replay (the engine "
@@ -1713,7 +1855,10 @@ def main() -> None:
              "at a p99 apply-latency SLO, ISSUE 7); storm = reconnect-storm "
              "backlog drain under serving load; longdoc = long-tail "
              "paged-vs-padded comparison (one essay among a tweet fleet, "
-             "ISSUE 8); ladder = every row as "
+             "ISSUE 8); markheavy = mark-heavy editorial pass (span-overlap "
+             "explosion, scalar-oracle byte equality in-row, ISSUE 10); "
+             "fleet-serve = host-kill failover episode as a measurement "
+             "(ISSUE 10); ladder = every row as "
              "bounded sub-workers (the default when invoked with no mode "
              "and no --smoke)",
     )
@@ -1813,6 +1958,10 @@ def main() -> None:
     elif args.mode == "longdoc":
         # --docs = the tweet fleet, --ops-per-doc = the essay
         defaults = (64, 512, 0, 0) if args.smoke else (1024, 8192, 0, 0)
+    elif args.mode == "markheavy":
+        defaults = (16, 64, 0, 0) if args.smoke else (256, 192, 0, 0)
+    elif args.mode == "fleet-serve":
+        defaults = (4, 16, 0, 0) if args.smoke else (8, 48, 0, 0)
     elif args.mode in ("streaming", "streaming-fused", "engine"):
         defaults = (64, 96, 256, 64) if args.smoke else (2048, 192, 384, 96)
     else:
@@ -1827,7 +1976,8 @@ def main() -> None:
                "engine": run_engine, "batch": run,
                "wire": run_wire, "sweep": run_sweep, "baselines": run_baselines,
                "fleet": run_fleet_heal, "serve": run_serve, "storm": run_storm,
-               "longdoc": run_longdoc}
+               "longdoc": run_longdoc, "markheavy": run_markheavy,
+               "fleet-serve": run_fleet_serve}
     if args.devprof:
         # arm the process profiler before any jit dispatches; cost capture
         # on — the worker is a bounded measurement run, and the AOT

@@ -122,28 +122,51 @@ _LEN = "<I"
 
 
 def _write_frames(path: Path, frames: List[bytes]) -> None:
-    import struct
-
-    with open(path, "wb") as f:
-        for frame in frames:
-            f.write(struct.pack(_LEN, len(frame)))
-            f.write(frame)
+    path.write_bytes(pack_doc_frames(frames))
 
 
 def _read_frames(path: Path) -> List[bytes]:
+    try:
+        return unpack_doc_frames(path.read_bytes())
+    except ValueError as exc:
+        raise ValueError(f"truncated frame file: {path}") from exc
+
+
+def pack_doc_frames(frames: List[bytes]) -> bytes:
+    """One doc's checkpoint frame history as a single SHIPPABLE blob —
+    the unit the fleet tier's checkpoint ship moves over the multihost
+    transport (:func:`~.parallel.multihost.ship_frames`).  Same
+    length-prefix framing as the on-disk ``doc_*.frames`` files, so a
+    shipped checkpoint and a saved one are byte-interchangeable.
+    Re-ingesting the unpacked frames reconstructs the doc exactly
+    (event sourcing), and frames are duplicate-tolerant, so overlap
+    between a shipped checkpoint and later journal redelivery is
+    harmless."""
+    import struct
+
+    out = bytearray()
+    for frame in frames:
+        out += struct.pack(_LEN, len(frame))
+        out += frame
+    return bytes(out)
+
+
+def unpack_doc_frames(data: bytes) -> List[bytes]:
+    """Inverse of :func:`pack_doc_frames`; raises ``ValueError`` on a
+    truncated blob (a partial ship must fail loudly, never ingest a
+    half-frame)."""
     import struct
 
     frames: List[bytes] = []
-    data = path.read_bytes()
     pos = 0
     while pos < len(data):
         if pos + 4 > len(data):
-            raise ValueError(f"truncated frame file: {path}")
+            raise ValueError("truncated doc-frame blob")
         (length,) = struct.unpack_from(_LEN, data, pos)
         pos += 4
-        if length < 0 or pos + length > len(data):
-            raise ValueError(f"truncated frame file: {path}")
-        frames.append(data[pos : pos + length])
+        if pos + length > len(data):
+            raise ValueError("truncated doc-frame blob")
+        frames.append(data[pos:pos + length])
         pos += length
     return frames
 

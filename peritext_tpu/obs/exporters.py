@@ -16,6 +16,9 @@ Endpoints:
   snapshot (shape buckets, occupancy, memory watermarks)
 * ``/serve.json``   — the attached :class:`~..serve.SessionMux` snapshot
   (sessions, bounded-queue + typed-verdict state, autotuned round window)
+* ``/fleet.json``   — the attached :class:`~..serve.FleetFrontend` snapshot
+  (heartbeat-lease table, router placement, per-host serve summaries,
+  failover/migration tallies, fleet-wide verdict accounting)
 """
 
 from __future__ import annotations
@@ -48,6 +51,7 @@ def prometheus_text(
     convergence=None,
     devprof=None,
     serve=None,
+    fleet=None,
 ) -> str:
     """Prometheus text exposition of the process telemetry.  Counter names
     sanitize ``.`` → ``_`` under a ``peritext_`` prefix; histograms emit the
@@ -62,7 +66,10 @@ def prometheus_text(
     :class:`~..serve.SessionMux` lands as ``peritext_serve_*`` gauges
     (sessions, bounded-queue depth/peak, backpressure flag, autotuned
     window) plus the typed-verdict counters, with sheds labelled by
-    reason."""
+    reason; a :class:`~..serve.FleetFrontend` lands as
+    ``peritext_fleet_*`` gauges (host/lease counts, failover + migration
+    tallies, durable-state bookkeeping) plus the fleet-wide verdict
+    counters with sheds labelled by reason."""
     counters = counters or GLOBAL_COUNTERS
     histograms = histograms if histograms is not None else GLOBAL_HISTOGRAMS
     lines = []
@@ -230,6 +237,52 @@ def prometheus_text(
             quoted = (reason.replace("\\", "\\\\").replace('"', '\\"')
                       .replace("\n", "\\n"))
             lines.append(f'{m}{{reason="{quoted}"}} {_fmt(count)}')
+    if fleet is not None:
+        snap = fleet.snapshot()
+        leases = snap["leases"]["leases"]
+        live = sum(1 for rec in leases.values() if rec["verdict"] == "live")
+        dead = sum(1 for rec in leases.values() if rec["verdict"] == "dead")
+        for m, value in (
+            ("peritext_fleet_hosts", len(snap["hosts"])),
+            ("peritext_fleet_live_hosts", live),
+            ("peritext_fleet_dead_hosts", dead),
+            ("peritext_fleet_docs", len(snap["serving"])),
+            ("peritext_fleet_moving_docs", len(snap["moving"])),
+            ("peritext_fleet_failed_docs", len(snap["failed_docs"])),
+            ("peritext_fleet_rounds", snap["rounds"]),
+            ("peritext_fleet_journal_frames", snap["journal_frames"]),
+            ("peritext_fleet_checkpoint_docs", snap["checkpoint_docs"]),
+        ):
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {_fmt(value)}")
+        for m, value in (
+            ("peritext_fleet_failovers_total", snap["failovers"]),
+            ("peritext_fleet_failover_docs_total", snap["failover_docs"]),
+            ("peritext_fleet_migrations_total", snap["migrations"]),
+            ("peritext_fleet_migration_rollbacks_total",
+             snap["migration_rollbacks"]),
+            ("peritext_fleet_checkpoint_ships_total",
+             snap["checkpoint_ships"]),
+        ):
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {_fmt(value)}")
+        verdicts = snap["verdicts"]
+        for m, key in (
+            ("peritext_fleet_submitted_total", "submitted"),
+            ("peritext_fleet_admitted_total", "admitted"),
+            ("peritext_fleet_delayed_total", "delayed"),
+            ("peritext_fleet_shed_total", "shed"),
+        ):
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {_fmt(verdicts[key])}")
+        # by-reason family, own name (same no-double-count rationale as
+        # peritext_serve_shed_reason_total)
+        m = "peritext_fleet_shed_reason_total"
+        lines.append(f"# TYPE {m} counter")
+        for reason, count in verdicts["shed_reasons"].items():
+            quoted = (reason.replace("\\", "\\\\").replace('"', '\\"')
+                      .replace("\n", "\\n"))
+            lines.append(f'{m}{{reason="{quoted}"}} {_fmt(count)}')
     if session is not None:
         health = session.health()
         for key in sorted(health):
@@ -289,12 +342,13 @@ class MetricsServer:
         convergence=None,
         devprof=None,
         serve=None,
+        fleet=None,
     ) -> None:
         def metrics() -> str:
             return prometheus_text(
                 counters=counters, histograms=histograms,
                 session=session, sentinel=sentinel, convergence=convergence,
-                devprof=devprof, serve=serve,
+                devprof=devprof, serve=serve, fleet=fleet,
             )
 
         def snapshot() -> str:
@@ -303,6 +357,7 @@ class MetricsServer:
                     counters=counters, session=session, sentinel=sentinel,
                     histograms=histograms, recorder=recorder,
                     convergence=convergence, devprof=devprof, serve=serve,
+                    fleet=fleet,
                 ),
                 default=str,
             )
@@ -329,6 +384,11 @@ class MetricsServer:
         if serve is not None:
             routes["/serve.json"] = (
                 lambda: json.dumps(serve.snapshot()),
+                "application/json",
+            )
+        if fleet is not None:
+            routes["/fleet.json"] = (
+                lambda: json.dumps(fleet.snapshot()),
                 "application/json",
             )
         self._httpd = ThreadingHTTPServer((host, port), _Handler)

@@ -47,7 +47,7 @@ GLOBAL_COUNTERS = Counters()
 
 #: counter/histogram namespaces that make up the fault-domain health surface
 _HEALTH_PREFIXES = ("streaming.", "transport.", "supervisor.", "merge.",
-                    "jit.", "convergence.", "serve.")
+                    "jit.", "convergence.", "serve.", "fleet.")
 
 
 def health_snapshot(
@@ -59,6 +59,7 @@ def health_snapshot(
     convergence=None,
     devprof=None,
     serve=None,
+    fleet=None,
 ) -> Dict[str, Any]:
     """One structured dict for a fleet health endpoint: every fault-domain
     counter (quarantines, corrupt frames, transport retries / behind peers,
@@ -113,4 +114,6 @@ def health_snapshot(
         out["devprof"] = devprof.snapshot()
     if serve is not None:
         out["serve"] = serve.snapshot()
+    if fleet is not None:
+        out["fleet"] = fleet.snapshot()
     return out

@@ -82,6 +82,18 @@ MSG_CHANGES = b"C"
 #: error.  Old peers reject the unknown kind loudly (sync aborts, store
 #: untouched); small backlogs still ride "C" for full compatibility.
 MSG_CHANGES_MULTI = b"M"
+#: checkpoint-frame ship (the fleet tier's doc-state migration leg): body =
+#: 4-byte big-endian JSON-header length + header + packed frame blob
+#: (checkpoint.pack_doc_frames).  The header names the doc key and carries
+#: the sender's ``base`` (how many frames it believes the receiver already
+#: holds — the frame-count frontier of this anti-entropy-shaped exchange).
+#: A peer without a ship handler rejects the kind loudly; nothing about the
+#: frontier/changes exchange changes.
+MSG_SHIP = b"S"
+#: ship acknowledgement: JSON ``{"doc": key, "have": n}`` — the receiver's
+#: post-merge frame count, so the shipper can diff and re-ship a tail that
+#: landed while this leg was in flight (the catch-up round).
+MSG_SHIP_ACK = b"A"
 
 
 # -- retry policy ------------------------------------------------------------
@@ -360,6 +372,8 @@ class ReplicaServer:
         metrics_port: Optional[int] = None,
         monitor=None,
         serve=None,
+        on_ship: Optional[Callable[[str, List[bytes], int], int]] = None,
+        fleet=None,
     ) -> None:
         """``on_changes`` receives each batch of newly-merged decoded
         changes; ``on_frame`` receives the RAW inbound frame bytes whenever
@@ -383,12 +397,22 @@ class ReplicaServer:
         bound address is :attr:`metrics_address` after :meth:`start`;
         ``serve`` (a :class:`~..serve.SessionMux`) additionally mounts
         ``/serve.json`` and the ``peritext_serve_*`` gauges, so a serving
-        host's replica endpoint and serving telemetry share one scrape."""
+        host's replica endpoint and serving telemetry share one scrape;
+        ``fleet`` (a :class:`~..serve.fleet.FleetFrontend`) mounts
+        ``/fleet.json`` + the ``peritext_fleet_*`` gauges the same way.
+
+        ``on_ship`` is the checkpoint-ship receiver
+        ``(doc_key, frames, base) -> total frame count now held``: the
+        fleet tier's doc-state migration lands here (frames are
+        duplicate-tolerant, so a retried or overlapping ship is
+        idempotent).  Without a handler, MSG_SHIP connections are refused
+        loudly — this endpoint does not accept migrations."""
         from ..obs import ConvergenceMonitor
 
         self.store = store
         self.on_changes = on_changes
         self.on_frame = on_frame
+        self.on_ship = on_ship
         self.timeout = timeout
         self.tracer = tracer if tracer is not None else GLOBAL_TRACER
         self.recorder = recorder
@@ -418,6 +442,7 @@ class ReplicaServer:
                     # appear the moment an operator arms GLOBAL_DEVPROF
                     devprof=GLOBAL_DEVPROF,
                     serve=serve,
+                    fleet=fleet,
                 )
             except OSError:
                 # metrics port unavailable: release the already-bound
@@ -436,6 +461,15 @@ class ReplicaServer:
 
     def stop(self) -> None:
         self._stop.set()
+        # shutdown() BEFORE close(): closing a listening socket does not
+        # wake a thread already blocked in accept() on Linux — the accept
+        # loop would strand until the join timeout below (a flat 5 s per
+        # server teardown, multiplied across every test/chaos episode that
+        # builds a fleet).  shutdown() fails accept() immediately.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -487,11 +521,51 @@ class ReplicaServer:
             advertise_port=self.address[1], peer_name=peer_name,
         )
 
+    def _handle_ship(self, conn: socket.socket, body: bytes) -> None:
+        """One inbound checkpoint ship: parse the header + packed frames,
+        hand them to ``on_ship`` (which merges idempotently and returns the
+        doc's total frame count), and ack with that count — the shipper's
+        catch-up input."""
+        from ..checkpoint import unpack_doc_frames
+
+        if self.on_ship is None:
+            raise ConnectionError("this endpoint accepts no checkpoint ships")
+        try:
+            (hlen,) = _LEN.unpack(body[:_LEN.size])
+            header = json.loads(body[_LEN.size:_LEN.size + hlen])
+            doc_key = str(header["doc"])
+            base = int(header.get("base", 0))
+            frames = unpack_doc_frames(body[_LEN.size + hlen:])
+        except (ValueError, KeyError, TypeError, struct.error) as exc:
+            # struct.error (short body), KeyError/TypeError (header not a
+            # dict / missing "doc"), json/unpack ValueError: all must stay
+            # inside _serve_one's bad-peer guard — a malformed ship is a
+            # counted, swallowed protocol error, never a dead thread
+            raise DecodeError(f"malformed checkpoint ship: {exc!r}") from exc
+        with self.tracer.span(
+            "fleet.ship.receive", doc=doc_key, frames=len(frames),
+        ):
+            have = int(self.on_ship(doc_key, frames, base))
+        GLOBAL_COUNTERS.add("fleet.ship_frames_received", len(frames))
+        _send_message(
+            conn, MSG_SHIP_ACK,
+            json.dumps({"doc": doc_key, "have": have}).encode("utf-8"),
+        )
+
     def _serve_one(self, conn: socket.socket) -> None:
         try:
             with conn:
                 conn.settimeout(self.timeout)
-                peer_clock, meta = _parse_frontier(_expect(conn, MSG_FRONTIER))
+                kind, first = _recv_message(conn)
+                if kind == MSG_SHIP:
+                    self._handle_ship(conn, first)
+                    return
+                if kind != MSG_FRONTIER:
+                    raise ConnectionError(
+                        f"expected message {MSG_FRONTIER!r} or {MSG_SHIP!r}, "
+                        f"got {kind!r}"
+                    )
+                peer_clock, meta = _parse_frontier(first)
                 # peer attribution for the convergence monitor: a frontier
                 # that advertised the sender's replica port names a stable
                 # identity (peer IP + that port); bare clients (no replica
@@ -792,3 +866,69 @@ def try_sync_with(
             )
         return SyncOutcome(ok=False, error=str(exc))
     return SyncOutcome(pulled=pulled, pushed=pushed)
+
+
+# -- checkpoint ship (the fleet tier's doc-state migration leg) --------------
+
+
+def ship_frames(
+    host: str,
+    port: int,
+    doc_key: str,
+    frames: List[bytes],
+    base: int = 0,
+    retry: Optional[RetryPolicy] = None,
+    timeout: Optional[float] = None,
+    tracer=None,
+) -> int:
+    """Ship one doc's checkpoint frame history to a peer's ship endpoint
+    (``ReplicaServer(on_ship=...)``) and return the peer's post-merge frame
+    count — the frame-count frontier of this anti-entropy-shaped exchange,
+    which the caller diffs against its own history to ship the tail that
+    landed mid-move (the catch-up round).
+
+    Rides the SAME bounded-retry transport discipline as the anti-entropy
+    sync: per-socket deadlines (a stalled peer raises
+    :class:`TransportError`, never hangs), exponential backoff + jitter
+    between attempts.  Retrying is always safe: the receiver's merge is
+    idempotent (frames are duplicate-tolerant), so a ship that died after
+    partial delivery simply re-ships.  ``base`` advertises how many frames
+    the sender believes the receiver already holds — a fresh target gets 0,
+    a catch-up leg gets the previous ack's ``have``."""
+    from ..checkpoint import pack_doc_frames
+
+    policy = retry or NO_RETRY
+    deadline = timeout if timeout is not None else policy.timeout
+    tracer = tracer if tracer is not None else GLOBAL_TRACER
+    header = json.dumps({"doc": doc_key, "base": int(base)}).encode("utf-8")
+    body = _LEN.pack(len(header)) + header + pack_doc_frames(frames)
+    rng = random.Random()
+    last: Optional[BaseException] = None
+    for attempt in range(max(1, policy.attempts)):
+        if attempt:
+            GLOBAL_COUNTERS.add("transport.retries")
+            time.sleep(policy.delay(attempt - 1, rng))
+        try:
+            with tracer.span(
+                "fleet.ship", peer=f"{host}:{port}", doc=doc_key,
+                frames=len(frames),
+            ):
+                with socket.create_connection((host, port), timeout=deadline) as sock:
+                    sock.settimeout(deadline)
+                    _send_message(sock, MSG_SHIP, body)
+                    ack = json.loads(_expect(sock, MSG_SHIP_ACK))
+        except _RETRYABLE as exc:
+            last = exc
+            continue
+        if str(ack.get("doc")) != doc_key:
+            raise DecodeError(
+                f"ship ack names doc {ack.get('doc')!r}, shipped {doc_key!r}"
+            )
+        GLOBAL_COUNTERS.add("fleet.ship_frames_sent", len(frames))
+        return int(ack["have"])
+    if isinstance(last, ValueError) and not isinstance(last, OSError):
+        raise last
+    raise TransportError(
+        f"ship to {host}:{port} failed after {max(1, policy.attempts)} "
+        f"attempt(s): {last!r}"
+    ) from last

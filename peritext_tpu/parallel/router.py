@@ -127,6 +127,18 @@ class FleetRouter:
             raise ValueError(f"host {name!r} already registered")
         self._hosts[name] = HostSlot(name=name, capacity=int(capacity))
 
+    def remove_host(self, name: str) -> None:
+        """Deregister a host (the fleet's dead-host re-admission path).
+        Refuses while placements remain — a dead host's are forgotten by
+        :meth:`fail_host` first, so a refusal here means the caller is
+        removing a host that still serves docs."""
+        host = self._hosts[name]
+        if host.placed:
+            raise PlacementError(
+                f"host {name!r} still places {len(host.placed)} doc(s)"
+            )
+        del self._hosts[name]
+
     def hosts(self) -> List[str]:
         return sorted(self._hosts)
 
@@ -300,6 +312,61 @@ class FleetRouter:
             done.append((doc_key, size, bound))
             self.moves += 1
         return moves
+
+    def release(self, doc_key: str) -> None:
+        """Forget one doc's placement — the execution layer failed to
+        realize it (target mux out of slots) or the doc was deleted.  A
+        no-op for unplaced docs."""
+        if doc_key in self._doc_host:
+            self._unassign(doc_key)
+
+    def move(self, doc_key: str, to: str) -> None:
+        """Directed single-doc move (the execution layer's manual-migration
+        bookkeeping): re-assign ``doc_key`` to host ``to`` if it has room.
+        Raises :class:`PlacementError` without touching state otherwise."""
+        host = self._hosts[to]
+        if host.draining or host.docs >= host.capacity:
+            raise PlacementError(
+                f"host {to!r} cannot accept doc {doc_key!r}"
+            )
+        _, size, bound = self._unassign(doc_key)
+        self._assign(doc_key, host, size, bound)
+        self.moves += 1
+
+    def fail_host(self, name: str) -> List[Tuple[str, int, bool]]:
+        """A host DIED (heartbeat lease expired): its doc state is gone, so
+        — unlike :meth:`evacuate`, which plans moves of live state — its
+        placements are simply forgotten and returned for failover
+        re-placement from durable state (checkpoint + journal).  The host
+        stays registered and draining so a zombie coming back cannot
+        receive placements until it re-registers.  Returns
+        ``[(doc_key, size, host_bound), ...]`` in the evacuation scarcity
+        order (host-bound first, largest first, key tiebreak) — the order
+        failover re-placement should run in."""
+        host = self._hosts[name]
+        host.draining = True
+        order = sorted(
+            host.placed,
+            key=lambda dk: (dk not in host.bound_docs,
+                            -host.placed[dk], dk),
+        )
+        lost: List[Tuple[str, int, bool]] = []
+        for doc_key in order:
+            _, size, bound = self._unassign(doc_key)
+            lost.append((doc_key, size, bound))
+        return lost
+
+    def rollback_moves(self, moves: List[Tuple[str, str, str]]) -> None:
+        """Reverse an executed move plan (``[(doc_key, from, to), ...]``
+        from :meth:`evacuate` / :meth:`rebalance`), newest first — the
+        execution layer's atomic-cutover escape hatch: when a move plan's
+        PHYSICAL execution fails partway (a cutover digest mismatch), the
+        router's bookkeeping must return to the pre-plan placement so it
+        never disagrees with where doc state actually serves."""
+        for doc_key, from_host, _ in reversed(moves):
+            _, size, bound = self._unassign(doc_key)
+            self._assign(doc_key, self._hosts[from_host], size, bound)
+            self.moves -= 1
 
     def rebalance(self, max_moves: int = 8) -> List[Tuple[str, str, str]]:
         """Bounded greedy re-placement: while the most- and least-loaded

@@ -76,9 +76,13 @@ from ..ops.frames import (
 from ..schema import MARK_INDEX
 from ..ops.kernel import (
     apply_batch_jit,
+    apply_batch_staged_rounds,
     apply_batch_staged_rounds_jit,
+    apply_batch_stacked_rounds,
     apply_batch_stacked_rounds_jit,
     encoded_arrays_of,
+    resolve_insert_impl,
+    resolve_state_donation,
 )
 from ..ops.packed import PackedDocs, empty_docs
 from ..ops.resolve import resolve, resolve_jit
@@ -272,6 +276,84 @@ def _rows_digest_jit(
         sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
     )
     return per_doc, resolved.overflow
+
+
+# -- drain-end digest chaining (fused pipeline, round 14) --------------------
+#
+# The FINAL staged batch of a pipelined drain chains the resolve+digest
+# block program INTO its own donated program: the drain-end digest prefetch
+# used to pre-dispatch _resolve_block_digest_jit as a SEPARATE program right
+# after the final apply — one more dispatch than strictly needed per drain.
+# The chained twins below return (state, resolved, per_doc) from ONE
+# program; the dispatch seeds the per-round block cache with the result, so
+# digest() and the read paths find the round's resolution exactly as if the
+# separate prefetch had run (byte equality pinned in tests/test_fused.py).
+# Only the genuinely fused multi-round forms chain ("flat" staged tensors
+# and the static-rounds "stacked" form): the single-round "compact1"/
+# "static1" fallbacks exist precisely to SHARE compiled programs with the
+# per-round discipline, and welding a digest into them would mint the
+# variant back.
+
+
+def _staged_rounds_digest(
+    state, counts_all, ins_all, del_all, mark_all, map_all,
+    row_mask, sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
+    *, widths_seq, loop_slots_seq, ins_lens, del_lens, mark_lens, map_lens,
+    insert_impl, comment_capacity,
+):
+    state = apply_batch_staged_rounds(
+        state, counts_all, ins_all, del_all, mark_all, map_all,
+        widths_seq=widths_seq, loop_slots_seq=loop_slots_seq,
+        ins_lens=ins_lens, del_lens=del_lens, mark_lens=mark_lens,
+        map_lens=map_lens, insert_impl=insert_impl,
+    )
+    resolved = resolve(state, comment_capacity, with_comments=True)
+    per_doc = _per_doc_full_digest(
+        state, resolved, row_mask,
+        sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
+    )
+    return state, resolved, per_doc
+
+
+_STAGED_DIGEST_STATICS = (
+    "widths_seq", "loop_slots_seq", "ins_lens", "del_lens", "mark_lens",
+    "map_lens", "insert_impl", "comment_capacity",
+)
+_staged_rounds_digest_jit = jax.jit(
+    _staged_rounds_digest, static_argnames=_STAGED_DIGEST_STATICS,
+    donate_argnums=0,
+)
+_staged_rounds_digest_jit_nodonate = jax.jit(
+    _staged_rounds_digest, static_argnames=_STAGED_DIGEST_STATICS,
+)
+
+
+def _stacked_rounds_digest(
+    state, stacked,
+    row_mask, sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
+    *, loop_slots_seq, insert_impl, comment_capacity,
+):
+    state = apply_batch_stacked_rounds(
+        state, stacked, loop_slots_seq=loop_slots_seq,
+        insert_impl=insert_impl,
+    )
+    resolved = resolve(state, comment_capacity, with_comments=True)
+    per_doc = _per_doc_full_digest(
+        state, resolved, row_mask,
+        sess_attr, sess_key, comment_hash, row_map, obj_attr, obj_key,
+    )
+    return state, resolved, per_doc
+
+
+_STACKED_DIGEST_STATICS = ("loop_slots_seq", "insert_impl",
+                           "comment_capacity")
+_stacked_rounds_digest_jit = jax.jit(
+    _stacked_rounds_digest, static_argnames=_STACKED_DIGEST_STATICS,
+    donate_argnums=0,
+)
+_stacked_rounds_digest_jit_nodonate = jax.jit(
+    _stacked_rounds_digest, static_argnames=_STACKED_DIGEST_STATICS,
+)
 
 
 @partial(jax.jit, static_argnums=2)
@@ -1483,11 +1565,19 @@ class StreamingMerge:
             (counts_all, tuple(ins_all), del_all, mark_all, map_all)
         )
 
-    def _dispatch_fused_batch(self, batch, statics, inputs) -> None:
+    def _dispatch_fused_batch(self, batch, statics, inputs,
+                              chain_digest: bool = False) -> bool:
         """Dispatch half: ONE donated program applies the whole batch (the
         old state buffer is consumed in place), then the per-round digest
-        and round bookkeeping."""
+        and round bookkeeping.  With ``chain_digest`` (the drain's FINAL
+        batch, digest prefetch armed) the staged multi-round forms chain
+        the resolve+digest block program INTO the same dispatch and seed
+        the block cache with its result — returns True when that happened
+        (the drain then skips the separate prefetch dispatch)."""
         self._apply_blocks = None
+        if chain_digest and statics[0] in ("stacked", "flat"):
+            self._dispatch_fused_batch_digest(batch, statics, inputs)
+            return True
         if statics[0] == "compact1":
             from ..ops.kernel import apply_batch_compact_jit
 
@@ -1520,6 +1610,53 @@ class StreamingMerge:
             self._digest_row_valid[np.nonzero(enc.num_ops)[0]] = False
             self.rounds += 1
             GLOBAL_COUNTERS.add("streaming.rounds")
+        return False
+
+    def _dispatch_fused_batch_digest(self, batch, statics, inputs) -> None:
+        """The chain_digest arm of :meth:`_dispatch_fused_batch`: apply the
+        final staged batch AND the fused resolve+digest of the (single)
+        block in one program, then seed the per-round block cache with the
+        returned resolution — the drain-end digest costs zero extra
+        dispatches, and digest()/read paths behave exactly as after the
+        separate prefetch (same cache entry, same mask semantics)."""
+        on_device = self._block_fallback_mask(0)
+        digest_args = (jnp.asarray(on_device),
+                       *self._digest_tables(0, self._padded_docs))
+        insert_impl = resolve_insert_impl(self.state.elem_id)
+        donate = resolve_state_donation(self.state.elem_id)
+        if statics[0] == "stacked":
+            fn = (_stacked_rounds_digest_jit if donate
+                  else _stacked_rounds_digest_jit_nodonate)
+            args = (self.state, inputs, *digest_args)
+            kw = dict(loop_slots_seq=statics[1], insert_impl=insert_impl,
+                      comment_capacity=self.comment_capacity)
+        else:  # "flat"
+            _, loop_seq, widths_seq, ins_lens, del_lens, mark_lens, \
+                map_lens = statics
+            counts_all, ins_all, del_all, mark_all, map_all = inputs
+            fn = (_staged_rounds_digest_jit if donate
+                  else _staged_rounds_digest_jit_nodonate)
+            args = (self.state, counts_all, ins_all, del_all, mark_all,
+                    map_all, *digest_args)
+            kw = dict(widths_seq=widths_seq, loop_slots_seq=loop_seq,
+                      ins_lens=ins_lens, del_lens=del_lens,
+                      mark_lens=mark_lens, map_lens=map_lens,
+                      insert_impl=insert_impl,
+                      comment_capacity=self.comment_capacity)
+        if GLOBAL_DEVPROF.enabled:
+            note_jit_dispatch(
+                "_fused_rounds_digest" if statics[0] == "flat"
+                else "_stacked_rounds_digest", fn, args, kw,
+            )
+        self.state, resolved, digest_dev = fn(*args, **kw)
+        for enc, _ in batch:
+            self._digest_row_valid[np.nonzero(enc.num_ops)[0]] = False
+            self.rounds += 1
+            GLOBAL_COUNTERS.add("streaming.rounds")
+        entry = _BlockResolution(resolved, digest_dev, on_device)
+        self._resolved_cache = (self.rounds, {0: entry})
+        self._start_digest_readback(entry)
+        GLOBAL_COUNTERS.add("streaming.digest_chained")
 
     def _apply_compact(self, enc: _RoundBuffers, widths) -> PackedDocs:
         """Dispatch one round via kernel.apply_batch_compact_jit: the host
@@ -1828,13 +1965,21 @@ class StreamingMerge:
             return self._drain_serial(max_rounds)
         rounds = 0
         committed = False
+        chained = False
         pending = None  # (handle, batch, statics, scheduled, schedule_span)
         while True:
             batch, scheduled_total, ssp = self._schedule_batch(
                 rounds, max_rounds
             )
             if pending is not None:
-                self._commit_pending(pending)
+                # an empty schedule means the staged batch in flight is the
+                # drain's FINAL one: with the prefetch armed, its dispatch
+                # chains the resolve+digest into the same program (the
+                # staged forms), saving the separate prefetch dispatch
+                chained = self._commit_pending(
+                    pending,
+                    chain_digest=self.prefetch_digest and not batch,
+                )
                 committed = True
                 pending = None
             if not batch:
@@ -1845,23 +1990,30 @@ class StreamingMerge:
             )
             pending = (handle, batch, statics, scheduled_total, ssp)
             rounds += len(batch)
-        if committed and self.prefetch_digest:
+        if committed and self.prefetch_digest and not chained:
+            # single-round compat forms (compact1/static1) and the paged
+            # subclass keep the separate pre-dispatch
             self._prefetch_digest()
         self._sweep_decode_quarantine()
         return rounds
 
-    def _commit_pending(self, pending) -> None:
+    def _commit_pending(self, pending, chain_digest: bool = False) -> bool:
         """Land one staged batch: wait its staging handle (a staging fault
         surfaces HERE, inside whatever guard wraps the drain) and dispatch
-        the donated program."""
+        the donated program.  ``chain_digest`` marks the drain's final
+        batch with the digest prefetch armed; returns whether the dispatch
+        actually chained the resolve+digest in."""
         handle, batch, statics, scheduled, ssp = pending
         with self.tracer.span("streaming.apply", rounds=len(batch)) as asp:
             inputs = handle.wait()
-            self._dispatch_fused_batch(batch, statics, inputs)
+            chained = bool(self._dispatch_fused_batch(
+                batch, statics, inputs, chain_digest=chain_digest,
+            ))
         self._emit_round_stats(
             batch, scheduled, ssp.duration, asp.duration,
             origin="streaming.fused",
         )
+        return chained
 
     def _ensure_stager(self):
         """The session's staging lane (lazy; respawned if closed)."""
@@ -2744,6 +2896,33 @@ class StreamingMerge:
                 part = (part + _doc_full_extras_host(doc, slots, self._actor_table)) & 0xFFFFFFFF
             total = (total + part) & 0xFFFFFFFF
         return total
+
+    def doc_digest(self, doc_index: int) -> int:
+        """ONE doc's full-state convergence hash — exactly the per-doc term
+        :meth:`digest` sums (device rows read the carried per-row hash
+        plane; fallback/overflowed docs hash host-side with the
+        bit-identical formula), so ``sum(doc_digest(i)) mod 2^32 ==
+        digest()`` on an all-real-doc session (pinned by test).
+
+        Interned identities fold as content hashes, so two SESSIONS that
+        interned attrs/keys in different orders still agree per doc — this
+        is the fleet tier's migration-cutover oracle: a doc shipped to a
+        new host must hash byte-equal there before the old slot is
+        released."""
+        from .mesh import doc_digest_host
+
+        sess = self.docs[doc_index]
+        if not sess.fallback:
+            on_device_all = self._refresh_digest_rows()
+            row = int(self._row_of[doc_index])
+            if on_device_all[row] and not self._digest_ov[row]:
+                return int(self._digest_plane[row])
+        doc = _replay_doc(self._replay_changes(sess))
+        cps, slots = _doc_char_slots(doc)
+        part = doc_digest_host(cps, slots, self._slot_capacity)
+        return (part + _doc_full_extras_host(
+            doc, slots, self._actor_table
+        )) & 0xFFFFFFFF
 
     def digest_async(self) -> "_PendingDigest":
         """Schedule the full-state convergence digest WITHOUT synchronizing:

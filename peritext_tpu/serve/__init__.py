@@ -44,13 +44,17 @@ from .admission import (
     SHED,
     SHED_CAPACITY,
     SHED_DEGRADED,
+    SHED_FAILOVER,
     SHED_OVERLOAD,
     SHED_QUEUE_FULL,
     SHED_REASONS,
     SHED_SESSION_QUOTA,
+    SHED_UNAUTHORIZED,
     SHED_UNKNOWN_SESSION,
     Verdict,
 )
+from .auth import AuthError, SessionKeyring
+from .fleet import CutoverError, FleetFrontend, FleetHost, FleetStats, HostDown
 from .mux import BatchWindowTuner, SessionMux
 from .traffic import (
     LadderRung,
@@ -63,18 +67,27 @@ from .traffic import (
 __all__ = [
     "ADMIT",
     "AdmissionController",
+    "AuthError",
     "BatchWindowTuner",
+    "CutoverError",
     "DELAY",
+    "FleetFrontend",
+    "FleetHost",
+    "FleetStats",
+    "HostDown",
     "LadderRung",
     "OpenLoopResult",
     "SHED",
     "SHED_CAPACITY",
     "SHED_DEGRADED",
+    "SHED_FAILOVER",
     "SHED_OVERLOAD",
     "SHED_QUEUE_FULL",
     "SHED_REASONS",
     "SHED_SESSION_QUOTA",
+    "SHED_UNAUTHORIZED",
     "SHED_UNKNOWN_SESSION",
+    "SessionKeyring",
     "SessionMux",
     "Verdict",
     "build_arrivals",

@@ -57,6 +57,15 @@ SHED_CAPACITY = "capacity"
 #: the session's doc has been demoted off the device path AND its scalar
 #: backlog is saturated too — the ladder's last rung still answers typed
 SHED_DEGRADED = "degraded"
+#: the doc's serving host died and failover could not (yet) re-place it —
+#: the fleet tier's typed answer while durable state is being re-homed, or
+#: terminally when no live host has capacity.  Ops shed here are retryable:
+#: nothing about the doc's durable state was lost (checkpoint + journal)
+SHED_FAILOVER = "failover"
+#: per-session wire auth: the submission carried a missing/bad HMAC session
+#: token (serve/auth.SessionKeyring) — rejected AT admission, before any
+#: queue space or doc slot is touched
+SHED_UNAUTHORIZED = "unauthorized"
 
 SHED_REASONS = (
     SHED_QUEUE_FULL,
@@ -65,6 +74,8 @@ SHED_REASONS = (
     SHED_UNKNOWN_SESSION,
     SHED_CAPACITY,
     SHED_DEGRADED,
+    SHED_FAILOVER,
+    SHED_UNAUTHORIZED,
 )
 
 
@@ -242,6 +253,20 @@ class AdmissionController:
         with self._lock:
             self.stats.submitted += 1
             return self._shed_locked(reason, self._depth)
+
+    def delay_out_of_band(self, hint_seconds: float = 0.05) -> Verdict:
+        """Record a typed delay decided OUTSIDE the queue logic — the fleet
+        tier's "this doc is mid-failover/mid-cutover, retry shortly"
+        verdict.  Counts as a submission so the zero-silent-drops identity
+        covers it, exactly like :meth:`shed_out_of_band`."""
+        with self._lock:
+            self.stats.submitted += 1
+            self.stats.delayed += 1
+            self.counters.add("serve.delayed")
+            return Verdict(
+                kind=DELAY, hint_seconds=float(hint_seconds),
+                queue_depth=self._depth,
+            )
 
     def _shed_locked(self, reason: str, depth: int) -> Verdict:
         self.stats.shed += 1

@@ -53,6 +53,7 @@ from .admission import (
     SHED,
     SHED_CAPACITY,
     SHED_SESSION_QUOTA,
+    SHED_UNAUTHORIZED,
     SHED_UNKNOWN_SESSION,
     Verdict,
 )
@@ -164,10 +165,21 @@ class SessionMux:
         clock: Callable[[], float] = time.monotonic,
         counters: Optional[Counters] = None,
         host: str = "local",
+        auth=None,
+        auth_per_frame: bool = False,
     ) -> None:
         self.session = session
         self.admission = admission if admission is not None else AdmissionController()
         self.tuner = tuner if tuner is not None else BatchWindowTuner()
+        #: per-session wire auth (serve/auth.SessionKeyring): when set,
+        #: open_session requires a valid HMAC token for the client name —
+        #: bad/missing tokens shed typed ``unauthorized`` BEFORE any slot
+        #: or queue space is touched.  ``auth_per_frame`` additionally
+        #: re-verifies the token on every submit (bearer-session-id alone
+        #: stops being enough).  None (default) = open tier, exactly the
+        #: pre-auth behavior.
+        self.auth = auth
+        self.auth_per_frame = bool(auth_per_frame)
         self.degrade_after = int(degrade_after)
         self.clock = clock
         self.counters = counters if counters is not None else GLOBAL_COUNTERS
@@ -195,11 +207,17 @@ class SessionMux:
 
     # -- session lifecycle ----------------------------------------------------
 
-    def open_session(self, client: str) -> Tuple[Optional[int], Verdict]:
+    def open_session(self, client: str,
+                     token: Optional[str] = None) -> Tuple[Optional[int], Verdict]:
         """Claim a doc slot for a new client session.  Returns
         ``(session_id, verdict)`` — ``session_id`` is None when the slot
         budget is exhausted (typed ``capacity`` shed; the fleet router's
-        cue to place the doc on another host)."""
+        cue to place the doc on another host) or, on an auth-enabled mux,
+        when ``token`` fails HMAC verification for ``client`` (typed
+        ``unauthorized`` shed — checked FIRST, so an unauthorized probe
+        never learns whether capacity exists)."""
+        if self.auth is not None and not self.auth.verify(client, token):
+            return None, self.admission.shed_out_of_band(SHED_UNAUTHORIZED)
         if self._next_doc >= self.session.num_docs:
             return None, self.admission.shed_out_of_band(SHED_CAPACITY)
         sid = self._next_session
@@ -223,16 +241,24 @@ class SessionMux:
 
     # -- the ingest surface ---------------------------------------------------
 
-    def submit(self, session_id: int, frame: bytes) -> Verdict:
+    def submit(self, session_id: int, frame: bytes,
+               token: Optional[str] = None) -> Verdict:
         """Submit one wire frame for a session's doc.  ``admit`` buffers it
         into the open round; ``delay``/``shed`` buffer nothing and the
         client owns the retry.  A degraded session's frames are ingested
         IMMEDIATELY on admit (scalar fallback replays host-side; holding
         them for the device window would only add latency to a path that
-        no longer batches)."""
+        no longer batches).  On an ``auth_per_frame`` mux every submit
+        must re-present the session's token (sheds ``unauthorized``
+        otherwise)."""
         sess = self._sessions.get(session_id)
         if sess is None or sess.closed:
             return self.admission.shed_out_of_band(SHED_UNKNOWN_SESSION)
+        if (self.auth is not None and self.auth_per_frame
+                and not self.auth.verify(sess.client, token)):
+            sess.submitted += 1
+            sess.shed += 1
+            return self.admission.shed_out_of_band(SHED_UNAUTHORIZED)
         sess.submitted += 1
         verdict = self.admission.offer(
             session_id, cost=1, degraded=sess.degraded
@@ -265,11 +291,13 @@ class SessionMux:
         return verdict
 
     def submit_changes(self, session_id: int,
-                       changes: Sequence[Change]) -> Verdict:
+                       changes: Sequence[Change],
+                       token: Optional[str] = None) -> Verdict:
         """The object-boundary form of :meth:`submit`: a batch of
         ``Change`` objects (what ``bridge.Editor.dispatch_input_ops``
         mints from ``InputOperation`` dicts) submitted as one frame."""
-        return self.submit(session_id, encode_frame(list(changes)))
+        return self.submit(session_id, encode_frame(list(changes)),
+                           token=token)
 
     def _degrade(self, sess: ClientSession) -> None:
         """The quarantine/fallback rung for a hot session: sustained quota
@@ -380,6 +408,32 @@ class SessionMux:
 
     # -- health ---------------------------------------------------------------
 
+    def load_report(self) -> Dict:
+        """This host's load along the router's placement dimensions
+        (``FleetRouter.observe`` keyword-compatible): device slot load of
+        on-device docs, host-bound (scalar-replay) load of fallback docs,
+        and — on a paged session — the pool page load.  Rides inside
+        :meth:`snapshot` so the fleet frontend ingests it through the SAME
+        ``/serve.json`` surface an operator scrapes."""
+        sizes = self.session._reshard_sizes()
+        slot_load = 0
+        host_bound = 0
+        for d in range(self._next_doc):
+            size = int(sizes[d]) if d < len(sizes) else 0
+            if self.session.docs[d].fallback:
+                host_bound += size
+            else:
+                slot_load += size
+        report = {
+            "slot_load": slot_load,
+            "host_bound_load": host_bound,
+            "docs": self._next_doc,
+        }
+        pool = getattr(self.session, "store", None)
+        if pool is not None:
+            report["page_load"] = int(pool.pool_stats()["pages_in_use"])
+        return report
+
     @property
     def overloaded(self) -> bool:
         """Sustained-overload flag: backpressure currently engaged, or the
@@ -415,6 +469,7 @@ class SessionMux:
             "recent_sheds": max(
                 0, self.admission.stats.shed - self._shed_mark
             ),
+            "load": self.load_report(),
             "queue": self.admission.snapshot(),
             "window": self.tuner.snapshot(),
             "session_table": {
@@ -425,4 +480,9 @@ class SessionMux:
         pool = getattr(self.session, "store", None)
         if pool is not None:
             snap["page_pool"] = pool.pool_stats()
+        if self.auth is not None:
+            snap["auth"] = {
+                **self.auth.snapshot(),
+                "per_frame": self.auth_per_frame,
+            }
         return snap

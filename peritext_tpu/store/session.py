@@ -291,9 +291,13 @@ class PagedStreamingMerge(StreamingMerge):
                 )
         return jax.device_put(tuple(group_inputs))
 
-    def _dispatch_fused_batch(self, batch, statics, inputs) -> None:
+    def _dispatch_fused_batch(self, batch, statics, inputs,
+                              chain_digest: bool = False) -> bool:
         """Dispatch the donated group chain + per-round bookkeeping and
-        the fused-site occupancy telemetry."""
+        the fused-site occupancy telemetry.  ``chain_digest`` is accepted
+        for drain-loop compatibility but never chains here (returns
+        False): a paged digest twin of the group-chain program is an open
+        rung — the drain keeps the separate prefetch dispatch instead."""
         from ..ops.kernel import apply_batch_paged_groups_jit
 
         from ..ops.kernel import (
@@ -340,6 +344,7 @@ class PagedStreamingMerge(StreamingMerge):
             GLOBAL_COUNTERS.add("streaming.rounds")
         if GLOBAL_DEVPROF.enabled:
             GLOBAL_DEVPROF.observe_page_pool(self._store.pool_stats())
+        return False
 
     def _emit_round_stats(self, batch, scheduled: int,
                           schedule_s: float, apply_s: float,

@@ -143,13 +143,17 @@ def run_chaos(
     transport: bool = True,
     crash: bool = True,
     checkpoint_every: int = 4,
+    workload_gen=generate_workload,
 ) -> ChaosReport:
     """One seeded chaos campaign (see module docstring).  Raises on any
-    oracle violation or unhandled fault; returns the evidence report."""
+    oracle violation or unhandled fault; returns the evidence report.
+    ``workload_gen`` selects the workload family (same change-log shape;
+    e.g. ``generate_markheavy_workload`` for the editorial-pass family —
+    see :func:`run_markheavy_chaos`)."""
     rng = random.Random(seed ^ 0xC4A05)
     report = ChaosReport(seed=seed, num_docs=num_docs)
 
-    workloads = generate_workload(seed, num_docs=num_docs, ops_per_doc=ops_per_doc)
+    workloads = workload_gen(seed, num_docs=num_docs, ops_per_doc=ops_per_doc)
     oracle_docs = [_oracle_doc(w) for w in workloads]
 
     # fault-free reference session: the byte-equality digest anchor
@@ -388,6 +392,12 @@ class _LinkGate:
 
     def close(self) -> None:
         self._stop = True
+        # shutdown() wakes a thread blocked in accept() (close() alone does
+        # not on Linux) so the proxy thread exits instead of lingering
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -1303,11 +1313,11 @@ def run_fused_drain_kill(seed: int, checkpoint_root=None) -> Dict:
         orig_dispatch = sess._dispatch_fused_batch
         calls = {"n": 0}
 
-        def killer(batch, statics, inputs):
+        def killer(batch, statics, inputs, **kw):
             calls["n"] += 1
             if calls["n"] == 2:
                 raise RuntimeError("chaos: device died mid-fuse")
-            return orig_dispatch(batch, statics, inputs)
+            return orig_dispatch(batch, statics, inputs, **kw)
 
         sess._dispatch_fused_batch = killer
         rolled = guarded.drain()
@@ -1335,6 +1345,305 @@ def run_fused_drain_kill(seed: int, checkpoint_root=None) -> Dict:
     finally:
         if tmp is not None:
             tmp.cleanup()
+
+
+def run_markheavy_chaos(seed: int, num_docs: int = 4,
+                        ops_per_doc: int = 36, **kw) -> ChaosReport:
+    """The mark-heavy editorial-pass chaos schedule (ROADMAP scenario
+    diversity): the full composed-fault campaign of :func:`run_chaos` —
+    delivery faults, detectable corruption + quarantine, injected device
+    rounds, crash-restore — run over the span-overlap-explosion workload
+    family, against the same byte-equality oracle.  The same workload is
+    the ``markheavy`` bench row (bench.py --mode markheavy)."""
+    from .fuzz import generate_markheavy_workload
+
+    return run_chaos(
+        seed, num_docs=num_docs, ops_per_doc=ops_per_doc,
+        workload_gen=generate_markheavy_workload, **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Live fleet failover: kill a serving host mid-traffic (ISSUE 10 acceptance)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class HostKillReport:
+    """Evidence from one host-kill failover episode (all oracles already
+    held — a violated oracle raises instead of returning)."""
+
+    seed: int
+    hosts: int
+    num_docs: int
+    victim: str = ""
+    victim_docs: int = 0
+    offered: int = 0
+    admitted: int = 0
+    delayed: int = 0
+    shed: int = 0
+    shed_reasons: Dict[str, int] = None
+    #: frontend rounds between the kill and the lease's dead verdict
+    detection_rounds: int = 0
+    failovers: int = 0
+    failover_docs: int = 0
+    #: frames acked (admitted) for victim docs at the instant of the kill
+    acked_at_kill: int = 0
+    acked_survived: bool = False
+    redelivered: bool = False
+    converged: bool = False
+    final_digest: int = 0
+    flight_dumps: int = 0
+    traffic_seconds: float = 0.0
+    applied_frames: int = 0
+
+    def to_json(self) -> Dict:
+        return asdict(self)
+
+
+def run_host_kill_failover(
+    seed: int,
+    hosts: int = 3,
+    num_docs: int = 6,
+    ops_per_doc: int = 24,
+    lease_rounds: int = 2,
+    transport: bool = True,
+    dump_dir=None,
+) -> HostKillReport:
+    """Kill a serving host mid-traffic and prove the fleet survives it.
+
+    A ≥3-host :class:`~..serve.FleetFrontend` places ``num_docs`` docs via
+    the router and carries round-robin client traffic; mid-traffic, one
+    host that serves docs is KILLED (mux dead, ship endpoint closed,
+    heartbeats stop).  Oracles, per the ISSUE-10 acceptance criteria:
+
+    * **typed verdicts only** — every submission after the kill still gets
+      a typed verdict (``delay`` while the lease drains / failover runs,
+      ``shed(failover)`` only if re-placement fails), every shed reason is
+      in ``SHED_REASONS``, and the fleet-wide accounting identity
+      ``submitted == admitted + delayed + shed`` holds — zero silent drops;
+    * **every acked op survives** — immediately after failover (before any
+      client retry) each victim doc's state on its NEW host byte-equals a
+      reference session fed exactly the frames that were ACKED at kill
+      time (the checkpoint ∪ journal invariant);
+    * **post-heal byte equality** — after client retries redeliver
+      everything, every doc's full-state hash equals a fault-free
+      reference run's, and the fleet-wide digest (doc-hash sum) equals the
+      fault-free session digest bit-for-bit;
+    * **failover timeline dumped** — the flight recorder produced
+      host-death and failover-complete dumps that parse (when
+      ``dump_dir``).
+
+    Raises on any violation; returns the evidence report."""
+    from ..obs import FlightRecorder
+    from ..serve import (
+        AdmissionController, FleetFrontend, SHED_REASONS, SessionMux,
+    )
+    from .fuzz import generate_workload
+
+    rng = random.Random(seed ^ 0xFA170)
+    assert hosts >= 3, "the acceptance episode needs a >=3-host fleet"
+    report = HostKillReport(seed=seed, hosts=hosts, num_docs=num_docs)
+
+    recorder = (
+        FlightRecorder(capacity=256, dump_dir=Path(dump_dir),
+                       min_dump_interval=0.0)
+        if dump_dir is not None else None
+    )
+
+    def make_mux():
+        return SessionMux(
+            _serve_session(max(4, num_docs), ops_per_doc),
+            admission=AdmissionController(max_depth=128, session_quota=None),
+        )
+
+    fe = FleetFrontend(lease_rounds=lease_rounds, checkpoint_every=2,
+                       recorder=recorder)
+    for i in range(hosts):
+        fe.add_host(f"host{i}", make_mux(), transport=transport)
+
+    workloads = generate_workload(seed, num_docs=num_docs,
+                                  ops_per_doc=ops_per_doc)
+    plans: Dict[str, List[bytes]] = {}
+    for d, w in enumerate(workloads):
+        changes = [ch for log in sorted(w) for ch in w[log]]
+        rng.shuffle(changes)
+        chunk = rng.randrange(4, 8)
+        plans[f"doc{d}"] = [
+            encode_frame(changes[i:i + chunk])
+            for i in range(0, len(changes), chunk)
+        ]
+        verdict = fe.open_doc(f"doc{d}", f"client{d}")
+        assert verdict.admitted, verdict
+
+    acked: Dict[str, List[bytes]] = {k: [] for k in plans}
+    pending: Dict[str, List[bytes]] = {k: list(v) for k, v in plans.items()}
+    keys = sorted(plans)
+
+    try:
+        t0 = time.perf_counter()
+        # -- phase A: traffic, with the kill landing mid-way ----------------
+        total_frames = sum(len(v) for v in plans.values())
+        kill_after = max(2, int(0.4 * total_frames))
+        submitted = 0
+        killed = False
+        victim = None
+        kill_round = 0
+        while any(pending.values()):
+            for k in keys:
+                if not pending[k]:
+                    continue
+                verdict = fe.submit(k, pending[k][0])
+                submitted += 1
+                assert verdict.kind in ("admit", "delay", "shed"), verdict
+                if verdict.kind == "admit":
+                    acked[k].append(pending[k].pop(0))
+                elif verdict.kind == "shed":
+                    assert verdict.reason in SHED_REASONS, verdict
+                if not killed and submitted >= kill_after:
+                    # kill a host that actually serves docs, mid-traffic
+                    serving_hosts = sorted(set(fe._serving.values()))
+                    victim = serving_hosts[rng.randrange(len(serving_hosts))]
+                    victim_docs = [
+                        dk for dk, h in sorted(fe._serving.items())
+                        if h == victim
+                    ]
+                    assert victim_docs, "victim must hold docs"
+                    report.victim = victim
+                    report.victim_docs = len(victim_docs)
+                    report.acked_at_kill = sum(
+                        len(acked[dk]) for dk in victim_docs
+                    )
+                    acked_at_kill = {dk: list(acked[dk])
+                                     for dk in victim_docs}
+                    fe.hosts[victim].kill()
+                    kill_round = fe.rounds
+                    killed = True
+                    # the very next submission to a victim doc must answer
+                    # TYPED (delay: the lease has not expired yet)
+                    probe = fe.submit(victim_docs[0],
+                                      plans[victim_docs[0]][0])
+                    assert probe.kind in ("delay", "shed"), probe
+            fe.round()
+            if killed and not any(pending.values()):
+                break
+            if fe.rounds > 200:
+                raise AssertionError("traffic loop wedged")
+        # drive the lease to the dead verdict + failover
+        while victim not in fe.ledger.dead_hosts():
+            fe.round()
+            assert fe.rounds - kill_round <= 2 * lease_rounds + 2, (
+                "lease never expired"
+            )
+        report.detection_rounds = fe.rounds - kill_round
+        assert fe.failovers == 1, fe.failovers
+        report.failovers = fe.failovers
+        report.failover_docs = fe.failover_docs
+        assert fe.failover_docs == report.victim_docs, (
+            f"seed={seed}: {report.victim_docs} docs on {victim}, only "
+            f"{fe.failover_docs} re-placed"
+        )
+        for dk in acked_at_kill:
+            new_host = fe._serving[dk]
+            assert new_host != victim and fe.hosts[new_host].alive, (
+                f"doc {dk} not re-placed off the dead host"
+            )
+
+        # -- acked-op survival (before any client retry) --------------------
+        # every frame EVER acked for a victim doc — the pre-kill set (which
+        # only survived via checkpoint + journal redelivery) plus anything
+        # admitted on the new host after failover — must be reflected in
+        # the re-homed doc's state, byte-for-byte
+        for dk in acked_at_kill:
+            assert acked[dk][:len(acked_at_kill[dk])] == acked_at_kill[dk]
+            ref = _serve_session(1, ops_per_doc)
+            for f in acked[dk]:
+                ref.ingest_frame(0, f)
+            ref.drain()
+            got = fe.doc_digest(dk)
+            want = ref.doc_digest(0)
+            assert got == want, (
+                f"seed={seed} doc={dk}: acked ops lost in failover "
+                f"({got:#010x} != {want:#010x})"
+            )
+        report.acked_survived = True
+
+        # -- phase B: client retries redeliver EVERYTHING -------------------
+        for attempt in range(80):
+            dirty = False
+            for k in keys:
+                # shed/delayed frames retry; redelivery of acked frames is
+                # harmless (duplicate-tolerant), so retry the whole plan
+                for f in plans[k]:
+                    verdict = fe.submit(k, f)
+                    assert verdict.kind in ("admit", "delay", "shed"), verdict
+                    if verdict.kind != "admit":
+                        dirty = True
+            fe.round()
+            if not dirty:
+                break
+        else:
+            raise AssertionError("redelivery never fully admitted")
+        fe.flush()
+        report.redelivered = True
+        report.traffic_seconds = time.perf_counter() - t0
+
+        # -- fleet-wide byte equality vs the fault-free reference -----------
+        clean = _serve_session(num_docs, ops_per_doc)
+        for d in range(num_docs):
+            for f in plans[f"doc{d}"]:
+                clean.ingest_frame(d, f)
+        clean.drain()
+        total = 0
+        for d in range(num_docs):
+            got = fe.doc_digest(f"doc{d}")
+            want = clean.doc_digest(d)
+            assert got == want, (
+                f"seed={seed} doc=doc{d}: post-heal digest {got:#010x} != "
+                f"fault-free {want:#010x}"
+            )
+            total = (total + got) & 0xFFFFFFFF
+        assert total == clean.digest(), (
+            f"seed={seed}: fleet-wide digest {total:#010x} != fault-free "
+            f"session digest {clean.digest():#010x}"
+        )
+        report.converged = True
+        report.final_digest = total
+
+        # -- accounting identity + applied tally ----------------------------
+        assert fe.stats.accounted(), fe.stats.to_json()
+        stats = fe.stats
+        report.offered = stats.submitted
+        report.admitted = stats.admitted
+        report.delayed = stats.delayed
+        report.shed = stats.shed
+        report.shed_reasons = dict(sorted(stats.shed_reasons.items()))
+        assert stats.delayed + stats.shed > 0, (
+            "the kill produced no delay/shed evidence — it landed too late"
+        )
+        report.applied_frames = sum(
+            h.mux.applied for h in fe.hosts.values()
+        )
+
+        # -- flight-recorder timeline ---------------------------------------
+        if recorder is not None:
+            dumps = sorted(Path(dump_dir).glob("*.jsonl"))
+            assert dumps, "host death produced no flight dump"
+            records = []
+            for dump in dumps:
+                records.extend(
+                    json.loads(line)
+                    for line in dump.read_text().splitlines() if line
+                )
+            reasons = {r.get("reason") for r in records
+                       if r.get("kind") == "fault"}
+            assert {"host-death", "failover-complete"} <= reasons, (
+                f"failover timeline incomplete: {sorted(reasons)}"
+            )
+            report.flight_dumps = len(dumps)
+    finally:
+        fe.stop()
+    return report
 
 
 def run_campaign(

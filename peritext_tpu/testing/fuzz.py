@@ -105,8 +105,51 @@ def random_input_op(state: FuzzState, doc: Doc) -> Optional[InputOperation]:
     return op
 
 
+def markheavy_input_op(state: FuzzState, doc: Doc) -> Optional[InputOperation]:
+    """A mark-heavy editorial-pass op (ROADMAP scenario family): mostly
+    ``addMark``/``removeMark`` over LONG spans drawn across the whole doc,
+    so span overlap explodes — every mark lands on text most other marks
+    also cover, which is the worst case for mark resolution (the reference's
+    span-splitting pressure) and for the device aux tables.  A thin stream
+    of inserts keeps the substrate growing so spans always have room."""
+    rng = state.rng
+    length = len(doc.root["text"])
+    if length < 12 or rng.random() > 0.85:
+        index = rng.randint(0, length)
+        count = rng.randint(2, 6)
+        values = [rng.choice(string.ascii_lowercase) for _ in range(count)]
+        return {"path": ["text"], "action": "insert", "index": index,
+                "values": values}
+    # long overlapping spans: start anywhere, reach up to half the doc
+    start = rng.randrange(length)
+    end = rng.randint(start + 1, min(length, start + max(2, length // 2)))
+    kind = "addMark" if rng.random() < 0.7 else "removeMark"
+    mark_type = rng.choice(MARK_TYPES)
+    op: InputOperation = {
+        "path": ["text"],
+        "action": kind,
+        "startIndex": start,
+        "endIndex": end,
+        "markType": mark_type,
+    }
+    if mark_type == "link":
+        if kind == "addMark":
+            op["attrs"] = {"url": rng.choice(EXAMPLE_URLS)}
+    elif mark_type == "comment":
+        if kind == "addMark":
+            cid = f"comment-{rng.randrange(1 << 16):04x}"
+            state.comment_history.append(cid)
+            op["attrs"] = {"id": cid}
+        else:
+            if not state.comment_history:
+                return None
+            op["attrs"] = {"id": rng.choice(state.comment_history)}
+    return op
+
+
 def fuzz_step(
-    state: FuzzState, check: bool = True, faults: Optional[FaultSpec] = None
+    state: FuzzState, check: bool = True, faults: Optional[FaultSpec] = None,
+    op_fn=random_input_op,
 ) -> None:
     """One fuzz iteration: a random edit on a random replica, then a random
     pairwise sync with convergence checks.
@@ -121,7 +164,7 @@ def fuzz_step(
     target = rng.randrange(len(state.docs))
     doc = state.docs[target]
 
-    input_op = random_input_op(state, doc)
+    input_op = op_fn(state, doc)
     if input_op is not None:
         change, patches = doc.change([input_op])
         state.store.append(change)
@@ -201,6 +244,29 @@ def generate_workload(
         state = make_fuzz_state(seed + d, num_replicas)
         while state.ops_generated < ops_per_doc:
             fuzz_step(state, check=False)
+        workloads.append(
+            {actor: list(state.store.log(actor)) for actor in state.store.actors()}
+        )
+    return workloads
+
+
+def generate_markheavy_workload(
+    seed: int, num_docs: int, ops_per_doc: int, num_replicas: int = 3
+) -> List[Dict[str, List[Change]]]:
+    """The mark-heavy editorial-pass workload family
+    (:func:`markheavy_input_op`): same change-log shape as
+    :func:`generate_workload`, so every consumer — the ``markheavy`` bench
+    row, the chaos schedule, the scalar-oracle byte-equality check —
+    composes unchanged.  Seeds are offset so a campaign running both
+    families on the same seed never correlates their randomness."""
+    workloads = []
+    for d in range(num_docs):
+        # +1 keeps the offset non-degenerate at seed=0 (seed*7919+d alone
+        # collapses to generate_workload's own per-doc seeds there)
+        state = make_fuzz_state((seed * 7919) + d + 1, num_replicas,
+                                initial_text="ABCDEFGHIJ")
+        while state.ops_generated < ops_per_doc:
+            fuzz_step(state, check=False, op_fn=markheavy_input_op)
         workloads.append(
             {actor: list(state.store.log(actor)) for actor in state.store.actors()}
         )

@@ -1,0 +1,27 @@
+"""graftlint fixture: the host-death-detection mistake PTL006 exists for.
+
+A heartbeat lease (parallel/lease.py) must be ROUND-counted: the death
+verdict is a deterministic function of the observed beat sequence, so two
+frontends that saw the same beats agree on the same verdict at the same
+tick — otherwise they re-place the same doc onto different hosts
+(split-brain placement).  The tempting bug is stamping the lease with a
+wall-clock read ("expired if now - last_beat > ttl"), which makes the
+verdict replica-local.  This file is the TRUE POSITIVE proving the rule
+fires on exactly that; never "fix" it.
+"""
+
+import time
+
+
+class WallClockLease:
+    def __init__(self, ttl):
+        self.ttl = ttl
+        self._last_beat = {}
+
+    def beat(self, host):
+        # PTL006: wall-clock lease stamp inside a merge-scope verdict path
+        self._last_beat[host] = time.monotonic()
+
+    def dead(self, host):
+        # the verdict now depends on WHICH replica asks, and WHEN
+        return time.monotonic() - self._last_beat[host] > self.ttl

@@ -573,7 +573,8 @@ class TestGuardedMergeAndHealth:
         assert "counters" in snap
         assert all(
             k.split(".")[0] in ("streaming", "transport", "supervisor",
-                                "merge", "convergence", "serve", "jit")
+                                "merge", "convergence", "serve", "fleet",
+                                "jit")
             for k in snap["counters"]
         )
         q = snap["session"]["quarantined"]
@@ -654,6 +655,41 @@ class TestChaosHarness:
             report.admitted + report.delayed + report.shed
         )
         assert report.served_rounds > 0
+
+    def test_host_kill_failover_acceptance(self, tmp_path):
+        """ISSUE 10 acceptance: with traffic running against a 3-host
+        fleet, killing one serving host yields only typed verdicts (zero
+        silent drops, fleet-wide accounting identity), every acked op
+        survives failover (checkpoint + journal redelivery), post-heal
+        fleet-wide digests byte-equal a fault-free reference run, and the
+        flight recorder dumps the failover timeline.  All oracles assert
+        inside the harness; the CI fleet-serve-smoke job runs the larger
+        TCP-transport episode."""
+        from peritext_tpu.testing.chaos import run_host_kill_failover
+
+        report = run_host_kill_failover(
+            0, hosts=3, num_docs=4, ops_per_doc=16, transport=False,
+            dump_dir=tmp_path,
+        )
+        assert report.acked_survived
+        assert report.converged
+        assert report.failovers == 1
+        assert report.failover_docs == report.victim_docs >= 1
+        assert report.offered == (
+            report.admitted + report.delayed + report.shed
+        )
+        assert report.delayed + report.shed > 0
+        assert report.flight_dumps >= 1
+
+    def test_markheavy_chaos_smoke(self):
+        """ROADMAP scenario diversity: the mark-heavy editorial-pass
+        family (span-overlap explosion) through the full composed-fault
+        campaign, byte-equality oracle and all."""
+        from peritext_tpu.testing.chaos import run_markheavy_chaos
+
+        report = run_markheavy_chaos(1, num_docs=4, ops_per_doc=30)
+        assert report.delivered_frames > 0
+        assert report.final_digest != 0
 
     @pytest.mark.slow
     def test_chaos_soak_twenty_seeds(self):

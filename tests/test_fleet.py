@@ -1,0 +1,1076 @@
+"""Live fleet failover tests (ISSUE 10): deterministic heartbeat leases,
+real doc-state migration (checkpoint ship + anti-entropy catch-up +
+digest-checked cutover with atomic rollback), host-death failover with
+acked-op survival, per-session wire auth, and the fleet exporter surfaces
+(golden shapes)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from peritext_tpu.checkpoint import pack_doc_frames, unpack_doc_frames
+from peritext_tpu.parallel.codec import encode_frame
+from peritext_tpu.parallel.lease import DEAD, HeartbeatLedger, LIVE, SUSPECT
+from peritext_tpu.parallel.router import FleetRouter, PlacementError
+from peritext_tpu.serve import (
+    AdmissionController,
+    AuthError,
+    CutoverError,
+    FleetFrontend,
+    SHED_FAILOVER,
+    SHED_REASONS,
+    SHED_UNAUTHORIZED,
+    SessionKeyring,
+    SessionMux,
+)
+from peritext_tpu.testing.chaos import _serve_session
+from peritext_tpu.testing.fuzz import generate_workload
+
+DOCS, OPS = 4, 16
+
+
+def make_mux(num_docs=8, max_depth=64):
+    return SessionMux(
+        _serve_session(num_docs, OPS),
+        admission=AdmissionController(max_depth=max_depth,
+                                      session_quota=None),
+    )
+
+
+def doc_plans(seed=31, num_docs=DOCS, ops_per_doc=OPS, chunk=5):
+    plans = {}
+    for d, w in enumerate(generate_workload(seed, num_docs=num_docs,
+                                            ops_per_doc=ops_per_doc)):
+        changes = [ch for log in sorted(w) for ch in w[log]]
+        plans[f"doc{d}"] = [
+            encode_frame(changes[i:i + chunk])
+            for i in range(0, len(changes), chunk)
+        ]
+    return plans
+
+
+def make_fleet(hosts=3, lease_rounds=2, transport=False, **kw):
+    fe = FleetFrontend(lease_rounds=lease_rounds, checkpoint_every=2, **kw)
+    for i in range(hosts):
+        fe.add_host(f"h{i}", make_mux(), transport=transport)
+    return fe
+
+
+def feed(fe, plans, keep_last=0):
+    for k in sorted(plans):
+        assert fe.open_doc(k, f"client-{k}").admitted
+    for k, frames in sorted(plans.items()):
+        for f in frames[:len(frames) - keep_last]:
+            assert fe.submit(k, f).admitted
+    fe.round()
+    fe.flush()
+
+
+def clean_reference(plans):
+    clean = _serve_session(len(plans), OPS)
+    for d, k in enumerate(sorted(plans)):
+        for f in plans[k]:
+            clean.ingest_frame(d, f)
+    clean.drain()
+    return clean, {k: d for d, k in enumerate(sorted(plans))}
+
+
+def assert_fleet_equals_clean(fe, plans):
+    clean, index = clean_reference(plans)
+    total = 0
+    for k in sorted(plans):
+        got = fe.doc_digest(k)
+        assert got == clean.doc_digest(index[k]), k
+        total = (total + got) & 0xFFFFFFFF
+    assert total == clean.digest()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat leases: deterministic round-counted death verdicts
+# ---------------------------------------------------------------------------
+
+
+class TestHeartbeatLedger:
+    def test_same_observation_sequence_same_verdicts(self):
+        """The split-brain guard: two independently-fed ledgers must agree
+        on every verdict at every tick."""
+        seq = [
+            {"a": True, "b": True},
+            {"a": False, "b": True},
+            {"a": False, "b": False},
+            {"a": False, "b": True},
+            {"a": True, "b": True},  # a is latched dead; beat ignored
+        ]
+        l1, l2 = HeartbeatLedger(3), HeartbeatLedger(3)
+        for ledger in (l1, l2):
+            ledger.track("a")
+            ledger.track("b")
+        trace1 = [l1.tick(beats) for beats in seq]
+        trace2 = [l2.tick(beats) for beats in seq]
+        assert trace1 == trace2
+        assert l1.snapshot() == l2.snapshot()
+
+    def test_verdict_ladder_and_latch(self):
+        ledger = HeartbeatLedger(2)
+        ledger.track("h")
+        assert ledger.tick({"h": True})["h"] == LIVE
+        assert ledger.tick({"h": False})["h"] == SUSPECT
+        assert ledger.newly_dead() == []
+        assert ledger.tick({"h": False})["h"] == DEAD
+        assert ledger.newly_dead() == ["h"]
+        # latched: a zombie beat does not revive, and newly_dead fires once
+        assert ledger.tick({"h": True})["h"] == DEAD
+        assert ledger.newly_dead() == []
+        assert ledger.dead_hosts() == ["h"]
+
+    def test_single_missed_round_is_not_death(self):
+        ledger = HeartbeatLedger(3)
+        ledger.track("h")
+        ledger.tick({"h": False})
+        assert ledger.tick({"h": True})["h"] == LIVE
+        assert ledger.lease("h").missed == 0
+
+    def test_absent_from_beats_counts_as_miss(self):
+        ledger = HeartbeatLedger(1)
+        ledger.track("h")
+        assert ledger.tick({})["h"] == DEAD
+
+    def test_reset_is_the_only_way_back(self):
+        ledger = HeartbeatLedger(1)
+        ledger.track("h")
+        ledger.tick({"h": False})
+        assert ledger.verdict("h") == DEAD
+        ledger.reset("h")
+        assert ledger.tick({"h": True})["h"] == LIVE
+
+    def test_snapshot_golden_shape(self):
+        ledger = HeartbeatLedger(2)
+        ledger.track("h")
+        ledger.tick({"h": False})
+        snap = ledger.snapshot()
+        assert set(snap) == {"lease_rounds", "ticks", "leases"}
+        assert set(snap["leases"]["h"]) == {
+            "missed", "rounds", "dead_at_round", "verdict",
+        }
+        json.dumps(snap)
+
+
+# ---------------------------------------------------------------------------
+# per-doc digest: the cutover oracle's foundation
+# ---------------------------------------------------------------------------
+
+
+class TestDocDigest:
+    def test_doc_digest_sums_to_session_digest(self):
+        plans = doc_plans()
+        sess = _serve_session(DOCS, OPS)
+        for d, k in enumerate(sorted(plans)):
+            for f in plans[k]:
+                sess.ingest_frame(d, f)
+        sess.drain()
+        total = sum(sess.doc_digest(d) for d in range(DOCS)) & 0xFFFFFFFF
+        assert total == sess.digest()
+
+    def test_doc_digest_comparable_across_sessions(self):
+        """Two sessions holding the same doc at DIFFERENT indices (and with
+        different other docs, so intern orders differ) hash it equal — the
+        migration cutover's exact requirement."""
+        plans = doc_plans()
+        a = _serve_session(DOCS, OPS)
+        b = _serve_session(DOCS, OPS)
+        keys = sorted(plans)
+        for d, k in enumerate(keys):
+            for f in plans[k]:
+                a.ingest_frame(d, f)
+        for d, k in enumerate(reversed(keys)):
+            for f in plans[k]:
+                b.ingest_frame(d, f)
+        a.drain()
+        b.drain()
+        for d, k in enumerate(keys):
+            assert a.doc_digest(d) == b.doc_digest(DOCS - 1 - d), k
+
+    def test_doc_digest_fallback_parity(self):
+        from peritext_tpu.parallel.streaming import REASON_CAPACITY
+
+        plans = doc_plans()
+        a = _serve_session(DOCS, OPS)
+        b = _serve_session(DOCS, OPS)
+        for d, k in enumerate(sorted(plans)):
+            for f in plans[k]:
+                a.ingest_frame(d, f)
+                b.ingest_frame(d, f)
+        a.drain()
+        b.drain()
+        b.force_fallback(1, REASON_CAPACITY, "test: scalar replay rung")
+        assert a.doc_digest(1) == b.doc_digest(1)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint ship transport
+# ---------------------------------------------------------------------------
+
+
+class TestShipTransport:
+    def test_pack_unpack_roundtrip(self):
+        frames = [b"", b"abc", b"\x00" * 100]
+        assert unpack_doc_frames(pack_doc_frames(frames)) == frames
+
+    def test_truncated_blob_raises(self):
+        blob = pack_doc_frames([b"abcdef"])
+        with pytest.raises(ValueError):
+            unpack_doc_frames(blob[:-2])
+        with pytest.raises(ValueError):
+            unpack_doc_frames(blob + b"\xff\xff\xff")
+
+    def test_ship_frames_roundtrip_and_catch_up(self):
+        from peritext_tpu.parallel.anti_entropy import ChangeStore
+        from peritext_tpu.parallel.multihost import (
+            ReplicaServer, RetryPolicy, ship_frames,
+        )
+
+        received = {}
+
+        def on_ship(doc_key, frames, base):
+            received.setdefault(doc_key, [])
+            have = len(received[doc_key])
+            received[doc_key].extend(frames[max(0, have - base):])
+            return len(received[doc_key])
+
+        server = ReplicaServer(ChangeStore(), on_ship=on_ship)
+        host, port = server.start()
+        policy = RetryPolicy(attempts=2, base_delay=0.01, timeout=2.0)
+        try:
+            have = ship_frames(host, port, "docA", [b"f0", b"f1"],
+                               retry=policy)
+            assert have == 2
+            # catch-up leg: only the tail ships, with base = prior have
+            have = ship_frames(host, port, "docA", [b"f2"], base=have,
+                               retry=policy)
+            assert have == 3
+            # a retried/overlapping ship is idempotent
+            have = ship_frames(host, port, "docA", [b"f1", b"f2"], base=1,
+                               retry=policy)
+            assert have == 3
+            assert received["docA"] == [b"f0", b"f1", b"f2"]
+        finally:
+            server.stop()
+
+    def test_ship_to_no_handler_endpoint_fails_loudly(self):
+        from peritext_tpu.core.errors import TransportError
+        from peritext_tpu.parallel.anti_entropy import ChangeStore
+        from peritext_tpu.parallel.multihost import (
+            ReplicaServer, RetryPolicy, ship_frames,
+        )
+
+        server = ReplicaServer(ChangeStore())  # no on_ship
+        host, port = server.start()
+        try:
+            with pytest.raises(TransportError):
+                ship_frames(host, port, "docA", [b"f0"],
+                            retry=RetryPolicy(attempts=1, timeout=1.0))
+        finally:
+            server.stop()
+
+    def test_malformed_ship_counted_not_fatal(self):
+        """A buggy/malicious peer's malformed MSG_SHIP body (short body,
+        non-dict header, missing "doc", bad frame blob) must die inside
+        the bad-peer guard — counted and swallowed — and the endpoint
+        must keep serving well-formed ships."""
+        import socket
+        import struct as _struct
+
+        from peritext_tpu.parallel.anti_entropy import ChangeStore
+        from peritext_tpu.parallel.multihost import (
+            _send_message, MSG_SHIP, ReplicaServer, ship_frames,
+        )
+
+        server = ReplicaServer(ChangeStore(), on_ship=lambda d, f, b: len(f))
+        host, port = server.start()
+        hdr = lambda s: _struct.pack("<I", len(s)) + s  # noqa: E731
+        bad_bodies = [
+            b"",                                   # short: struct.error
+            b"\x01",                               # short: struct.error
+            hdr(b"[1, 2]"),                        # header not a dict
+            hdr(b"{}"),                            # header missing "doc"
+            hdr(b"not json"),                      # json ValueError
+            hdr(b'{"doc": "d"}') + b"\xff\xff",    # truncated frame blob
+        ]
+        try:
+            for body in bad_bodies:
+                with socket.create_connection((host, port),
+                                              timeout=5) as sock:
+                    _send_message(sock, MSG_SHIP, body)
+                    sock.settimeout(2)
+                    assert sock.recv(4096) == b"", body  # closed, no ack
+            # the endpoint survived every malformed peer
+            assert ship_frames(host, port, "docZ", [b"frame"]) == 1
+        finally:
+            server.stop()
+
+    def test_anti_entropy_exchange_unaffected(self):
+        """The ship message kind must not disturb the frontier/changes
+        protocol on the same endpoint."""
+        from peritext_tpu.parallel.anti_entropy import ChangeStore
+        from peritext_tpu.parallel.multihost import ReplicaServer, sync_with
+        from peritext_tpu.testing.chaos import _append_changes
+
+        full, local = ChangeStore(), ChangeStore()
+        _append_changes(full, "actor", 5)
+        server = ReplicaServer(full, on_ship=lambda *a: 0)
+        host, port = server.start()
+        try:
+            pulled, pushed = sync_with(local, host, port)
+        finally:
+            server.stop()
+        assert pulled == 5 and local.clock() == full.clock()
+
+
+# ---------------------------------------------------------------------------
+# router execution hooks
+# ---------------------------------------------------------------------------
+
+
+class TestRouterHooks:
+    def make_router(self):
+        r = FleetRouter()
+        for name in ("h0", "h1", "h2"):
+            r.add_host(name, capacity=4)
+        for i in range(4):
+            r.place(f"doc{i}", size=i + 1)
+        return r
+
+    def test_fail_host_forgets_placements_and_latches(self):
+        r = self.make_router()
+        victim = r.host_of("doc0")
+        held = [dk for dk, h in r.placement().items() if h == victim]
+        lost = r.fail_host(victim)
+        assert sorted(dk for dk, _, _ in lost) == sorted(held)
+        assert all(r.host_of(dk) is None for dk in held)
+        assert r.host(victim).draining
+        # a dead host receives no placements
+        r.place("fresh", size=1)
+        assert r.host_of("fresh") != victim
+
+    def test_rollback_moves_restores_pre_plan_placement(self):
+        r = self.make_router()
+        before = r.placement()
+        moves_before = r.moves
+        plan = r.evacuate("h0")
+        assert plan
+        r.rollback_moves(plan)
+        r.set_draining("h0", False)
+        assert r.placement() == before
+        assert r.moves == moves_before
+
+    def test_release_and_directed_move(self):
+        r = self.make_router()
+        r.release("doc0")
+        assert r.host_of("doc0") is None
+        r.release("doc0")  # idempotent
+        target = "h2" if r.host_of("doc1") != "h2" else "h1"
+        r.move("doc1", target)
+        assert r.host_of("doc1") == target
+
+    def test_directed_move_refuses_full_or_draining(self):
+        r = self.make_router()
+        r.set_draining("h2", True)
+        src = r.host_of("doc1")
+        with pytest.raises(PlacementError):
+            r.move("doc1", "h2")
+        assert r.host_of("doc1") == src
+
+
+# ---------------------------------------------------------------------------
+# migration: real state movement with digest-checked cutover
+# ---------------------------------------------------------------------------
+
+
+class TestMigration:
+    def test_evacuate_moves_real_state(self):
+        plans = doc_plans()
+        fe = make_fleet(hosts=3)
+        try:
+            feed(fe, plans)
+            victim = fe.router.host_of("doc0")
+            plan = fe.evacuate(victim)
+            assert plan
+            assert all(fe._serving[dk] != victim for dk in plans)
+            # source slots were released only after the plan committed
+            assert all(
+                fe.hosts[victim].session_of(dk) is None for dk in plans
+            )
+            assert_fleet_equals_clean(fe, plans)
+        finally:
+            fe.stop()
+
+    def test_mid_move_op_race_catches_up(self, monkeypatch):
+        """Ops landing between the checkpoint snapshot and cutover keep
+        hitting the SOURCE (the serving map flips only at cutover) and the
+        catch-up legs ship them — the moved doc must be byte-equal to a
+        reference fed everything."""
+        plans = doc_plans()
+        fe = make_fleet(hosts=2)
+        try:
+            feed(fe, plans, keep_last=1)
+            key = "doc1"
+            late = plans[key][-1]
+            src = fe.router.host_of(key)
+            dst = next(n for n in fe.hosts if n != src)
+            real_ship = fe._ship
+            raced = {"done": False}
+
+            def racing_ship(target, doc_key, frames, base):
+                have = real_ship(target, doc_key, frames, base)
+                if doc_key == key and not raced["done"]:
+                    raced["done"] = True
+                    # the race: a client op lands mid-move, on the source
+                    verdict = fe.submit(key, late)
+                    assert verdict.admitted
+                    assert fe._serving[key] == src
+                return have
+
+            monkeypatch.setattr(fe, "_ship", racing_ship)
+            fe.migrate(key, dst)
+            assert raced["done"], "the race never fired"
+            assert fe._serving[key] == dst
+            # deliver the held-back frames of the OTHER docs for the
+            # reference comparison
+            for k, frames in sorted(plans.items()):
+                if k != key:
+                    assert fe.submit(k, frames[-1]).admitted
+            fe.round()
+            fe.flush()
+            assert_fleet_equals_clean(fe, plans)
+        finally:
+            fe.stop()
+
+    def test_fallback_doc_migration_with_mid_move_race(self, monkeypatch):
+        """A degraded doc re-encodes its whole log as ONE frame, so the
+        frame-count frontier never advances — catch-up must diff CONTENT
+        and re-ship in full (the receiver's merge is idempotent), or a
+        mid-move op is silently dropped and the cutover digest check can
+        never pass."""
+        from peritext_tpu.parallel.streaming import REASON_CAPACITY
+
+        plans = doc_plans()
+        fe = make_fleet(hosts=2)
+        try:
+            feed(fe, plans, keep_last=1)
+            key = "doc1"
+            late = plans[key][-1]
+            src = fe.router.host_of(key)
+            dst = next(n for n in fe.hosts if n != src)
+            host = fe.hosts[src]
+            doc = host.mux.sessions()[host.session_of(key)].doc_index
+            host.mux.session.force_fallback(
+                doc, REASON_CAPACITY, "test: scalar replay rung")
+            real_ship = fe._ship
+            raced = {"done": False}
+
+            def racing_ship(target, doc_key, frames, base):
+                have = real_ship(target, doc_key, frames, base)
+                if doc_key == key and not raced["done"]:
+                    raced["done"] = True
+                    assert fe.submit(key, late).admitted
+                    assert fe._serving[key] == src
+                return have
+
+            monkeypatch.setattr(fe, "_ship", racing_ship)
+            fe.migrate(key, dst)
+            assert raced["done"], "the race never fired"
+            assert fe._serving[key] == dst
+            for k, frames in sorted(plans.items()):
+                if k != key:
+                    assert fe.submit(k, frames[-1]).admitted
+            fe.round()
+            fe.flush()
+            assert_fleet_equals_clean(fe, plans)
+        finally:
+            fe.stop()
+
+    def test_failed_move_reuses_target_slot(self, monkeypatch):
+        """A ship that fails AFTER the target slot was claimed keeps the
+        doc→slot reservation — mux slots are append-only, so releasing
+        could never reclaim capacity; retries must RESUME into the same
+        slot, not burn a fresh one per attempt.  Repeated failures (more
+        than the mux has slots) must not drain the target, and a clean
+        migrate afterwards lands byte-equal."""
+        plans = doc_plans()
+        fe = make_fleet(hosts=2)
+        try:
+            feed(fe, plans)
+            key = "doc0"
+            src = fe.router.host_of(key)
+            dst = next(n for n in fe.hosts if n != src)
+            real_ship = fe._ship
+
+            def failing_ship(target, doc_key, frames, base):
+                # deliver one frame (claiming the slot), then die mid-ship
+                real_ship(target, doc_key, frames[:1], base=base)
+                raise OSError("injected ship failure")
+
+            monkeypatch.setattr(fe, "_ship", failing_ship)
+            before = fe.hosts[dst].mux.load_report()["docs"]
+            failures = 0
+            # the broken transport dies mid-ship every time; each retry
+            # must RESUME where the last died, so the move eventually
+            # completes through the fault — and claims ONE slot, ever
+            for _ in range(40):
+                try:
+                    fe.migrate(key, dst)
+                    break
+                except OSError:
+                    failures += 1
+                    assert fe._serving[key] == src
+            else:
+                pytest.fail("migration never completed through resume")
+            assert failures >= 1, "the fault never fired"
+            assert fe._serving[key] == dst
+            assert fe.hosts[dst].mux.load_report()["docs"] == before + 1
+            fe.round()
+            fe.flush()
+            assert_fleet_equals_clean(fe, plans)
+        finally:
+            fe.stop()
+
+    def test_cutover_mismatch_rolls_back_atomically(self, monkeypatch):
+        from peritext_tpu.serve.fleet import FleetHost
+
+        plans = doc_plans()
+        fe = make_fleet(hosts=2)
+        try:
+            feed(fe, plans)
+            key = "doc0"
+            src = fe.router.host_of(key)
+            dst = next(n for n in fe.hosts if n != src)
+            before_serving = dict(fe._serving)
+            before_placement = fe.router.placement()
+            orig = FleetHost.doc_digest
+
+            def corrupt(self, doc_key):
+                value = orig(self, doc_key)
+                return value ^ 1 if (self.name == dst and doc_key == key) \
+                    else value
+
+            monkeypatch.setattr(FleetHost, "doc_digest", corrupt)
+            with pytest.raises(CutoverError):
+                fe.migrate(key, dst)
+            monkeypatch.setattr(FleetHost, "doc_digest", orig)
+            # atomic: serving map, router placement, and the doc's state
+            # are all exactly pre-plan; the doc still serves
+            assert fe._serving == before_serving
+            assert fe.router.placement() == before_placement
+            assert fe.migration_rollbacks == 1
+            assert fe.submit(key, plans[key][0]).admitted
+            fe.round()
+            fe.flush()
+            assert fe.doc_digest(key) is not None
+        finally:
+            fe.stop()
+
+    def test_evacuate_rollback_spans_whole_plan(self, monkeypatch):
+        """A digest mismatch on the LAST doc of an evacuation plan must
+        revert every earlier (already cut over) doc too."""
+        from peritext_tpu.serve.fleet import FleetHost
+
+        plans = doc_plans()
+        fe = make_fleet(hosts=3)
+        try:
+            feed(fe, plans)
+            victim = fe.router.host_of("doc0")
+            victim_docs = sorted(
+                dk for dk, h in fe._serving.items() if h == victim
+            )
+            assert len(victim_docs) >= 1
+            before_serving = dict(fe._serving)
+            before_placement = fe.router.placement()
+            orig = FleetHost.doc_digest
+            last = victim_docs[-1]
+
+            def corrupt(self, doc_key):
+                value = orig(self, doc_key)
+                return value ^ 1 if (doc_key == last
+                                     and self.name != victim) else value
+
+            monkeypatch.setattr(FleetHost, "doc_digest", corrupt)
+            with pytest.raises(CutoverError):
+                fe.evacuate(victim)
+            monkeypatch.setattr(FleetHost, "doc_digest", orig)
+            fe.router.set_draining(victim, False)
+            assert fe._serving == before_serving
+            assert fe.router.placement() == before_placement
+            assert_fleet_equals_clean(fe, plans)
+        finally:
+            fe.stop()
+
+    def test_tcp_ship_migration(self):
+        """The same migration over the real retrying transport (TCP ship
+        endpoints on both hosts)."""
+        plans = doc_plans(num_docs=2)
+        fe = make_fleet(hosts=2, transport=True)
+        try:
+            feed(fe, plans)
+            key = "doc0"
+            src = fe.router.host_of(key)
+            dst = next(n for n in fe.hosts if n != src)
+            assert fe.hosts[dst].address is not None
+            fe.migrate(key, dst)
+            assert fe._serving[key] == dst
+            assert_fleet_equals_clean(fe, plans)
+        finally:
+            fe.stop()
+
+
+# ---------------------------------------------------------------------------
+# failover: host death mid-traffic
+# ---------------------------------------------------------------------------
+
+
+class TestFailover:
+    def test_kill_failover_typed_verdicts_and_survival(self):
+        plans = doc_plans()
+        fe = make_fleet(hosts=3, lease_rounds=2)
+        try:
+            feed(fe, plans, keep_last=1)
+            victim = fe.router.host_of("doc0")
+            victim_docs = sorted(
+                dk for dk, h in fe._serving.items() if h == victim
+            )
+            acked = {k: plans[k][:-1] for k in victim_docs}
+            fe.hosts[victim].kill()
+            # pre-detection submissions answer TYPED delay, never raise
+            verdict = fe.submit(victim_docs[0], plans[victim_docs[0]][-1])
+            assert verdict.kind == "delay"
+            for _ in range(2):
+                fe.round()
+            assert fe.failovers == 1
+            assert fe.failover_docs == len(victim_docs)
+            # acked-op survival BEFORE any retry
+            for k in victim_docs:
+                ref = _serve_session(1, OPS)
+                for f in acked[k]:
+                    ref.ingest_frame(0, f)
+                ref.drain()
+                assert fe.doc_digest(k) == ref.doc_digest(0), k
+            # retries redeliver the held-back tail fleet-wide
+            for k, frames in sorted(plans.items()):
+                while not fe.submit(k, frames[-1]).admitted:
+                    fe.round()
+            fe.round()
+            fe.flush()
+            assert_fleet_equals_clean(fe, plans)
+            assert fe.stats.accounted()
+            for reason in fe.stats.shed_reasons:
+                assert reason in SHED_REASONS
+        finally:
+            fe.stop()
+
+    def test_failover_without_capacity_sheds_typed_then_heals(self):
+        plans = doc_plans(num_docs=2)
+        fe = FleetFrontend(lease_rounds=1, checkpoint_every=1)
+        # two hosts with capacity exactly 1 each: no spare room anywhere
+        fe.add_host("h0", make_mux(), capacity=1)
+        fe.add_host("h1", make_mux(), capacity=1)
+        try:
+            feed(fe, plans, keep_last=1)
+            victim = fe.router.host_of("doc0")
+            doomed = [dk for dk, h in fe._serving.items() if h == victim]
+            fe.hosts[victim].kill()
+            fe.round()
+            assert fe.failovers == 1 and fe.failover_docs == 0
+            verdict = fe.submit(doomed[0], plans[doomed[0]][-1])
+            assert verdict.kind == "shed"
+            assert verdict.reason == SHED_FAILOVER
+            # capacity returns: a fresh host registers, retry heals
+            fe.add_host("h2", make_mux(), capacity=2)
+            assert fe.retry_failed() == len(doomed)
+            for k in doomed:
+                assert fe._serving[k] == "h2"
+                assert fe.submit(k, plans[k][-1]).admitted
+            fe.round()
+            fe.flush()
+            assert fe.stats.accounted()
+        finally:
+            fe.stop()
+
+    def test_failed_replacement_reuses_target_slot(self, monkeypatch):
+        """A failover redelivery that dies after claiming the target slot
+        keeps the reservation: the doc sheds ``failover`` typed, repeated
+        retries resume into the SAME slot (never burning fresh ones), and
+        once the fault clears retry_failed() re-homes byte-equal."""
+        plans = doc_plans()
+        fe = make_fleet(hosts=2, lease_rounds=1)
+        try:
+            feed(fe, plans, keep_last=1)
+            victim = fe.router.host_of("doc0")
+            survivor = next(n for n in fe.hosts if n != victim)
+            doomed = sorted(dk for dk, h in fe._serving.items()
+                            if h == victim)
+            real_ship = fe._ship
+
+            def failing_ship(target, doc_key, frames, base):
+                real_ship(target, doc_key, frames, base)
+                raise OSError("injected redelivery failure")
+
+            monkeypatch.setattr(fe, "_ship", failing_ship)
+            fe.hosts[victim].kill()
+            fe.round()
+            assert fe.failovers == 1 and fe.failover_docs == 0
+            slots_used = fe.hosts[survivor].mux.load_report()["docs"]
+            for k in doomed:
+                verdict = fe.submit(k, plans[k][-1])
+                assert verdict.kind == "shed"
+                assert verdict.reason == SHED_FAILOVER
+            # failed retries must not burn fresh slots
+            assert fe.retry_failed() == 0
+            assert (fe.hosts[survivor].mux.load_report()["docs"]
+                    == slots_used)
+            monkeypatch.setattr(fe, "_ship", real_ship)
+            assert fe.retry_failed() == len(doomed)
+            assert (fe.hosts[survivor].mux.load_report()["docs"]
+                    == slots_used)
+            for k in doomed:
+                assert fe._serving[k] == survivor
+                assert fe.submit(k, plans[k][-1]).admitted
+            for k in sorted(plans):
+                if k not in doomed:
+                    assert fe.submit(k, plans[k][-1]).admitted
+            fe.round()
+            fe.flush()
+            assert fe.stats.accounted()
+            assert_fleet_equals_clean(fe, plans)
+        finally:
+            fe.stop()
+
+    def test_dead_host_readmission_via_add_host(self):
+        """Re-registering a DEAD host's name is the re-admission path:
+        the zombie's remnants tear down, the lease restarts fresh (the
+        only way out of the latch), and the new host takes placements
+        again.  A LIVE name re-registering raises before any state
+        mutates."""
+        plans = doc_plans(num_docs=2)
+        fe = make_fleet(hosts=2, lease_rounds=1)
+        try:
+            feed(fe, plans, keep_last=1)
+            with pytest.raises(ValueError):
+                fe.add_host("h0", make_mux())
+            victim = fe.router.host_of("doc0")
+            fe.hosts[victim].kill()
+            fe.round()
+            assert fe.ledger.verdict(victim) == DEAD
+            assert fe.failovers == 1
+            # the operator restarts the machine and re-registers the name
+            fe.add_host(victim, make_mux())
+            assert fe.ledger.verdict(victim) == LIVE
+            fe.round()
+            assert fe.ledger.verdict(victim) == LIVE
+            # the reborn host is placeable again
+            assert fe.open_doc("doc-new", "client-new").admitted
+            for k, frames in sorted(plans.items()):
+                assert fe.submit(k, frames[-1]).admitted
+            fe.round()
+            fe.flush()
+            assert fe.stats.accounted()
+            assert_fleet_equals_clean(fe, plans)
+        finally:
+            fe.stop()
+
+    def test_retried_plan_redelivery_does_not_grow_standby_store(self):
+        """A client retrying its whole plan after a failover re-admits
+        byte-identical frames; the journal dedups them, so the standby
+        store (checkpoint ∪ journal) holds each acked frame ONCE no
+        matter how many retry passes run."""
+        plans = doc_plans(num_docs=2)
+        fe = make_fleet(hosts=2, lease_rounds=1)
+        try:
+            feed(fe, plans)
+            fe.checkpoint_ship()
+            size = sum(len(v) for v in fe._checkpoint.values()) + sum(
+                len(v) for v in fe._journal.values())
+            for _ in range(3):  # three full retry passes
+                for k, frames in sorted(plans.items()):
+                    for f in frames:
+                        assert fe.submit(k, f).admitted
+                fe.round()
+                fe.flush()
+            fe.checkpoint_ship()
+            grown = sum(len(v) for v in fe._checkpoint.values()) + sum(
+                len(v) for v in fe._journal.values())
+            assert grown == size, "retry passes multiplied the standby store"
+            assert_fleet_equals_clean(fe, plans)
+        finally:
+            fe.stop()
+
+    def test_flight_recorder_dumps_failover_timeline(self, tmp_path):
+        from peritext_tpu.obs import FlightRecorder
+
+        plans = doc_plans(num_docs=2)
+        recorder = FlightRecorder(capacity=128, dump_dir=tmp_path,
+                                  min_dump_interval=0.0)
+        fe = make_fleet(hosts=3, lease_rounds=1, recorder=recorder)
+        try:
+            feed(fe, plans)
+            victim = fe.router.host_of("doc0")
+            fe.hosts[victim].kill()
+            fe.round()
+            assert fe.failovers == 1
+            dumps = sorted(tmp_path.glob("*.jsonl"))
+            assert dumps
+            records = [
+                json.loads(line)
+                for dump in dumps
+                for line in dump.read_text().splitlines() if line
+            ]
+            reasons = {r.get("reason") for r in records
+                       if r.get("kind") == "fault"}
+            assert {"host-death", "failover-complete"} <= reasons
+        finally:
+            fe.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-session wire auth
+# ---------------------------------------------------------------------------
+
+
+class TestAuth:
+    def keyring(self):
+        return SessionKeyring({"k1": b"secret-one"})
+
+    def test_mint_verify_and_reject(self):
+        kr = self.keyring()
+        token = kr.mint("alice")
+        assert kr.verify("alice", token)
+        assert not kr.verify("bob", token)  # bound to the client
+        assert not kr.verify("alice", None)
+        assert not kr.verify("alice", "garbage")
+        assert not kr.verify("alice", "nokey." + token.split(".", 1)[1])
+        snap = kr.snapshot()
+        assert set(snap) == {"keys", "minting", "verified", "rejected",
+                             "rotations"}
+        assert snap["verified"] == 1 and snap["rejected"] == 4
+
+    def test_rotation_keeps_live_tokens_retire_ends_them(self):
+        kr = self.keyring()
+        old_token = kr.mint("alice")
+        kr.rotate("k2", b"secret-two")
+        assert kr.minting_key_id == "k2"
+        # rotation does NOT drop live sessions: old tokens still verify
+        assert kr.verify("alice", old_token)
+        new_token = kr.mint("alice")
+        assert new_token.startswith("k2.")
+        assert kr.verify("alice", new_token)
+        kr.retire("k1")
+        assert not kr.verify("alice", old_token)
+        assert kr.verify("alice", new_token)
+        with pytest.raises(AuthError):
+            kr.retire("k2")  # the minting key cannot be retired
+
+    def test_mux_sheds_unauthorized_at_admission(self):
+        kr = self.keyring()
+        mux = SessionMux(_serve_session(2, OPS), auth=kr)
+        sid, verdict = mux.open_session("alice")  # no token
+        assert sid is None and verdict.reason == SHED_UNAUTHORIZED
+        sid, verdict = mux.open_session("alice", token=kr.mint("bob"))
+        assert sid is None and verdict.reason == SHED_UNAUTHORIZED
+        sid, verdict = mux.open_session("alice", token=kr.mint("alice"))
+        assert sid is not None and verdict.admitted
+        # identity holds and the reason is counted
+        stats = mux.admission.stats
+        assert stats.submitted == stats.admitted + stats.delayed + stats.shed
+        assert stats.shed_reasons[SHED_UNAUTHORIZED] == 2
+        assert "auth" in mux.snapshot()
+
+    def test_per_frame_auth_and_rotation_mid_session(self):
+        kr = self.keyring()
+        mux = SessionMux(_serve_session(2, OPS), auth=kr,
+                         auth_per_frame=True)
+        token = kr.mint("alice")
+        sid, verdict = mux.open_session("alice", token=token)
+        assert verdict.admitted
+        plans = doc_plans(num_docs=1)
+        frame = plans["doc0"][0]
+        assert mux.submit(sid, frame, token=token).admitted
+        verdict = mux.submit(sid, frame)  # missing token
+        assert verdict.kind == "shed"
+        assert verdict.reason == SHED_UNAUTHORIZED
+        # rotation mid-session: the cached token keeps working
+        kr.rotate("k2", b"secret-two")
+        assert mux.submit(sid, frame, token=token).admitted
+
+    def test_unauthorized_counted_in_shed_reason_gauges(self):
+        from peritext_tpu.obs import prometheus_text
+
+        kr = self.keyring()
+        mux = SessionMux(_serve_session(2, OPS), auth=kr)
+        mux.open_session("alice")
+        text = prometheus_text(serve=mux)
+        assert ('peritext_serve_shed_reason_total{reason="unauthorized"} 1'
+                in text)
+
+    def test_fleet_frontend_auth_edge(self):
+        """doc_key is a PUBLIC name, not a bearer: an auth-enabled fleet
+        must verify every submit and bind re-opens to the registered
+        owner, or any tenant could write into any doc it can name."""
+        kr = self.keyring()
+        fe = FleetFrontend(auth=kr)
+        fe.add_host("h0", make_mux())
+        try:
+            verdict = fe.open_doc("docA", "alice")
+            assert verdict.kind == "shed"
+            assert verdict.reason == SHED_UNAUTHORIZED
+            token = kr.mint("alice")
+            assert fe.open_doc("docA", "alice", token=token).admitted
+            frame = doc_plans(num_docs=1)["doc0"][0]
+            # knowing the doc name is not a credential
+            verdict = fe.submit("docA", frame)
+            assert verdict.kind == "shed"
+            assert verdict.reason == SHED_UNAUTHORIZED
+            # a DIFFERENT tenant's valid token opens nothing of alice's
+            verdict = fe.open_doc("docA", "mallory",
+                                  token=kr.mint("mallory"))
+            assert verdict.kind == "shed"
+            assert verdict.reason == SHED_UNAUTHORIZED
+            assert fe.submit("docA", frame, token=token).admitted
+            assert fe.stats.accounted()
+        finally:
+            fe.stop()
+
+    def test_host_mux_with_own_keyring_refused(self):
+        fe = FleetFrontend()
+        mux = SessionMux(_serve_session(2, OPS),
+                         auth=SessionKeyring({"k": b"s"}))
+        with pytest.raises(AuthError):
+            fe.add_host("h0", mux)
+        assert not fe.hosts and fe.router.hosts() == []
+
+
+# ---------------------------------------------------------------------------
+# exporter surfaces: /fleet.json + peritext_fleet_* gauges
+# ---------------------------------------------------------------------------
+
+
+class TestFleetExporters:
+    def make_frontend(self):
+        plans = doc_plans(num_docs=2)
+        fe = make_fleet(hosts=2)
+        feed(fe, plans)
+        return fe
+
+    def test_snapshot_golden_shape(self):
+        fe = self.make_frontend()
+        try:
+            snap = fe.snapshot()
+            assert set(snap) == {
+                "rounds", "hosts", "leases", "router", "serving", "moving",
+                "failed_docs", "failovers", "failover_docs", "migrations",
+                "migration_rollbacks", "checkpoint_ships", "journal_frames",
+                "checkpoint_docs", "verdicts", "auth",
+            }
+            assert set(snap["verdicts"]) == {
+                "submitted", "admitted", "delayed", "shed", "shed_reasons",
+            }
+            host_snap = snap["hosts"]["h0"]
+            assert set(host_snap) == {"alive", "docs", "address", "serve"}
+            json.dumps(snap)
+        finally:
+            fe.stop()
+
+    def test_fleet_json_route(self):
+        from peritext_tpu.obs import MetricsServer
+
+        fe = self.make_frontend()
+        server = MetricsServer(fleet=fe)
+        host, port = server.start()
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/fleet.json", timeout=5
+            ).read())
+        finally:
+            server.stop()
+            fe.stop()
+        assert body["router"]["docs"] == 2
+        assert set(body["serving"]) == {"doc0", "doc1"}
+
+    def test_prometheus_fleet_gauges(self):
+        from peritext_tpu.obs import prometheus_text
+
+        fe = self.make_frontend()
+        try:
+            fe.submit("nonexistent", b"x")  # one typed shed for the family
+            text = prometheus_text(fleet=fe)
+            for line in (
+                "peritext_fleet_hosts ",
+                "peritext_fleet_live_hosts ",
+                "peritext_fleet_dead_hosts ",
+                "peritext_fleet_docs ",
+                "peritext_fleet_failed_docs ",
+                "peritext_fleet_journal_frames ",
+                "peritext_fleet_failovers_total ",
+                "peritext_fleet_migrations_total ",
+                "peritext_fleet_migration_rollbacks_total ",
+                "peritext_fleet_checkpoint_ships_total ",
+                "peritext_fleet_submitted_total ",
+                "peritext_fleet_admitted_total ",
+                "peritext_fleet_delayed_total ",
+                "peritext_fleet_shed_total ",
+            ):
+                assert any(ln.startswith(line)
+                           for ln in text.splitlines()), line
+            assert ('peritext_fleet_shed_reason_total'
+                    '{reason="unknown-session"} 1') in text
+        finally:
+            fe.stop()
+
+    def test_health_snapshot_composition(self):
+        from peritext_tpu.obs import health_snapshot
+
+        fe = self.make_frontend()
+        try:
+            snap = health_snapshot(fleet=fe)
+            assert "fleet" in snap and snap["fleet"]["router"]["docs"] == 2
+            json.dumps(snap, default=str)
+        finally:
+            fe.stop()
+
+    def test_replica_server_mounts_fleet(self):
+        from peritext_tpu.parallel.anti_entropy import ChangeStore
+        from peritext_tpu.parallel.multihost import ReplicaServer
+
+        fe = self.make_frontend()
+        server = ReplicaServer(ChangeStore(), metrics_port=0, fleet=fe)
+        server.start()
+        try:
+            mh, mp = server.metrics_address
+            body = json.loads(urllib.request.urlopen(
+                f"http://{mh}:{mp}/fleet.json", timeout=5
+            ).read())
+            assert body["router"]["docs"] == 2
+        finally:
+            server.stop()
+            fe.stop()
+
+
+# ---------------------------------------------------------------------------
+# load ingestion: the router learns from the serve exporter surface
+# ---------------------------------------------------------------------------
+
+
+class TestLoadIngestion:
+    def test_round_feeds_measured_loads_into_router(self):
+        plans = doc_plans(num_docs=2)
+        fe = make_fleet(hosts=2)
+        try:
+            feed(fe, plans)
+            fe.observe_loads()  # re-observe after the flush landed frames
+            for name in fe.hosts:
+                rec = fe.router.host(name)
+                expected = fe.hosts[name].mux.load_report()
+                assert rec.slot_load == expected["slot_load"]
+                assert rec.host_bound_load == expected["host_bound_load"]
+            assert sum(fe.router.host(n).slot_load
+                       for n in fe.hosts) > 0
+        finally:
+            fe.stop()

@@ -157,6 +157,51 @@ def test_prefetch_digest_matches_plain_digest():
     assert a.digest(refresh=True) == b.digest()
 
 
+def test_drain_end_digest_chains_into_final_staged_batch():
+    """Round-14 rung: with the prefetch armed, a multi-round drain's FINAL
+    staged batch carries the resolve+digest in ITS OWN program — no
+    separate prefetch dispatch.  Pinned three ways: the chained counter
+    moves, the per-round block cache is already seeded when drain()
+    returns (so digest() is a pure cache hit), and the digest stays
+    byte-equal to the unchained per-round oracle."""
+    from peritext_tpu.obs import GLOBAL_COUNTERS
+
+    workloads = generate_workload(seed=55, num_docs=6, ops_per_doc=36)
+    before = GLOBAL_COUNTERS.get("streaming.digest_chained")
+    fused = _feed(_session(), workloads, random.Random(9), prefetch=True)
+    assert GLOBAL_COUNTERS.get("streaming.digest_chained") > before
+    # the final batch's dispatch seeded the resolution cache at the
+    # current round stamp: the block program need not run again
+    stamp, cache = fused._resolved_cache
+    assert stamp == fused.rounds and 0 in cache
+    entry = cache[0]
+    digest = fused.digest()
+    # digest() consumed the SEEDED entry (same object — no re-dispatch)
+    assert fused._resolved_cache[1][0] is entry
+    oracle = _feed(_session(fused=False), workloads, random.Random(9),
+                   per_round_steps=True)
+    assert digest == oracle.digest()
+    assert fused.read_all() == oracle.read_all()
+
+
+def test_drain_end_digest_chains_on_stacked_serving_form():
+    """The static-rounds serving discipline chains too (the stacked
+    fixed-width program grows a digest tail), with the same byte
+    equality."""
+    from peritext_tpu.obs import GLOBAL_COUNTERS
+
+    workloads = generate_workload(seed=23, num_docs=6, ops_per_doc=36)
+    before = GLOBAL_COUNTERS.get("streaming.digest_chained")
+    fused = _feed(_session(static_rounds=True, caps=(24, 12, 12, 8)),
+                  workloads, random.Random(4), prefetch=True)
+    assert GLOBAL_COUNTERS.get("streaming.digest_chained") > before
+    oracle = _feed(_session(static_rounds=True, caps=(24, 12, 12, 8),
+                            fused=False),
+                   workloads, random.Random(4), per_round_steps=True)
+    assert fused.digest() == oracle.digest()
+    assert fused.read_all() == oracle.read_all()
+
+
 def test_staged_rounds_donation_consumes_input_state():
     """Donation semantics of the fused apply program: with donate=True the
     input state buffer is consumed (further reads raise), and the result is

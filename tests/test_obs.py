@@ -307,7 +307,7 @@ class TestHealthSnapshotShape:
         json.dumps(snap, default=str)  # one JSON document, end to end
         # fault-domain namespacing holds across every surface
         prefixes = ("streaming.", "transport.", "supervisor.", "merge.",
-                    "jit.", "convergence.", "serve.")
+                    "jit.", "convergence.", "serve.", "fleet.")
         assert all(k.startswith(prefixes) for k in snap["counters"])
         assert all(k.startswith(prefixes) for k in snap["histograms"])
 

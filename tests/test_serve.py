@@ -487,8 +487,11 @@ class TestSessionMux:
             "host", "layout", "fused_pipeline", "sessions", "sessions_total",
             "docs", "doc_capacity", "degraded_docs", "rounds",
             "applied_frames", "buffered_frames", "overloaded",
-            "recent_sheds", "queue", "window", "session_table",
+            "recent_sheds", "load", "queue", "window", "session_table",
         }
+        # the load section is FleetRouter.observe keyword-compatible (the
+        # fleet frontend feeds placement straight from this surface)
+        assert {"slot_load", "host_bound_load", "docs"} <= set(snap["load"])
         assert snap["layout"] == "padded"  # paged muxes add "page_pool"
         assert snap["fused_pipeline"] is True  # serving rides the fused path
         assert snap["host"] == "h9"
