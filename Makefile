@@ -8,7 +8,8 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: test fuzz fuzz-differential fuzz-frames fuzz-crash chaos weak-scaling \
 	bench bench-smoke bench-streaming bench-fused entry dryrun lint lint-baseline \
 	clean obs fleet perf-gate serve-smoke bench-serve paged-smoke bench-longdoc \
-	fused-smoke fleet-serve-smoke bench-fleet-serve bench-markheavy
+	fused-smoke fleet-serve-smoke bench-fleet-serve bench-markheavy \
+	ragged-smoke
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -64,8 +65,15 @@ bench-serve:
 paged-smoke:
 	$(CPU_ENV) $(PY) scripts/paged_smoke.py --out /tmp/pt-paged
 
-# long-tail paged-vs-padded comparison row: one essay among a tweet fleet,
-# both layouts measured, byte equality asserted, waste ratio reported
+# ragged-layout smoke (mirrors the CI ragged-smoke job): the Pallas kernel
+# in interpret mode + the lax pool walk against the padded oracle, the
+# ragged DocBatch/streaming byte equality, padding_efficiency == 1.0, and
+# the peritext_ragged_* gauges (artifacts land in /tmp/pt-ragged)
+ragged-smoke:
+	$(CPU_ENV) $(PY) scripts/ragged_smoke.py --out /tmp/pt-ragged
+
+# long-tail layout comparison row: one essay among a tweet fleet, all
+# three layouts measured, byte equality asserted, waste ratio reported
 bench-longdoc:
 	$(PY) bench.py --mode longdoc
 
@@ -120,7 +128,7 @@ bench-engine:  # device-only streaming replay: the engine limit vs the link
 # ledger, then gated with per-row tolerance bands (exit 1 on regression)
 perf-gate:
 	cp perf/reference_ledger.jsonl /tmp/pt-perf-gate.jsonl
-	PT_BENCH_LADDER_ROWS="streaming,streaming_fused,wire,serve_sustained,batch_longdoc,markheavy,fleet_serve" $(PY) bench.py \
+	PT_BENCH_LADDER_ROWS="streaming,streaming_fused,wire,serve_sustained,batch_longdoc,batch_8k_ragged,markheavy,fleet_serve" $(PY) bench.py \
 		--mode ladder --smoke --platform cpu --devprof \
 		--ledger /tmp/pt-perf-gate.jsonl
 	$(PY) -m peritext_tpu.obs perf /tmp/pt-perf-gate.jsonl --gate

@@ -190,6 +190,13 @@ def run(args) -> dict:
     from peritext_tpu.testing.synth import synth_streams, synth_total_ops
 
     d, k, s, m = args.docs, args.ops_per_doc, args.slots, args.marks
+    if args.layout == "ragged":
+        # the ragged store pages the element planes: round the shared slot
+        # capacity to a page multiple so both layouts overflow at the same
+        # op (cap = page_count * P must be able to equal S exactly)
+        from peritext_tpu.store import DEFAULT_PAGE_SIZE
+
+        s = -(-s // DEFAULT_PAGE_SIZE) * DEFAULT_PAGE_SIZE
     # op mix matching the fuzz distribution: ~70% inserts, 15% deletes, 15% marks
     ki = int(k * 0.7)
     kd = int(k * 0.15)
@@ -219,6 +226,16 @@ def run(args) -> dict:
     result = apply_jit(state0, ops_dev)
     sync(result)
     compile_time = time.perf_counter() - compile_start
+
+    if args.layout == "ragged":
+        # the batch_8k_ragged row (ISSUE 12): same streams, same protocol,
+        # but the apply runs ragged over a page pool — the padded result
+        # just computed is its byte-equality oracle
+        return _batch_ragged_tail(
+            args, ops_dev, state0, apply_jit, sync, result, total_ops,
+            gen_time, compile_time, d=d, s=s, mark_cap=max(m, km),
+            tomb_cap=max(kd, 8),
+        )
 
     # single_call_seconds DEFINITION (stable across rounds; VERDICT r4 task
     # 7): wall time of ONE whole-batch apply dispatch through to a host
@@ -287,6 +304,122 @@ def run(args) -> dict:
         "overflow_docs": overflow,
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0]),
+    }
+
+
+def _batch_ragged_tail(args, ops_dev, state0, apply_jit, sync, oracle,
+                       total_ops, gen_time, padded_compile_s, *, d, s,
+                       mark_cap, tomb_cap) -> dict:
+    """layout=ragged variant of the batch row (ISSUE 12): the SAME synth
+    streams apply through ops/ragged.py directly against a page pool — one
+    compiled program for the whole batch, per-doc op/page counts as data —
+    with the padded apply just computed as the byte-equality oracle, then
+    the identical steady-state enqueue/sync protocol.  ``vs_baseline`` is
+    measured in-row against the padded apply under the same protocol (one
+    pass of ``--iters``), so the row gates the ragged/padded ratio, not
+    two machines' clocks."""
+    import jax
+    import jax.numpy as jnp
+
+    from peritext_tpu.ops.kernel import PAGED_AUX_FIELDS
+    from peritext_tpu.ops.ragged import apply_batch_ragged_jit, plan_arrays
+    from peritext_tpu.store import DEFAULT_PAGE_SIZE
+    from peritext_tpu.store.paged import PagedDocStore
+    from peritext_tpu.store.ragged import ragged_plan
+
+    ins_counts = np.count_nonzero(np.asarray(ops_dev[1]), axis=1)
+    del_counts = np.count_nonzero(np.asarray(ops_dev[3]), axis=1)
+    max_pages = max(1, s // DEFAULT_PAGE_SIZE)
+    need = np.minimum(
+        -(-np.maximum(ins_counts, 1) // DEFAULT_PAGE_SIZE), max_pages
+    )
+    # pre-sized pool: growth mid-run would change the pool shape (an honest
+    # recompile); sizing is the deployer's lever, shape stability the row's
+    store = PagedDocStore(
+        d, s, mark_cap, tomb_capacity=tomb_cap,
+        initial_pages=1 + int(need.sum()),
+    )
+    rows = np.arange(d, dtype=np.int64)
+    store.ensure_rows(rows, ins_counts)
+    planes = plan_arrays(ragged_plan(store))
+    ic_dev = jnp.asarray(ins_counts, jnp.int32)
+    dc_dev = jnp.asarray(del_counts, jnp.int32)
+    pool0 = (store.pool_elem, store.pool_char, store.aux)
+
+    def apply_ragged():
+        # nodonate: every dispatch re-applies the round to the SAME empty
+        # pool, exactly as the padded loop re-applies to state0
+        return apply_batch_ragged_jit(
+            *pool0, *planes, ops_dev, ic_dev, dc_dev, donate=False,
+        )
+
+    ns_i = PAGED_AUX_FIELDS.index("num_slots")
+
+    def sync_ragged(out):
+        return np.asarray(out[2][ns_i])
+
+    t0 = time.perf_counter()
+    out = apply_ragged()
+    sync_ragged(out)
+    ragged_compile = time.perf_counter() - t0
+
+    # byte equality, field by field: materialize the pool back to the
+    # padded (D, S) view (widths match — S is a page multiple here, so
+    # max_doc_pages * P == S) and compare against the padded oracle
+    store.pool_elem, store.pool_char, store.aux = out
+    got = store.materialize_rows(rows, bucket_pages=store.max_doc_pages)
+    for f in oracle._fields:
+        a = np.asarray(getattr(oracle, f))
+        b = np.asarray(getattr(got, f))
+        if f in ("elem_id", "char"):
+            b = b[:, : a.shape[1]]
+        assert np.array_equal(a, b), f"ragged apply diverged on {f}"
+    overflow = int(np.asarray(got.overflow).sum())
+
+    t0 = time.perf_counter()
+    sync_ragged(apply_ragged())
+    single_call = time.perf_counter() - t0
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = apply_ragged()
+        sync_ragged(out)
+        times.append(time.perf_counter() - t0)
+    best = min(times) / args.iters
+    value = total_ops / best
+
+    # the in-row padded baseline: one pass of the same protocol (the full
+    # 3-pass padded measurement is the batch_8k row's job, not this one's)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        res = apply_jit(state0, ops_dev)
+    sync(res)
+    padded_best = (time.perf_counter() - t0) / args.iters
+
+    pool = store.pool_stats()
+    return {
+        "metric": "ragged_crdt_ops_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(padded_best / best, 2),
+        "baseline_impl": "same synth batch through the padded (D, S) apply "
+                         "(one pass of the same enqueue/sync protocol)",
+        "baseline_ops_per_sec": round(total_ops / padded_best, 1),
+        "byte_equal": True,
+        "docs": d,
+        "ops_per_doc": args.ops_per_doc,
+        "slot_capacity": s,
+        "apply_seconds": round(best, 4),
+        "single_call_seconds": round(single_call, 4),
+        "padded_apply_seconds": round(padded_best, 4),
+        "compile_seconds": round(ragged_compile, 1),
+        "padded_compile_seconds": round(padded_compile_s, 1),
+        "overflow_docs": overflow,
+        "page_pool": pool,
+        "workload_gen_seconds": round(gen_time, 1),
+        "platform": jax.devices()[0].platform,
     }
 
 
@@ -774,7 +907,7 @@ def orchestrate(args, passthrough) -> int:
             "batch": "crdt_ops_per_sec_per_chip",
             "serve": "serve_sustained_docs_per_sec",
             "storm": "reconnect_storm_drain_ops_per_sec",
-            "longdoc": "longdoc_paged_ops_per_sec",
+            "longdoc": "longdoc_ragged_ops_per_sec",
             "markheavy": "markheavy_ops_per_sec",
             "fleet-serve": "fleet_serve_applied_frames_per_sec",
         }
@@ -1307,13 +1440,15 @@ def run_longdoc(args) -> dict:
     because every tweet pays the essay's stream width and slot bucket.
 
     The SAME workload merges through the padded DocBatch (the byte-equality
-    oracle) and the paged DocBatch (store/: page pool + per-doc page
-    tables, size-bucketed groups); the row asserts byte equality, then
-    reports both layouts' wall clock and padded-op waste.  Headline =
-    paged throughput; ``vs_baseline`` = paged/padded speedup; the waste
-    ratio (absolute padded ops burned, padded / paged) is the number the
-    ROADMAP item is gated on.  ``--docs`` sizes the tweet fleet,
-    ``--ops-per-doc`` the essay."""
+    oracle), the paged DocBatch (store/: page pool + per-doc page tables,
+    size-bucketed groups) and the ragged DocBatch (ops/ragged.py: one
+    program over the pool, per-doc counts as data — ISSUE 12); the row
+    asserts byte equality, then reports every layout's wall clock and
+    padded-op waste.  Headline = ragged throughput; ``vs_baseline`` =
+    ragged/paged speedup (the bucket ladder this layout kills);
+    ``vs_padded`` and the waste ratio (absolute padded ops burned,
+    padded / paged; ragged burns ZERO) ride along.  ``--docs`` sizes the
+    tweet fleet, ``--ops-per-doc`` the essay."""
     import jax
 
     if args.platform:
@@ -1359,9 +1494,11 @@ def run_longdoc(args) -> dict:
 
     padded_batch, padded, wall_padded = measure("padded")
     paged_batch, paged, wall_paged = measure("paged")
-    assert padded.spans == paged.spans, "paged layout diverged from padded"
-    assert padded.roots == paged.roots, "paged roots diverged from padded"
-    assert padded.fallback_docs == paged.fallback_docs
+    ragged_batch, ragged, wall_ragged = measure("ragged")
+    for name, rep in (("paged", paged), ("ragged", ragged)):
+        assert padded.spans == rep.spans, f"{name} layout diverged from padded"
+        assert padded.roots == rep.roots, f"{name} roots diverged from padded"
+        assert padded.fallback_docs == rep.fallback_docs
 
     # padded-op waste: absolute padded stream ops burned per layout (the
     # devprof occupancy quantity, derivable here from padding_efficiency)
@@ -1373,14 +1510,18 @@ def run_longdoc(args) -> dict:
 
     waste_padded, cap_padded = wasted(padded)
     waste_paged, cap_paged = wasted(paged)
-    pool = paged_batch.last_store.pool_stats()
-    value = total_ops / wall_paged
+    waste_ragged, cap_ragged = wasted(ragged)
+    pool_paged = paged_batch.last_store.pool_stats()
+    pool = ragged_batch.last_store.pool_stats()
+    value = total_ops / wall_ragged
     return {
-        "metric": "longdoc_paged_ops_per_sec",
+        "metric": "longdoc_ragged_ops_per_sec",
         "value": round(value, 1),
         "unit": "ops/s",
-        "vs_baseline": round(wall_padded / wall_paged, 2),
-        "baseline_impl": "same long-tail workload through the padded layout",
+        "vs_baseline": round(wall_paged / wall_ragged, 2),
+        "baseline_impl": "same long-tail workload through the paged "
+                         "(pow-2 bucketed) layout",
+        "vs_padded": round(wall_padded / wall_ragged, 2),
         "docs": d_small + 1,
         "small_doc_ops": small_ops,
         "big_doc_ops": big_ops,
@@ -1388,15 +1529,20 @@ def run_longdoc(args) -> dict:
         "slot_capacity": slots,
         "byte_equal": True,
         "padded_ops_per_sec": round(total_ops / wall_padded, 1),
+        "paged_ops_per_sec": round(total_ops / wall_paged, 1),
         "wall_padded_s": round(wall_padded, 3),
         "wall_paged_s": round(wall_paged, 3),
+        "wall_ragged_s": round(wall_ragged, 3),
         "stream_capacity_padded": round(cap_padded),
         "stream_capacity_paged": round(cap_paged),
+        "stream_capacity_ragged": round(cap_ragged),
         "padded_ops_wasted": round(waste_padded),
         "paged_ops_wasted": round(waste_paged),
+        "ragged_ops_wasted": round(waste_ragged),
         "waste_ratio": round(waste_padded / waste_paged, 2) if waste_paged else None,
         "state_slots_padded": (d_small + 1) * slots,
-        "state_slots_paged": pool["pages_in_use"] * pool["page_size"],
+        "state_slots_paged": pool_paged["pages_in_use"] * pool_paged["page_size"],
+        "state_slots_ragged": pool["pages_in_use"] * pool["page_size"],
         "page_pool": pool,
         "workload_gen_seconds": round(gen_time, 1),
         "platform": jax.devices()[0].platform,
@@ -1627,6 +1773,10 @@ def ladder_rows(platform: str):
     return [
         ("baselines",    "1",  ["--mode", "baselines"], "cpu", t),
         ("batch_8k",     "4",  ["--mode", "batch"], platform, t),
+        # the ragged twin (ISSUE 12): same synth batch, one program over
+        # the page pool, padded byte-equality asserted in-row
+        ("batch_8k_ragged", "4r", ["--mode", "batch", "--layout", "ragged"],
+         platform, t),
         ("streaming",    "5",  ["--mode", "streaming"], platform, t),
         ("streaming_fused", "5f", ["--mode", "streaming-fused"], platform, t),
         ("wire",         "-",  ["--mode", "wire"], "cpu", t),
@@ -1877,9 +2027,10 @@ def main() -> None:
         "--platform", default=None, help="force a jax platform (e.g. cpu)"
     )
     parser.add_argument(
-        "--layout", choices=("padded", "paged"), default="padded",
-        help="resident-state storage layout for the sweep row (the longdoc "
-             "row always measures both; other rows are padded-only)",
+        "--layout", choices=("padded", "paged", "ragged"), default="padded",
+        help="resident-state storage layout for the sweep row and (ragged "
+             "only) the batch row's one-program-over-the-pool variant; the "
+             "longdoc row always measures all three layouts itself",
     )
     parser.add_argument(
         "--profile", default=None, metavar="DIR",
@@ -1916,10 +2067,14 @@ def main() -> None:
         # only the streaming runner consumes it; anything else would both
         # skip the default ladder AND silently write no trace
         parser.error("--trace-out requires --mode streaming")
-    if args.layout != "padded" and args.mode != "sweep":
-        # only the sweep runner consumes it (longdoc measures both layouts
+    layout_modes = {"paged": ("sweep",), "ragged": ("sweep", "batch")}
+    if args.layout != "padded" and args.mode not in layout_modes[args.layout]:
+        # only these runners consume it (longdoc measures every layout
         # itself); anything else would silently measure the padded layout
-        parser.error("--layout requires --mode sweep")
+        parser.error(
+            f"--layout {args.layout} requires --mode "
+            + "/".join(layout_modes[args.layout])
+        )
 
     explicit_sizing = (
         any(v is not None for v in (args.docs, args.ops_per_doc, args.slots,

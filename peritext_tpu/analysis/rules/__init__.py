@@ -11,6 +11,7 @@ from .ptl003_host_sync import HostSyncRule
 from .ptl004_recompile_hazard import RecompileHazardRule
 from .ptl005_broad_except import BroadExceptRule
 from .ptl006_nondeterminism import NondeterminismRule
+from .ptl007_ragged_bucket_free import RaggedBucketFreeRule
 
 ALL_RULES: Dict[str, Rule] = {
     rule.rule_id: rule
@@ -21,5 +22,6 @@ ALL_RULES: Dict[str, Rule] = {
         RecompileHazardRule(),
         BroadExceptRule(),
         NondeterminismRule(),
+        RaggedBucketFreeRule(),
     )
 }

@@ -88,23 +88,28 @@ class DocBatch:
         page_size: Optional[int] = None,
     ) -> None:
         #: storage layout: "padded" (one (D, S) batch, every doc at the
-        #: widest bucket — the byte-equality oracle) or "paged" (store/
+        #: widest bucket — the byte-equality oracle), "paged" (store/
         #: page pool + per-doc page tables; docs group by size bucket so
-        #: stream padding AND element-plane memory scale with real ops).
-        if layout not in ("padded", "paged"):
+        #: stream padding AND element-plane memory scale with real ops),
+        #: or "ragged" (same pool, but ONE apply over every doc's true op
+        #: and page counts — no bucket ladder, one compiled program; see
+        #: ops/ragged.py).
+        if layout not in ("padded", "paged", "ragged"):
             raise ValueError(f"unknown layout: {layout!r}")
-        if layout == "paged" and mesh is not None:
-            raise ValueError("layout='paged' does not support a mesh yet")
+        if layout in ("paged", "ragged") and mesh is not None:
+            raise ValueError(
+                f"layout={layout!r} does not support a mesh yet"
+            )
         self.layout = layout
         if page_size is None:
             from ..store import DEFAULT_PAGE_SIZE
 
             page_size = DEFAULT_PAGE_SIZE
         self.page_size = int(page_size)
-        if layout == "paged" and slot_capacity % self.page_size:
+        if layout in ("paged", "ragged") and slot_capacity % self.page_size:
             raise ValueError(
                 f"slot_capacity {slot_capacity} must be a multiple of "
-                f"page_size {self.page_size} under layout='paged'"
+                f"page_size {self.page_size} under layout={layout!r}"
             )
         #: pipeline-span producer (obs/spans.py): merge() opens a
         #: ``batch.merge`` span with encode/apply/resolve/decode children,
@@ -186,6 +191,8 @@ class DocBatch:
         with self.tracer.span("batch.merge", docs=len(workloads)) as sp:
             if self.layout == "paged":
                 report = self._merge_paged(workloads, cursors)
+            elif self.layout == "ragged":
+                report = self._merge_ragged(workloads, cursors)
             else:
                 report = self._merge(workloads, cursors)
         GLOBAL_HISTOGRAMS.observe("merge.seconds", sp.duration)
@@ -567,6 +574,214 @@ class DocBatch:
             GLOBAL_DEVPROF.sample_memory()
         GLOBAL_COUNTERS.add("merge.calls")
         GLOBAL_COUNTERS.add("merge.paged_calls")
+        GLOBAL_COUNTERS.add("merge.device_ops", device_ops)
+        GLOBAL_COUNTERS.add("merge.fallback_docs", len(fallback))
+        return MergeReport(
+            spans=spans,
+            fallback_docs=sorted(fallback),
+            device_ops=device_ops,
+            stats=stats,
+            cursor_positions=cursor_positions,
+            roots=roots,
+        )
+
+    # -- ragged layout (ops/ragged.py over store/) ----------------------------
+
+    def _merge_ragged(
+        self,
+        workloads: Sequence[Workload],
+        cursors: Optional[Sequence[Sequence[dict]]],
+    ) -> MergeReport:
+        """merge() under ``layout="ragged"``: the whole batch is ONE group.
+        Streams pad once to the batch's own true maxima, the page pool is
+        pre-sized to the batch's true page demand, and a single
+        ``ops/ragged.apply_batch_ragged`` dispatch walks every doc's true
+        op count against its true pages — no power-of-two buckets anywhere,
+        so the whole merge compiles exactly one apply executable regardless
+        of the doc-size mix.  The padded path stays the byte-equality
+        oracle, exactly as for "paged"."""
+        import jax.numpy as jnp
+
+        from ..ops.encode import _EMPTY_STREAMS, encode_doc_streams, pad_doc_streams
+        from ..ops.ragged import apply_batch_ragged_jit, plan_arrays
+        from ..store.paged import PagedDocStore, group_stream_arrays
+        from ..store.ragged import ragged_plan
+
+        stats = MergeStats(docs=len(workloads))
+        d_total = len(workloads)
+        with self.tracer.span("batch.encode") as sp:
+            per_doc, fb_encode, actor_tables, attr_tables, map_tables = (
+                encode_doc_streams(workloads)
+            )
+            fb_set = set(fb_encode)
+            # per-doc capacity fallback thresholds: identical to the paged
+            # path so the same docs fall back under every layout
+            for d in range(d_total):
+                s = per_doc[d]
+                over = len(s.marks) > self.mark_capacity
+                if self.op_capacity is not None:
+                    over = over or len(s.ins) > self.op_capacity \
+                        or len(s.dels) > self.op_capacity
+                if over:
+                    fb_set.add(d)
+            enc = pad_doc_streams(
+                [_EMPTY_STREAMS if d in fb_set else per_doc[d]
+                 for d in range(d_total)],
+                sorted(fb_set),
+                actor_tables,
+                attr_tables,
+                map_tables=map_tables,
+            )
+        stats.encode_seconds = sp.duration
+
+        try:
+            with self.tracer.span("batch.apply") as sp:
+                ins_counts = (np.asarray(enc.ins_op) != 0).sum(axis=1)
+                del_counts = (np.asarray(enc.del_target) != 0).sum(axis=1)
+                max_pages = max(1, self.slot_capacity // self.page_size)
+                page_need = np.minimum(
+                    -(-np.maximum(ins_counts, 1) // self.page_size), max_pages
+                )
+                store = PagedDocStore(
+                    d_total,
+                    slot_capacity=self.slot_capacity,
+                    mark_capacity=self.mark_capacity,
+                    tomb_capacity=enc.del_target.shape[1],
+                    map_capacity=self.map_capacity,
+                    page_size=self.page_size,
+                    # page 0 is the null page; true demand, no bucket round
+                    initial_pages=1 + int(page_need.sum()),
+                )
+                self.last_store = store
+                rows = np.arange(d_total, dtype=np.int64)
+                store.ensure_rows(rows, ins_counts)
+                plan = ragged_plan(store)
+                row_idx, owner, pos_base, prev_page, page_count, page_table = (
+                    plan_arrays(plan)
+                )
+                store.pool_elem, store.pool_char, store.aux = (
+                    apply_batch_ragged_jit(
+                        store.pool_elem, store.pool_char, store.aux,
+                        row_idx, owner, pos_base, prev_page, page_count,
+                        page_table,
+                        group_stream_arrays(enc, None, d_total),
+                        jnp.asarray(ins_counts, jnp.int32),
+                        jnp.asarray(del_counts, jnp.int32),
+                    )
+                )
+                real_ops = int(enc.num_ops.sum())
+                widths = (
+                    enc.ins_op.shape[1], enc.del_target.shape[1],
+                    next(iter(enc.marks.values())).shape[1],
+                    next(iter(enc.map_ops.values())).shape[1],
+                )
+                if GLOBAL_DEVPROF.enabled:
+                    # ragged pays real ops only: capacity IS the real work
+                    GLOBAL_DEVPROF.observe_round(
+                        occupancy_key(d_total, *widths),
+                        real_ops, max(real_ops, 1),
+                        origin="batch.merge.ragged",
+                    )
+                    GLOBAL_DEVPROF.observe_ragged(
+                        docs_walked=plan.docs_walked,
+                        pages_walked=plan.pages_walked,
+                        real_ops=real_ops,
+                    )
+                # host sync: time apply honestly (mirror of _merge)
+                np.asarray(store.aux_field("num_slots"))
+            stats.apply_seconds = sp.duration
+
+            with self.tracer.span("batch.resolve") as sp:
+                # one materialize at the batch's true max page count — the
+                # only place the ragged merge builds a dense block, and it
+                # is sized by the data, not a bucket
+                g_max = max(1, int(np.max(np.asarray(plan.page_count))))
+                state = store.materialize_rows(rows, g_max)
+                resolved_dev = self._resolve(state, self.comment_capacity)
+                resolved = type(resolved_dev)(
+                    *(np.asarray(x) for x in resolved_dev)
+                )
+            stats.resolve_seconds = sp.duration
+        except Exception as exc:  # graftlint: boundary(guarded merge: ANY device-path failure degrades to the scalar oracle; re-raised when unguarded)
+            if not self.guard:
+                raise
+            return self._degraded_merge(workloads, cursors, stats, exc)
+
+        overflow = np.asarray(resolved.overflow)
+        fallback = fb_set | set(enc.fallback_docs) | {
+            int(d) for d in np.nonzero(overflow)[0] if d < d_total
+        }
+
+        oracle_docs: Dict[int, Doc] = {}
+
+        def oracle_doc_for(d: int) -> Doc:
+            if d not in oracle_docs:
+                oracle_docs[d] = _oracle_doc(workloads[d])
+            return oracle_docs[d]
+
+        # row i IS doc i (one group, no bucket permutation), so the padded
+        # path's batch cursor resolver applies verbatim
+        cursor_positions: Optional[List[List[int]]] = None
+        if cursors is not None:
+            cursor_positions = self._resolve_cursor_batch(
+                state, resolved_dev.visible, enc, cursors, fallback,
+                oracle_doc_for,
+            )
+
+        with self.tracer.span("batch.decode") as sp:
+            from types import SimpleNamespace
+
+            from ..ops.decode import decode_doc_root
+
+            device_mask = np.zeros(resolved.visible.shape[0], bool)
+            for d in range(d_total):
+                device_mask[d] = d not in fallback
+            block_spans = decode_block_spans(
+                resolved,
+                lambda d: enc.attr_tables[d],
+                lambda d: enc.attr_tables[d],
+                doc_mask=device_mask,
+            )
+            regs = SimpleNamespace(
+                r_obj=np.asarray(state.r_obj), r_key=np.asarray(state.r_key),
+                r_op=np.asarray(state.r_op), r_kind=np.asarray(state.r_kind),
+                r_val=np.asarray(state.r_val),
+                num_regs=np.asarray(state.num_regs),
+            )
+            spans: List[List[FormatSpan]] = []
+            roots: List[dict] = []
+            device_ops = 0
+            fallback_ops = 0
+            for d, workload in enumerate(workloads):
+                if d in fallback:
+                    doc = oracle_doc_for(d)
+                    spans.append(doc.get_text_with_formatting(["text"]))
+                    roots.append(doc.root)
+                    fallback_ops += int(enc.num_ops[d])
+                else:
+                    spans.append(block_spans[d])
+                    roots.append(
+                        decode_doc_root(regs, resolved, d, enc.map_tables[d])
+                    )
+                    device_ops += int(enc.num_ops[d])
+        stats.decode_seconds = sp.duration
+
+        stats.device_ops = device_ops
+        stats.fallback_ops = fallback_ops
+        stats.fallback_docs = len(fallback)
+        stats.device_docs = d_total - len(fallback)
+        # no pow-2 row bucket, no padded stream slots dispatched: the apply
+        # walks true counts, so the occupancy ratio is 1.0 by construction
+        stats.padding_efficiency = 1.0 if real_ops else 0.0
+        pool = store.pool_stats()
+        stats.extras["layout_ragged"] = 1.0
+        stats.extras["page_pool_utilization"] = pool["pool_utilization"]
+        stats.extras["page_internal_frag_ratio"] = pool["internal_frag_ratio"]
+        if GLOBAL_DEVPROF.enabled:
+            GLOBAL_DEVPROF.observe_page_pool(pool)
+            GLOBAL_DEVPROF.sample_memory()
+        GLOBAL_COUNTERS.add("merge.calls")
+        GLOBAL_COUNTERS.add("merge.ragged_calls")
         GLOBAL_COUNTERS.add("merge.device_ops", device_ops)
         GLOBAL_COUNTERS.add("merge.fallback_docs", len(fallback))
         return MergeReport(

@@ -149,6 +149,7 @@ class DeviceProfiler:
         self._mem_backend_peak: Optional[int] = None
         self._page_pool: Optional[Dict[str, Any]] = None
         self._page_pool_peak_util = 0.0
+        self._ragged: Optional[Dict[str, int]] = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -171,6 +172,7 @@ class DeviceProfiler:
             self._mem_backend_peak = None
             self._page_pool = None
             self._page_pool_peak_util = 0.0
+            self._ragged = None
 
     def __enter__(self) -> "DeviceProfiler":
         return self.enable()
@@ -283,6 +285,28 @@ class DeviceProfiler:
             util = float(stats.get("pool_utilization") or 0.0)
             self._page_pool_peak_util = max(self._page_pool_peak_util, util)
 
+    def observe_ragged(self, docs_walked: int, pages_walked: int,
+                       real_ops: int, padded_slot_waste: int = 0,
+                       dispatches: int = 1) -> None:
+        """Fold one ragged apply's plan stats in (ops/ragged callers report
+        after each dispatch): docs and pool pages the plan walked, the real
+        ops applied, and any padded-slot waste — which the ragged layout
+        keeps at ~0 by construction (true counts are loop bounds, not
+        shapes), making this section the bucket-occupancy table's
+        counterpoint."""
+        with self._lock:
+            if self._ragged is None:
+                self._ragged = {
+                    "dispatches": 0, "docs_walked": 0, "pages_walked": 0,
+                    "real_ops": 0, "padded_slot_waste": 0,
+                }
+            r = self._ragged
+            r["dispatches"] += int(dispatches)
+            r["docs_walked"] += int(docs_walked)
+            r["pages_walked"] += int(pages_walked)
+            r["real_ops"] += int(real_ops)
+            r["padded_slot_waste"] += int(padded_slot_waste)
+
     # -- device-memory watermarks -------------------------------------------
 
     def sample_memory(self) -> Optional[int]:
@@ -354,6 +378,7 @@ class DeviceProfiler:
                 if self._page_pool is not None
                 else None
             )
+            ragged = dict(self._ragged) if self._ragged is not None else None
         return {
             "enabled": self.enabled,
             "capture_costs": self.capture_costs,
@@ -369,6 +394,8 @@ class DeviceProfiler:
             # None until a paged store reports in — padded-only processes
             # export no page section (the golden-shape test pins both forms)
             "page_pool": page_pool,
+            # None until a ragged apply reports in (same discipline)
+            "ragged": ragged,
         }
 
 

@@ -185,6 +185,21 @@ def prometheus_text(
             lines.append(f"# TYPE {m} gauge")
             for decile, value in sorted(pp.get("frag_by_decile", {}).items()):
                 lines.append(f'{m}{{decile="{decile}"}} {_fmt(value)}')
+        rg = dp.get("ragged")
+        if rg:
+            # ragged-apply gauges (ops/ragged.py dispatches): how much of
+            # the pool each one-program round actually walked.  The waste
+            # gauge is the layout's headline — identically 0 padded slots
+            # dispatched, vs the bucket ladder's pow-2 pad
+            for m, value in (
+                ("peritext_ragged_dispatches", rg["dispatches"]),
+                ("peritext_ragged_docs_walked", rg["docs_walked"]),
+                ("peritext_ragged_pages_walked", rg["pages_walked"]),
+                ("peritext_ragged_real_ops", rg["real_ops"]),
+                ("peritext_ragged_padded_slot_waste", rg["padded_slot_waste"]),
+            ):
+                lines.append(f"# TYPE {m} gauge")
+                lines.append(f"{m} {_fmt(value)}")
         mem = dp["memory"]
         if mem["available"]:
             for m, value in (

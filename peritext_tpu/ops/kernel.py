@@ -118,14 +118,21 @@ def _apply_doc(state: PackedDocs, ins_ref, ins_op, ins_char, del_target, mark_ro
     )
 
 
-def _post_insert_doc(state: PackedDocs, del_target, mark_rows, mark_count):
-    """Phases 2+3 (deletes, marks) for one doc, after the insert phase."""
+def _post_insert_doc(state: PackedDocs, del_target, mark_rows, mark_count,
+                     exists=None):
+    """Phases 2+3 (deletes, marks) for one doc, after the insert phase.
+
+    ``exists`` optionally carries a precomputed (KD,) target-exists mask so
+    callers whose element planes do NOT live in ``state`` (the ragged pool
+    walk, ops/ragged.py) can reuse these phases on a dummy-elem state; with
+    it given, ``state.elem_id`` is never read."""
     elem, n, ov = state.elem_id, state.num_slots, state.overflow
 
     # Deletes: validate targets exist, then append to the tombstone table
     # (dedup against rows already there keeps re-delivery idempotent).
     live = del_target != 0
-    exists = jnp.any(elem[:, None] == del_target[None, :], axis=0)  # (KD,)
+    if exists is None:
+        exists = jnp.any(elem[:, None] == del_target[None, :], axis=0)  # (KD,)
     # Idempotence: skip targets already tombstoned in the carried-over table
     # AND duplicates within this stream (concurrent deletes of one char).
     kd = del_target.shape[0]
@@ -787,6 +794,24 @@ def resolve_insert_impl(*arrays, platform: str | None = None) -> str:
     the default backend — callers jitting over a non-default mesh must pass
     ``insert_impl`` explicitly.
     """
+    if platform is None:
+        for a in arrays:
+            sharding = getattr(a, "sharding", None)
+            device_set = getattr(sharding, "device_set", None)
+            if device_set:
+                platform = next(iter(device_set)).platform
+                break
+    if platform is None:
+        platform = jax.default_backend()
+    return "pallas" if platform == "tpu" else "lax"
+
+
+def resolve_ragged_impl(*arrays, platform: str | None = None) -> str:
+    """Pick the ragged pool-walk implementation (ops/ragged.py) for where
+    the pool actually lives — the :func:`resolve_insert_impl` sniffing
+    discipline, with the same pallas-iff-TPU outcome: ``"pallas"`` walks
+    pages with the ragged Pallas grid, ``"lax"`` is the dense pool-walk
+    fallback every CPU path (tier-1, interpret smokes) runs."""
     if platform is None:
         for a in arrays:
             sharding = getattr(a, "sharding", None)

@@ -305,11 +305,11 @@ resolve_cursors_jit = jax.jit(resolve_cursors)
 
 def cursor_width_bucket(needed: int) -> int:
     """Power-of-two cursor-axis width so varying cursor counts across calls
-    reuse one compiled resolve_cursors program."""
-    width = 4
-    while width < needed:
-        width *= 2
-    return width
+    reuse one compiled resolve_cursors program (canonical spelling:
+    utils/shapes.next_pow2, floor 4)."""
+    from ..utils.shapes import next_pow2
+
+    return next_pow2(needed, floor=4)
 
 
 def pack_cursor_rows(cursor_map, num_docs: int, actor_table_for) -> "np.ndarray":

@@ -87,6 +87,7 @@ from ..ops.kernel import (
 from ..ops.packed import PackedDocs, empty_docs
 from ..ops.resolve import resolve, resolve_jit
 from ..utils.interning import Interner, OrderedActorTable
+from ..utils.shapes import next_pow2
 from .causal import causal_schedule
 from .codec import decode_frame, encode_frame, strip_trace_context
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -457,11 +458,9 @@ class _BlockResolution:
 
 
 def _width_bucket(n: int) -> int:
-    """Power-of-two table width so growing interners reuse compiled digests."""
-    w = 8
-    while w < n:
-        w *= 2
-    return w
+    """Power-of-two table width so growing interners reuse compiled digests
+    (canonical spelling: utils/shapes.next_pow2, floor 8)."""
+    return next_pow2(n, floor=8)
 
 
 #: byte budget for the per-(round, epoch) CompactBlock cache — 100K docs of
@@ -550,12 +549,14 @@ class StreamingMerge:
     undeclared actor demotes that doc to scalar-replay fallback).
 
     ``layout`` selects the resident-state storage: ``"padded"`` (this
-    class: one (D, S) element batch, every doc at the slot capacity) or
+    class: one (D, S) element batch, every doc at the slot capacity),
     ``"paged"`` (store/session.PagedStreamingMerge: a global op-page pool
     + per-doc page tables, gathered per round at each doc's own size
-    bucket).  The constructor is the factory — ``StreamingMerge(...,
-    layout="paged")`` builds the paged subclass; the padded layout remains
-    the byte-equality oracle.
+    bucket), or ``"ragged"`` (store/session.RaggedStreamingMerge: the same
+    pool applied IN PLACE by ops/ragged — no buckets, one compiled apply
+    for any doc mix).  The constructor is the factory — ``StreamingMerge(
+    ..., layout="paged")`` builds the matching subclass; the padded layout
+    remains the byte-equality oracle.
     """
 
     #: storage layout of this class (the paged subclass overrides)
@@ -563,12 +564,16 @@ class StreamingMerge:
 
     def __new__(cls, *args, **kwargs):
         layout = kwargs.get("layout", "padded")
-        if layout not in ("padded", "paged"):
+        if layout not in ("padded", "paged", "ragged"):
             raise ValueError(f"unknown layout: {layout!r}")
         if cls is StreamingMerge and layout == "paged":
             from ..store.session import PagedStreamingMerge
 
             return super().__new__(PagedStreamingMerge)
+        if cls is StreamingMerge and layout == "ragged":
+            from ..store.session import RaggedStreamingMerge
+
+            return super().__new__(RaggedStreamingMerge)
         return super().__new__(cls)
 
     def __init__(
@@ -3151,7 +3156,7 @@ class StreamingMerge:
 
     @property
     def layout(self) -> str:
-        """Resident-state storage layout ("padded" or "paged")."""
+        """Resident-state storage layout ("padded", "paged" or "ragged")."""
         return self._layout
 
     def sync_device(self) -> None:

@@ -448,8 +448,12 @@ class SessionMux:
         snap = {
             "host": self.host,
             # the backing session's storage layout — a fleet scrape must be
-            # able to tell paged serving hosts (page-pool gauges live) from
-            # padded ones without a second endpoint
+            # able to tell paged/ragged serving hosts (page-pool gauges
+            # live; ragged adds the peritext_ragged_* walk gauges) from
+            # padded ones without a second endpoint.  On "ragged" the mux's
+            # staged drains route through the same prep/stage/dispatch trio
+            # but every round is the ONE pool-wide ragged program — a
+            # serving host never compiles a bucket ladder.
             "layout": getattr(self.session, "layout", "padded"),
             # whether serving rounds commit through the fused
             # device-resident pipeline (donated multi-round programs +

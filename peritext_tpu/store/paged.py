@@ -39,6 +39,7 @@ from ..ops.kernel import (
     gather_paged_state_jit,
 )
 from ..ops.packed import PackedDocs, empty_docs
+from ..utils.shapes import next_pow2
 from .alloc import PageAllocator, PoolExhausted
 
 #: Default op-page width.  Chosen from the PR-5 devprof cost snapshots (see
@@ -51,11 +52,11 @@ DEFAULT_PAGE_SIZE = 64
 
 
 def _pow2(n: int) -> int:
-    """Smallest power of two >= n (floor 1 — page counts, not stream widths)."""
-    w = 1
-    while w < n:
-        w *= 2
-    return w
+    """Smallest power of two >= n (floor 1 — page counts, not stream
+    widths).  Delegates to the one canonical spelling
+    (:func:`peritext_tpu.utils.shapes.next_pow2`); kept under its
+    historical name because the session/batch layers import it from here."""
+    return next_pow2(n, floor=1)
 
 
 def plan_page_groups(
@@ -160,6 +161,10 @@ class PagedDocStore:
         #: pool growths so far (each one is a fresh device allocation and a
         #: new program shape — telemetry wants to see them)
         self.growths = 0
+        #: bumped whenever any page table (or the pool size) changes —
+        #: ragged callers key their plan caches on (alloc_epoch, pool size)
+        #: so stale owner/pos_base planes can never reach a dispatch
+        self.alloc_epoch = 0
 
     # -- sizing --------------------------------------------------------------
 
@@ -207,6 +212,8 @@ class PagedDocStore:
             if delta > 0 and delta > self.alloc.free_pages:
                 self._grow_pool(self.alloc.pages_in_use + self.alloc.reserved + delta)
             self.alloc.ensure(row, need)
+            if delta > 0:
+                self.alloc_epoch += 1
             self._num_pages[row] = self.alloc.num_pages(row)
             self._used_hint[row] = max(self._used_hint[row], int(used))
 
@@ -224,6 +231,7 @@ class PagedDocStore:
             self.pool_elem = jnp.concatenate([self.pool_elem, pad], axis=0)
             self.pool_char = jnp.concatenate([self.pool_char, pad], axis=0)
             self.growths += 1
+            self.alloc_epoch += 1
 
     def page_rows(self, rows: Sequence[int], bucket_pages: int,
                   pad_rows_to: Optional[int] = None) -> np.ndarray:
@@ -306,6 +314,8 @@ class PagedDocStore:
         self.aux = tuple(
             a.at[r].set(jnp.zeros((), a.dtype)) for a in self.aux
         )
+        if pages:
+            self.alloc_epoch += 1
         self._num_pages[r] = 0
         self._used_hint[r] = 0
         return len(pages)
@@ -325,6 +335,8 @@ class PagedDocStore:
             self.pool_elem = jnp.take(self.pool_elem, idx, axis=0)
             self.pool_char = jnp.take(self.pool_char, idx, axis=0)
         self.alloc.apply_compact(mapping)
+        if moved:
+            self.alloc_epoch += 1
         self._num_pages[:] = 0
         for doc in self.alloc.docs():
             self._num_pages[doc] = self.alloc.num_pages(doc)
@@ -345,6 +357,7 @@ class PagedDocStore:
         self.aux = tuple(jnp.take(a, idx, axis=0) for a in self.aux)
         self._num_pages = self._num_pages[src]
         self._used_hint = self._used_hint[src]
+        self.alloc_epoch += 1
 
     # -- telemetry -----------------------------------------------------------
 

@@ -1,0 +1,107 @@
+"""Ragged plan: the flat doc-index + page-table view of the page pool.
+
+The paged dispatch plan (store/paged.plan_page_groups) buckets touched rows
+by power-of-two page count and pads each group's row axis to a power of two
+— the compile-cache discipline that keeps the gather/apply/scatter variant
+family logarithmic.  The ragged apply (ops/ragged.py) needs none of that:
+it walks the pool IN PLACE, so the only shapes the compiled program sees
+are the pool itself and the round's stream staging — per-doc true op and
+page counts ride in as *data* (traced loop bounds and plan planes), never
+as shapes.
+
+This module builds that plan: three ``(N_pages,)`` planes over the pool —
+
+* ``owner``      — which batch-local row each pool page belongs to
+  (``num_rows`` = unowned: the null page, free pages, and pages of docs
+  outside the batch — the apply's inert segment),
+* ``pos_base``   — the page's first slot position within its doc
+  (``page_index_within_doc * page_size``),
+* ``prev_page``  — the preceding page in the same doc (first pages point at
+  the null page 0, whose lanes are always zero),
+
+plus the per-row ``page_count`` (true allocation, no rounding) and the flat
+``row_idx``.  Everything is a pure function of the allocator state; the
+plan snapshots at build time exactly like ``PagedDocStore.group_plan``, so
+later growth never leaks into a planned dispatch.
+
+Deliberately bucket-free: no import of ``_pow2`` / ``next_pow2`` /
+``_width_bucket`` may appear here or in ops/ragged.py — enforced by
+graftlint rule PTL007.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RaggedPlan:
+    """One ragged dispatch's host-side plan (module doc)."""
+
+    #: batch rows (B,) — the ``owner`` sentinel is ``num_rows``
+    row_idx: np.ndarray
+    #: (N_pages,) batch-local owner per pool page (num_rows = unowned)
+    owner: np.ndarray
+    #: (N_pages,) first slot position of the page within its doc
+    pos_base: np.ndarray
+    #: (N_pages,) previous page of the same doc (0 = null page)
+    prev_page: np.ndarray
+    #: (B,) true allocated page count per row — no pow-2 rounding
+    page_count: np.ndarray
+    #: (B, max_doc_pages) pool page per (row, doc-page); 0 (the null page)
+    #: pads beyond each row's true count — the ragged Pallas kernel's
+    #: scalar-prefetch plane (its second axis is config-static, so it pins
+    #: no data-dependent shape)
+    page_table: np.ndarray
+    #: pool size the plan was built against (shape pin for the dispatch)
+    pool_pages: int
+    #: grid stats for the ``peritext_ragged_*`` gauges
+    docs_walked: int
+    pages_walked: int
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.row_idx.shape[0])
+
+
+def ragged_plan(store, rows: Optional[Sequence[int]] = None) -> RaggedPlan:
+    """Build the ragged pool view for ``rows`` (default: every doc row of
+    ``store``).  Rows must already hold their allocation
+    (``ensure_rows``); rows with no pages are legal — they simply own no
+    pool segment, and any live op for them overflows exactly as the padded
+    oracle's zero-width doc would."""
+    if rows is None:
+        rows = np.arange(store.num_docs, dtype=np.int64)
+    row_idx = np.asarray(rows, np.int64)
+    b = int(row_idx.shape[0])
+    n = int(store.pool_elem.shape[0])
+    p = int(store.page_size)
+    owner = np.full(n, b, np.int32)
+    pos_base = np.zeros(n, np.int32)
+    prev_page = np.zeros(n, np.int32)
+    page_count = np.zeros(b, np.int32)
+    page_table = np.zeros((b, store.max_doc_pages), np.int32)
+    pages_walked = 0
+    for i, row in enumerate(row_idx):
+        pages = store.alloc.pages_of(int(row))
+        page_count[i] = len(pages)
+        pages_walked += len(pages)
+        for k, pg in enumerate(pages):
+            owner[pg] = i
+            pos_base[pg] = k * p
+            prev_page[pg] = pages[k - 1] if k else 0
+            page_table[i, k] = pg
+    return RaggedPlan(
+        row_idx=row_idx,
+        owner=owner,
+        pos_base=pos_base,
+        prev_page=prev_page,
+        page_count=page_count,
+        page_table=page_table,
+        pool_pages=n,
+        docs_walked=b,
+        pages_walked=pages_walked,
+    )

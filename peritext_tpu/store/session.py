@@ -531,3 +531,216 @@ class PagedStreamingMerge(StreamingMerge):
             page_load[min(row // rows_per_shard, n_shards - 1)] += int(pages[row])
         out["page_load"] = page_load
         return out
+
+
+class RaggedStreamingMerge(PagedStreamingMerge):
+    """StreamingMerge over the page pool with the RAGGED apply: every round
+    is ONE ``ops/ragged.apply_batch_ragged`` dispatch straight against pool
+    pages — no page-count buckets, no row-bucket pad, no gather/scatter,
+    and therefore exactly one compiled apply executable per session
+    regardless of the doc-size mix (tests/test_recompile_sentinel.py pins
+    a tweet-fleet + essay + book drain to one program where the paged
+    engine compiles a bucket ladder).
+
+    Storage, reads, digests, compaction and resharding are inherited from
+    :class:`PagedStreamingMerge` unchanged — the pool IS the paged pool,
+    so materialized blocks and the pad-term-corrected digests stay
+    bit-equal to both other layouts.  What changes is only the commit
+    half: the round's streams dispatch over ALL ``D`` doc rows (a static
+    batch axis; untouched rows carry all-zero streams, which the traced
+    per-doc loop bounds make genuinely free, not just masked), with the
+    plan planes (store/ragged.ragged_plan) cached per
+    ``(alloc_epoch, pool size)`` so steady-state rounds re-upload
+    nothing."""
+
+    _layout = "ragged"
+
+    def __init__(self, num_docs, actors, *args,
+                 layout: str = "ragged", **kwargs) -> None:
+        if layout != "ragged":
+            raise ValueError(
+                f"RaggedStreamingMerge is layout='ragged', got {layout!r}"
+            )
+        super().__init__(num_docs, actors, *args, layout="paged", **kwargs)
+        #: (alloc_epoch, pool_pages) -> (RaggedPlan, device plane tuple)
+        self._ragged_cache: tuple = ((-1, -1), None)
+
+    def health(self) -> Dict:
+        h = super().health()
+        h["layout"] = "ragged"
+        return h
+
+    def _round_widths(self, pool, obj_streams, ki, kd, km, kp):
+        """Keep round stream widths FIXED at the session caps (the
+        block-chunked/static_rounds discipline): the ragged apply's trip
+        counts are data, so padded stream slots cost transfer bytes but
+        zero compute — while a shrunk width is a brand-new apply shape.
+        One width set x one pool shape = the ONE executable the recompile
+        sentinel pins."""
+        return ki, kd, km, kp
+
+    # -- the ragged device half of a round -----------------------------------
+
+    def _ragged_planes(self):
+        """The whole-session ragged plan, rebuilt only when the allocator
+        state it snapshots actually changed (ensure growth, evacuation,
+        compaction, permutation, pool growth — anything that bumps
+        ``PagedDocStore.alloc_epoch``)."""
+        from ..ops.ragged import plan_arrays
+        from .ragged import ragged_plan
+
+        store = self._store
+        key = (store.alloc_epoch, int(store.pool_elem.shape[0]))
+        cached_key, cached = self._ragged_cache
+        if cached_key != key:
+            plan = ragged_plan(store)
+            cached = (plan, plan_arrays(plan))
+            self._ragged_cache = (key, cached)
+        return cached
+
+    def _commit_round_ragged(self, enc, widths) -> None:
+        """One round = one ragged dispatch over the whole pool."""
+        from ..ops.ragged import apply_batch_ragged_jit
+
+        store = self._store
+        d = self._padded_docs
+        rows = np.nonzero(enc.num_ops)[0]
+        real = int(enc.num_ops.sum())
+        if len(rows):
+            store.ensure_rows(rows, self._cum_ins[rows])
+        plan, planes = self._ragged_planes()
+        row_idx, owner, pos_base, prev_page, page_count, page_table = planes
+        store.pool_elem, store.pool_char, store.aux = apply_batch_ragged_jit(
+            store.pool_elem, store.pool_char, store.aux,
+            row_idx, owner, pos_base, prev_page, page_count, page_table,
+            group_stream_arrays(enc, None, d),
+            jnp.asarray(enc.ins_count, jnp.int32),
+            jnp.asarray(enc.del_count, jnp.int32),
+        )
+        # ragged pays real ops only: no bucket pad rows, no padded slots —
+        # capacity IS the real work, so padding_efficiency reads 1.0
+        self._commit_caps[id(enc)] = real
+        if GLOBAL_DEVPROF.enabled:
+            GLOBAL_DEVPROF.observe_round(
+                occupancy_key(d, *widths), real, max(real, 1),
+                origin="streaming.ragged",
+            )
+            GLOBAL_DEVPROF.observe_ragged(
+                docs_walked=plan.docs_walked,
+                pages_walked=plan.pages_walked,
+                real_ops=real,
+            )
+        if len(rows):
+            self._digest_row_valid[rows] = False
+        self.rounds += 1
+        GLOBAL_COUNTERS.add("streaming.rounds")
+
+    def _commit_rounds(self, batch) -> None:
+        """Per-round ragged dispatches (a Python loop, ONE executable): a
+        rounds-chained fused program would mint one shape per drain depth,
+        which is exactly the ladder this layout exists to kill.  The fused
+        staged-drain hooks below reuse this same discipline, so serving
+        drains and direct commits share the single compiled apply."""
+        for enc, widths in batch:
+            self._cum_ins += enc.ins_count
+            self._commit_round_ragged(enc, widths)
+        if GLOBAL_DEVPROF.enabled:
+            GLOBAL_DEVPROF.observe_page_pool(self._store.pool_stats())
+
+    def _commit_rounds_serial(self, batch) -> None:
+        self._commit_rounds(batch)
+
+    # -- fused staged-drain hooks (serve/mux.py drains) ----------------------
+    #
+    # The drain loop stages rounds through the prep/stage/dispatch trio so
+    # host staging overlaps device work.  The ragged prep is allocation
+    # only (the plan planes are cached device-side), the stage uploads each
+    # round's stream tensors, and the dispatch is the same per-round
+    # program as a direct commit — shapes never depend on the drain depth.
+
+    def _prep_fused_batch(self, batch):
+        for enc, _ in batch:
+            self._cum_ins += enc.ins_count
+            rows = np.nonzero(enc.num_ops)[0]
+            if len(rows):
+                self._store.ensure_rows(rows, self._cum_ins[rows])
+        return ("ragged", len(batch))
+
+    def _stage_fused_batch(self, batch, statics):
+        d = self._padded_docs
+        return jax.device_put(tuple(
+            (
+                group_stream_arrays(enc, None, d),
+                jnp.asarray(enc.ins_count, jnp.int32),
+                jnp.asarray(enc.del_count, jnp.int32),
+            )
+            for enc, _ in batch
+        ))
+
+    def _dispatch_fused_batch(self, batch, statics, inputs,
+                              chain_digest: bool = False) -> bool:
+        from ..ops.ragged import apply_batch_ragged_jit
+
+        store = self._store
+        plan, planes = self._ragged_planes()
+        row_idx, owner, pos_base, prev_page, page_count, page_table = planes
+        for (enc, widths), (earrays, ins_counts, del_counts) in zip(
+            batch, inputs
+        ):
+            rows = np.nonzero(enc.num_ops)[0]
+            real = int(enc.num_ops.sum())
+            store.pool_elem, store.pool_char, store.aux = (
+                apply_batch_ragged_jit(
+                    store.pool_elem, store.pool_char, store.aux,
+                    row_idx, owner, pos_base, prev_page, page_count,
+                    page_table, earrays, ins_counts, del_counts,
+                )
+            )
+            self._commit_caps[id(enc)] = real
+            if GLOBAL_DEVPROF.enabled:
+                GLOBAL_DEVPROF.observe_round(
+                    occupancy_key(self._padded_docs, *widths), real,
+                    max(real, 1), origin="streaming.ragged",
+                )
+                GLOBAL_DEVPROF.observe_ragged(
+                    docs_walked=plan.docs_walked,
+                    pages_walked=plan.pages_walked,
+                    real_ops=real,
+                )
+            if len(rows):
+                self._digest_row_valid[rows] = False
+            self.rounds += 1
+            GLOBAL_COUNTERS.add("streaming.rounds")
+        if GLOBAL_DEVPROF.enabled:
+            GLOBAL_DEVPROF.observe_page_pool(store.pool_stats())
+        return False
+
+    def _emit_round_stats(self, batch, scheduled: int,
+                          schedule_s: float, apply_s: float,
+                          origin: str = "streaming.ragged") -> None:
+        touched: set = set()
+        real = 0
+        capacity = 0
+        for enc, _ in batch:
+            touched.update(int(r) for r in np.nonzero(enc.num_ops)[0])
+            real += int(enc.num_ops.sum())
+            capacity += self._commit_caps.pop(id(enc), 0)
+        if GLOBAL_DEVPROF.enabled:
+            GLOBAL_DEVPROF.sample_memory()
+        stats = MergeStats(
+            docs=len(touched),
+            device_docs=len(touched),
+            device_ops=real,
+            encode_seconds=schedule_s,
+            apply_seconds=apply_s,
+            padding_efficiency=real / capacity if capacity else 0.0,
+            extras={"rounds": len(batch), "scheduled_changes": scheduled,
+                    "layout_ragged": 1.0},
+        )
+        self.last_round_stats = stats
+        self._pad_real_ops += real
+        self._pad_capacity += capacity
+        GLOBAL_HISTOGRAMS.observe("streaming.round_seconds", schedule_s + apply_s)
+        GLOBAL_HISTOGRAMS.observe(
+            "streaming.round_scheduled_changes", scheduled, buckets=SIZE_BUCKETS
+        )

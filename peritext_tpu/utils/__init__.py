@@ -1,5 +1,6 @@
 """Cross-cutting utilities: interning, tracing, metrics, checkpointing."""
 
 from .interning import Interner, OrderedActorTable
+from .shapes import next_pow2
 
-__all__ = ["Interner", "OrderedActorTable"]
+__all__ = ["Interner", "OrderedActorTable", "next_pow2"]

@@ -1,0 +1,33 @@
+"""The ONE power-of-two width spelling.
+
+Every bucketed device path (padded stream widths, paged page-count groups,
+cursor-axis widths, digest row buckets) rounds a dynamic count up to a
+power of two so jax's compile cache is hit by a small logarithmic family of
+shapes instead of one shape per exact count.  Before this module each site
+spelled the same while-loop privately (``store/paged._pow2``,
+``parallel/streaming._width_bucket``, ``ops/resolve.cursor_width_bucket``);
+graftlint's ``bucket_fns`` config had to track the whole family by name.
+Now they all delegate here and differ only in their floor.
+
+The ragged layout (ops/ragged.py, store/ragged.py) deliberately imports
+NOTHING from this module: its entire point is that per-doc true counts
+reach the device as traced loop bounds under one compiled shape, so any
+pow-2 rounding in ragged planning is a bug — enforced by graftlint PTL007.
+"""
+
+from __future__ import annotations
+
+
+def next_pow2(n: int, floor: int = 1) -> int:
+    """Smallest power of two >= max(n, floor).
+
+    ``floor`` must itself be a power of two — it seeds the doubling walk,
+    so a non-power seed would return non-power widths and silently fork the
+    compile-cache bucket family.
+    """
+    if floor < 1 or (floor & (floor - 1)):
+        raise ValueError(f"floor must be a positive power of two, got {floor}")
+    w = floor
+    while w < n:
+        w *= 2
+    return w

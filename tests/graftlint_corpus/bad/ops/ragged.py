@@ -1,0 +1,29 @@
+"""Corpus case: a ragged module that smuggles the bucket ladder back in.
+The basename is ``ragged.py`` ON PURPOSE — PTL007 scopes by module name,
+because the one-shape contract attaches to the module, not a directory.
+Both spellings must fire: the import line (the cheap catch) and the call
+sites (the actual regression)."""
+
+from peritext_tpu.utils.shapes import next_pow2  # PTL007: bucket import
+
+
+def _pow2(n):
+    k = 1
+    while k < n:
+        k *= 2
+    return k
+
+
+def plan_ragged_groups(ins_counts, page_size):
+    groups = {}
+    for doc, count in enumerate(ins_counts):
+        pages = -(-max(1, count) // page_size)
+        # PTL007: pow-2 rounding of a per-doc count IS the bucket ladder —
+        # every distinct bucket mints a compiled shape again
+        groups.setdefault(_pow2(pages), []).append(doc)
+    return groups
+
+
+def staged_width(counts):
+    # PTL007: the canonical helper is just as banned here as the private one
+    return next_pow2(max(counts, default=1))

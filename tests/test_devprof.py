@@ -218,7 +218,7 @@ def test_bucket_table_distinct_shapes_match_sentinel(recompile_sentinel,
 
 GOLDEN_DEVPROF_KEYS = {
     "enabled", "capture_costs", "sites", "occupancy", "occupancy_totals",
-    "memory", "page_pool",
+    "memory", "page_pool", "ragged",
 }
 GOLDEN_SITE_KEYS = {"distinct_shapes", "dispatches", "buckets"}
 GOLDEN_BUCKET_KEYS = {"dispatches", "sig", "cost", "memory"}

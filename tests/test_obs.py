@@ -320,6 +320,31 @@ class TestHealthSnapshotShape:
         for line in text.splitlines():
             assert line.startswith("#") or len(line.split()) == 2
 
+    def test_prometheus_ragged_gauges(self):
+        from peritext_tpu.obs import DeviceProfiler
+
+        prof = DeviceProfiler()
+        # padded/paged-only profiles carry no section and emit no gauges
+        assert prof.snapshot()["ragged"] is None
+        assert "peritext_ragged_dispatches" not in prometheus_text(devprof=prof)
+        with prof:
+            prof.observe_ragged(docs_walked=7, pages_walked=19, real_ops=140)
+            prof.observe_ragged(docs_walked=7, pages_walked=19, real_ops=60)
+        snap = prof.snapshot()["ragged"]
+        assert snap == {
+            "dispatches": 2, "docs_walked": 14, "pages_walked": 38,
+            "real_ops": 200, "padded_slot_waste": 0,
+        }
+        text = prometheus_text(devprof=prof)
+        assert "peritext_ragged_dispatches 2" in text
+        assert "peritext_ragged_docs_walked 14" in text
+        assert "peritext_ragged_pages_walked 38" in text
+        assert "peritext_ragged_real_ops 200" in text
+        # the layout's headline: no padded slots ever dispatched
+        assert "peritext_ragged_padded_slot_waste 0" in text
+        for line in text.splitlines():
+            assert line.startswith("#") or len(line.split()) == 2
+
     def test_metrics_server_endpoints(self):
         tracer = Tracer(host="metrics-test", enabled=True)
         with tracer.span("probe"):

@@ -111,6 +111,57 @@ def test_steady_state_streaming_rounds_zero_recompiles(recompile_sentinel):
     assert warm == cold  # replay converges byte-equal, and compiled nothing
 
 
+def test_mixed_size_drain_one_ragged_executable(recompile_sentinel):
+    """The ragged layout's headline, pinned live: a tweet fleet + an essay
+    + a book-scale doc drain through ONE compiled ragged apply — per-doc op
+    and page counts are data, so the size mix cannot mint shapes.  The
+    paged engine on the IDENTICAL schedule splits the same mix across its
+    power-of-two bucket ladder and compiles several apply variants; that
+    contrast is the point, so it is asserted too."""
+    tweets = generate_workload(seed=31, num_docs=6, ops_per_doc=10)
+    essay = generate_workload(seed=32, num_docs=1, ops_per_doc=120)
+    book = generate_workload(seed=33, num_docs=1, ops_per_doc=400)
+    workloads = tweets + essay + book
+    rounds = 5
+    arrival = _arrival_rounds(workloads, rounds=rounds, rng=random.Random(7))
+
+    def session(layout):
+        return StreamingMerge(
+            num_docs=8,
+            actors=ACTORS,
+            slot_capacity=512,
+            mark_capacity=64,
+            tomb_capacity=64,
+            round_insert_capacity=128,
+            round_delete_capacity=32,
+            round_mark_capacity=32,
+            layout=layout,
+            # pre-sized pool: growth mid-drain would change the pool
+            # shape, which recompiles HONESTLY — sizing is the deployer's
+            # lever, shape stability is the layout's
+            pool_pages=64,
+        )
+
+    recompile_sentinel.mark()
+    ragged_reads = _run_schedule(session("ragged"), arrival, rounds)
+    ragged_compiles = recompile_sentinel.since_mark().get(
+        "apply_batch_ragged", 0
+    )
+    assert ragged_compiles == 1, (
+        f"mixed-size drain minted {ragged_compiles} ragged apply "
+        "executables; the whole layout exists to make this 1"
+    )
+
+    recompile_sentinel.mark()
+    paged_reads = _run_schedule(session("paged"), arrival, rounds)
+    paged_compiles = sum(
+        n for site, n in recompile_sentinel.since_mark().items()
+        if "apply_batch_paged" in site
+    )
+    assert paged_compiles > 1  # the bucket ladder, observed
+    assert ragged_reads == paged_reads  # same bytes, fewer programs
+
+
 # ---------------------------------------------------------------------------
 # log-record parsing regression (ISSUE 3 satellite): the sentinel must
 # tolerate prefixed and multi-line jax log_compiles records
