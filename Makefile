@@ -9,7 +9,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 	bench bench-smoke bench-streaming bench-fused entry dryrun lint lint-baseline \
 	clean obs fleet perf-gate serve-smoke bench-serve paged-smoke bench-longdoc \
 	fused-smoke fleet-serve-smoke bench-fleet-serve bench-markheavy \
-	ragged-smoke
+	ragged-smoke plan-smoke bench-serve-fused
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -98,6 +98,18 @@ fleet-serve-smoke:
 bench-fleet-serve:
 	$(PY) bench.py --mode fleet-serve
 
+# device-as-OS planner smoke (mirrors the CI plan-smoke job): 32 tenants
+# fuse into one staged dispatch per window (byte equality vs per-session
+# drains), then the closed-loop planner proposes statics from the captured
+# devprof snapshot and the proposal replays through the bench row
+plan-smoke:
+	$(CPU_ENV) $(PY) scripts/plan_smoke.py --out /tmp/pt-plan
+
+# multi-tenant fused-dispatch row: N small tenants on one lane vs
+# per-session drains (dispatch amortization; byte equality in-row)
+bench-serve-fused:
+	$(PY) bench.py --mode serve-fused
+
 # mark-heavy editorial pass (span-overlap explosion) vs the scalar oracle
 bench-markheavy:
 	$(PY) bench.py --mode markheavy
@@ -128,7 +140,7 @@ bench-engine:  # device-only streaming replay: the engine limit vs the link
 # ledger, then gated with per-row tolerance bands (exit 1 on regression)
 perf-gate:
 	cp perf/reference_ledger.jsonl /tmp/pt-perf-gate.jsonl
-	PT_BENCH_LADDER_ROWS="streaming,streaming_fused,wire,serve_sustained,batch_longdoc,batch_8k_ragged,markheavy,fleet_serve" $(PY) bench.py \
+	PT_BENCH_LADDER_ROWS="streaming,streaming_fused,wire,serve_sustained,serve_multitenant,batch_longdoc,batch_8k_ragged,markheavy,fleet_serve" $(PY) bench.py \
 		--mode ladder --smoke --platform cpu --devprof \
 		--ledger /tmp/pt-perf-gate.jsonl
 	$(PY) -m peritext_tpu.obs perf /tmp/pt-perf-gate.jsonl --gate

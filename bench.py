@@ -906,6 +906,7 @@ def orchestrate(args, passthrough) -> int:
             "engine": "engine_limit_streaming_ops_per_sec_per_chip",
             "batch": "crdt_ops_per_sec_per_chip",
             "serve": "serve_sustained_docs_per_sec",
+            "serve-fused": "serve_multitenant_dispatch_amortization",
             "storm": "reconnect_storm_drain_ops_per_sec",
             "longdoc": "longdoc_ragged_ops_per_sec",
             "markheavy": "markheavy_ops_per_sec",
@@ -1394,6 +1395,189 @@ def run_serve(args) -> dict:
     }
 
 
+def run_serve_fused(args) -> dict:
+    """Multi-tenant fused-dispatch row (ISSUE 13): N small tenants served
+    through ONE :class:`~peritext_tpu.serve.FusedMuxGroup` lane vs N
+    standalone per-session muxes, same frames, same windows.
+
+    The fused arm commits each batching window as one staged device
+    program per touched lane (the plan tier's
+    :class:`~peritext_tpu.plan.fusion.FusionGroup` assigns disjoint
+    doc-row ranges; sparse windows ride the multi-tenant offset-plane
+    staged form); the per-session arm drains every tenant separately —
+    the dispatch-floor bill this row exists to show.  Byte equality of
+    every tenant's patch stream against its standalone twin is asserted
+    IN-ROW (the CRDT correctness oracle), and both arms' p99 apply
+    latencies ride along.  Headline = device programs per window saved:
+    per-session dispatches / fused dispatches."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    from peritext_tpu.obs import GLOBAL_COUNTERS
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.parallel.streaming import StreamingMerge
+    from peritext_tpu.plan.fusion import TenantSpec
+    from peritext_tpu.serve import (
+        FusedMuxGroup, SessionMux, default_lane_factory,
+    )
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    tenants_n = args.docs  # one small tenant per doc slot
+    opd = args.ops_per_doc
+    actors = ("doc1", "doc2", "doc3")
+    windows = 6
+    workloads = generate_workload(seed=args.seed + 13, num_docs=tenants_n,
+                                  ops_per_doc=opd)
+    names = [f"tenant{i:03d}" for i in range(tenants_n)]
+    frame_plans = {}
+    for name, w in zip(names, workloads):
+        changes = sorted((ch for log in w.values() for ch in log),
+                         key=lambda c: (c.actor, c.seq))
+        frame_plans[name] = [
+            encode_frame(changes[i::windows]) for i in range(windows)
+        ]
+    # window plan: alternating full and sparse activity — the sparse
+    # windows exercise the multi-tenant offset-plane staged form (only
+    # the active tenants' doc blocks ship), the full ones the shared
+    # full-lane staging.  Every tenant's frames stay in causal order.
+    active_of = []
+    cursor = {n: 0 for n in names}
+    for w in range(windows):
+        if w % 2 == 0:
+            active_of.append(list(names))
+        else:
+            active_of.append(names[(w // 2) % 4::4])
+    plan = []  # (window, tenant, frame)
+    for w, active in enumerate(active_of):
+        step = []
+        for n in active:
+            if cursor[n] < windows:
+                step.append((n, frame_plans[n][cursor[n]]))
+                cursor[n] += 1
+        plan.append(step)
+    # leftover frames drain in a final full window
+    tail = [(n, frame_plans[n][c])
+            for n in names for c in range(cursor[n], windows)]
+    if tail:
+        plan.append(tail)
+
+    session_kw = dict(
+        slot_capacity=max(256, 4 * opd), mark_capacity=max(64, opd),
+        tomb_capacity=max(128, opd),
+        round_insert_capacity=128, round_delete_capacity=64,
+        round_mark_capacity=64,
+    )
+
+    def build_group():
+        group = FusedMuxGroup(
+            [TenantSpec(tenant=n, docs=1) for n in names],
+            default_lane_factory(actors, **session_kw),
+            host="bench-fused",
+        )
+        sids = {}
+        for n in names:
+            sid, verdict = group.open_session(n, "client")
+            assert verdict.admitted
+            sids[n] = sid
+            group.muxes[n].latency_sink = []
+        return group, sids
+
+    def build_solo():
+        muxes, sids = {}, {}
+        for n in names:
+            mux = SessionMux(
+                StreamingMerge(num_docs=1, actors=actors,
+                               static_rounds=True, **session_kw),
+                host="bench-solo",
+            )
+            sid, verdict = mux.open_session("client")
+            assert verdict.admitted
+            muxes[n], sids[n] = mux, sid
+            mux.latency_sink = []
+        return muxes, sids
+
+    def drive_group(group, sids):
+        d0 = GLOBAL_COUNTERS.get("streaming.fused_dispatches")
+        t0 = time.perf_counter()
+        for step in plan:
+            for n, frame in step:
+                verdict = group.submit(n, sids[n], frame)
+                assert verdict.admitted, verdict
+            group.flush()
+        wall = time.perf_counter() - t0
+        return (int(GLOBAL_COUNTERS.get("streaming.fused_dispatches") - d0),
+                wall)
+
+    def drive_solo(muxes, sids):
+        d0 = GLOBAL_COUNTERS.get("streaming.fused_dispatches")
+        t0 = time.perf_counter()
+        for step in plan:
+            touched = []
+            for n, frame in step:
+                verdict = muxes[n].submit(sids[n], frame)
+                assert verdict.admitted, verdict
+                touched.append(n)
+            for n in dict.fromkeys(touched):
+                muxes[n].flush()
+        wall = time.perf_counter() - t0
+        return (int(GLOBAL_COUNTERS.get("streaming.fused_dispatches") - d0),
+                wall)
+
+    def p99_ms(sinks):
+        lats = sorted(x for sink in sinks for x in sink)
+        if not lats:
+            return None
+        return round(lats[min(len(lats) - 1, int(0.99 * len(lats)))] * 1e3, 3)
+
+    # warmup: walk both arms once on throwaway instances so every staged
+    # variant (full-lane, offset-plane, per-session) compiles OUTSIDE the
+    # measured pass — steady-state serving never pays an XLA compile
+    drive_group(*build_group())
+    drive_solo(*build_solo())
+
+    group, gsids = build_group()
+    fused_dispatches, fused_wall = drive_group(group, gsids)
+    muxes, ssids = build_solo()
+    solo_dispatches, solo_wall = drive_solo(muxes, ssids)
+
+    # the correctness oracle: every tenant's patch stream byte-equal to
+    # its standalone twin's
+    for n in names:
+        fused_patches = group.patches(n, gsids[n])
+        solo_patches = muxes[n].patches(ssids[n])
+        assert fused_patches == solo_patches, (
+            f"fused/unfused patch divergence for {n}"
+        )
+    fusion = group.fusion_snapshot()
+    amortization = (solo_dispatches / fused_dispatches
+                    if fused_dispatches else 0.0)
+    return {
+        "metric": "serve_multitenant_dispatch_amortization",
+        "value": round(amortization, 2),
+        "unit": "x",
+        "vs_baseline": round(solo_wall / fused_wall, 2) if fused_wall else None,
+        "baseline_impl": "one standalone SessionMux drain per tenant",
+        "tenants": tenants_n,
+        "ops_per_doc": opd,
+        "windows": len(plan),
+        "fused_dispatches": fused_dispatches,
+        "per_session_dispatches": solo_dispatches,
+        "fused_wall_s": round(fused_wall, 4),
+        "per_session_wall_s": round(solo_wall, 4),
+        "fused_p99_apply_ms": p99_ms(
+            [group.muxes[n].latency_sink for n in names]
+        ),
+        "per_session_p99_apply_ms": p99_ms(
+            [muxes[n].latency_sink for n in names]
+        ),
+        "byte_equal": True,
+        "docs_per_dispatch": fusion["docs_per_dispatch"],
+        "window_occupancy": fusion["window_occupancy"],
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def run_storm(args) -> dict:
     """Reconnect-storm row (ISSUE 7 / ROADMAP scenario item): a peer back
     from a long offline window drains a giant backlog through one gossip
@@ -1785,6 +1969,9 @@ def ladder_rows(platform: str):
         ("batch_1k",     "3",  ["--mode", "batch", "--docs", "1024"], platform, t),
         ("batch_128_cpu", "2", ["--mode", "batch", "--docs", "128"], "cpu", t),
         ("serve_sustained", "-", ["--mode", "serve"], platform, t),
+        # the multi-tenant fused-dispatch row (ISSUE 13): N small tenants
+        # on one lane vs per-session drains, byte equality asserted in-row
+        ("serve_multitenant", "-", ["--mode", "serve-fused"], platform, t),
         ("reconnect_storm", "-", ["--mode", "storm"], platform, t),
         ("batch_longdoc", "4b", ["--mode", "longdoc"], platform, t),
         ("markheavy",    "-",  ["--mode", "markheavy"], platform, t),
@@ -1993,8 +2180,8 @@ def main() -> None:
     parser.add_argument(
         "--mode",
         choices=("batch", "streaming", "streaming-fused", "engine", "wire",
-                 "sweep", "baselines", "fleet", "serve", "storm", "longdoc",
-                 "markheavy", "fleet-serve", "ladder"),
+                 "sweep", "baselines", "fleet", "serve", "serve-fused",
+                 "storm", "longdoc", "markheavy", "fleet-serve", "ladder"),
         default=None,
         help="batch = one-shot converge (configs 2-4); streaming = config 5 "
              "end-to-end; engine = device-only streaming replay (the engine "
@@ -2002,7 +2189,10 @@ def main() -> None:
              "sweep = config-5b full-corpus read sweep; baselines = scalar "
              "baselines only; fleet = partition-heal time-to-convergence "
              "(ISSUE 4); serve = sustained open-loop serving ladder (docs/s "
-             "at a p99 apply-latency SLO, ISSUE 7); storm = reconnect-storm "
+             "at a p99 apply-latency SLO, ISSUE 7); serve-fused = N small "
+             "tenants fused onto one device lane vs per-session dispatch "
+             "(dispatch amortization + byte equality, ISSUE 13); "
+             "storm = reconnect-storm "
              "backlog drain under serving load; longdoc = long-tail "
              "paged-vs-padded comparison (one essay among a tweet fleet, "
              "ISSUE 8); markheavy = mark-heavy editorial pass (span-overlap "
@@ -2108,6 +2298,9 @@ def main() -> None:
         defaults = (64, 192, 0, 0) if args.smoke else (512, 192, 0, 0)
     elif args.mode == "serve":
         defaults = (16, 48, 0, 0) if args.smoke else (64, 96, 0, 0)
+    elif args.mode == "serve-fused":
+        # --docs = the tenant count (one doc slot per small tenant)
+        defaults = (16, 48, 0, 0) if args.smoke else (32, 96, 0, 0)
     elif args.mode == "storm":
         defaults = (4, 30, 0, 0) if args.smoke else (8, 64, 0, 0)
     elif args.mode == "longdoc":
@@ -2130,7 +2323,8 @@ def main() -> None:
                "streaming-fused": run_streaming_fused,
                "engine": run_engine, "batch": run,
                "wire": run_wire, "sweep": run_sweep, "baselines": run_baselines,
-               "fleet": run_fleet_heal, "serve": run_serve, "storm": run_storm,
+               "fleet": run_fleet_heal, "serve": run_serve,
+               "serve-fused": run_serve_fused, "storm": run_storm,
                "longdoc": run_longdoc, "markheavy": run_markheavy,
                "fleet-serve": run_fleet_serve}
     if args.devprof:

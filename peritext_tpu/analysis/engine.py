@@ -31,6 +31,13 @@ class LintConfig:
     #: directory names whose files are "merge/convergence scope" (PTL001,
     #: PTL004's shape checks, PTL006)
     merge_scope_dirs: frozenset = frozenset({"core", "ops", "parallel", "store"})
+    #: '/'-joined path suffixes of INDIVIDUAL merge-scope files living in
+    #: otherwise out-of-scope directories.  plan/ is the canonical split:
+    #: the cost model (plan/model.py, plan/tuner.py) is observability —
+    #: wall-clock reads are legal — but plan/fusion.py assembles the
+    #: cross-tenant fusion groups that decide device dispatch order, so it
+    #: must stay deterministic like the merge kernels it feeds
+    merge_scope_files: frozenset = frozenset({"plan/fusion.py"})
     #: functions that route a raw length into the padded-shape tables;
     #: shapes wrapped in one of these never recompile (streaming.py's
     #: ``_width_bucket`` is the canonical instance)
@@ -87,7 +94,11 @@ class FileContext:
             elif _NOQA_BLE_RE.search(text):
                 self.suppressed.setdefault(lineno, set()).add("PTL005")
         parts = Path(display_path).parts[:-1]
-        self.in_merge_scope = any(p in config.merge_scope_dirs for p in parts)
+        posix = Path(display_path).as_posix()
+        self.in_merge_scope = (
+            any(p in config.merge_scope_dirs for p in parts)
+            or any(posix.endswith(f) for f in config.merge_scope_files)
+        )
         self.module_aliases, self.from_imports = astutil.import_maps(tree)
 
     # -- helpers used by rules ------------------------------------------------

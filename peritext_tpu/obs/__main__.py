@@ -17,6 +17,18 @@ reason), degradations, and the autotuned round-open window — exiting 1
 when any host is under sustained overload (backpressure engaged) or has
 shed load, so the command doubles as a fleet serving-health check.
 
+The ``plan`` command reads one devprof snapshot (a ``/devprof.json``
+scrape, a ``/health.json`` body carrying a ``devprof`` key, or an
+obs-smoke artifact) — plus, optionally, the perf ledger for the
+admission-window term — and prints the closed-loop planner's
+:class:`~peritext_tpu.plan.tuner.PlanProposal`: the proposed statics
+(stream widths, slot capacity, page size, fused depth, admission
+window) next to the observed configuration, with the modeled
+padded-FLOPs / recompile / dispatch terms that justify them.  Exit 1
+when the proposal beats the current configuration beyond the tolerance
+band ("your statics are stale" — the cue to replay the proposal through
+a bench row), 0 inside the band.
+
 The ``perf`` command reads the append-only perf ledger
 (:mod:`peritext_tpu.obs.ledger`: bench ladder rows + devprof snapshots,
 one JSONL record per run) and renders the LAST record as a diff table
@@ -31,11 +43,14 @@ Usage::
     python -m peritext_tpu.obs fleet hostA-convergence.json hostB.json
     python -m peritext_tpu.obs serve hostA-serve.json hostB-serve.json
     python -m peritext_tpu.obs perf perf/reference_ledger.jsonl --gate
+    python -m peritext_tpu.obs plan devprof.json --ledger perf/ledger.jsonl
 
 ``summary`` is the default command (``python -m peritext_tpu.obs t.json``
 works).  Exit codes: 0 ok (fleet: converged; serve: healthy; perf: no
-regression), 1 no spans found / fleet has lag or divergence / serve has
-overload or shedding / perf ``--gate`` regression, 2 unreadable input.
+regression; plan: statics within tolerance), 1 no spans found / fleet has
+lag or divergence / serve has overload or shedding / perf ``--gate``
+regression / plan proposal beats the current statics beyond tolerance,
+2 unreadable input.
 """
 
 from __future__ import annotations
@@ -274,11 +289,83 @@ def _perf_command(args) -> int:
     return 0
 
 
+def _plan_command(args) -> int:
+    """The closed-loop planner's operator surface (see module doc)."""
+    from ..plan import PlanProposal, propose  # noqa: F401 - typed surface
+    from ..plan.model import load_devprof
+
+    try:
+        snapshot = load_devprof(args.snapshot)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"unreadable devprof snapshot {args.snapshot}: {exc}",
+              file=sys.stderr)
+        return 2
+    ledger_records = None
+    if args.ledger:
+        from . import ledger as _ledger
+
+        try:
+            ledger_records = _ledger.load_ledger(args.ledger)
+        except (OSError, ValueError) as exc:
+            print(f"unreadable perf ledger {args.ledger}: {exc}",
+                  file=sys.stderr)
+            return 2
+    tolerance = (args.tolerance / 100.0 if args.tolerance is not None
+                 else None)
+    kwargs = {} if tolerance is None else {"tolerance": tolerance}
+    proposal = propose(snapshot, ledger_records, **kwargs)
+    stale = proposal.beats_current(
+        tolerance if tolerance is not None else
+        proposal.modeled.get("tolerance", 0.1)
+    )
+    if args.json:
+        print(json.dumps(
+            {**proposal.to_json(), "beats_current": stale}, indent=2,
+        ))
+    else:
+        modeled = proposal.modeled
+        print(
+            f"planner: modeled score {modeled['current_score']} -> "
+            f"{modeled['proposed_score']} "
+            f"(savings {modeled['savings_frac'] * 100:.1f}%, tolerance "
+            f"{modeled['tolerance'] * 100:.0f}%, utilization "
+            f"{modeled['utilization'] * 100:.1f}%)"
+        )
+        body = proposal.to_json()
+        rows = [
+            {"static": key,
+             "current": body["current"].get(key, "-"),
+             "proposed": body["proposal"][key]}
+            for key in body["proposal"]
+        ]
+        print(render_table(rows, cols=["static", "current", "proposed"],
+                           left_cols=1))
+        print(
+            f"modeled: padded_flops {modeled['padded_flops_current']} -> "
+            f"{modeled['padded_flops_proposed']} · recompiles "
+            f"{modeled['recompiles_current']} -> "
+            f"{modeled['recompiles_proposed']} · dispatches "
+            f"{modeled['dispatches_current']} -> "
+            f"{modeled['dispatches_proposed']}"
+        )
+        if stale:
+            print(
+                "plan: proposal beats current statics beyond tolerance — "
+                "replay it through a bench row before re-pinning",
+                file=sys.stderr,
+            )
+        else:
+            print("plan: current statics are within tolerance")
+    # "stale statics" is exit 1: the command doubles as a CI/cron check
+    # that the pinned configuration still matches the observed workload
+    return 1 if stale else 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # default command: `python -m peritext_tpu.obs trace.json` == summary
     if argv and argv[0] not in ("summary", "merge", "fleet", "serve", "perf",
-                                "-h", "--help"):
+                                "plan", "-h", "--help"):
         argv.insert(0, "summary")
     parser = argparse.ArgumentParser(
         prog="python -m peritext_tpu.obs", description=__doc__,
@@ -324,6 +411,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         default="device",
                         help="how strictly reference records must match the "
                         "candidate's device fingerprint (default: device)")
+    p_plan = sub.add_parser(
+        "plan", help="closed-loop planner proposal from a devprof snapshot "
+        "(exit 1 when the proposal beats the current statics)",
+    )
+    p_plan.add_argument("snapshot", help="devprof.json / health.json path")
+    p_plan.add_argument("--ledger", default=None, metavar="PATH",
+                        help="perf-ledger JSONL for the admission-window "
+                        "term (optional)")
+    p_plan.add_argument("--json", action="store_true",
+                        help="machine-readable proposal instead of the table")
+    p_plan.add_argument("--tolerance", type=float, default=None, metavar="PCT",
+                        help="savings band (percent) below which the current "
+                        "statics stand (default 10)")
     args = parser.parse_args(argv)
     if args.cmd is None:
         parser.print_help()
@@ -331,6 +431,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.cmd == "perf":
         return _perf_command(args)
+
+    if args.cmd == "plan":
+        return _plan_command(args)
 
     if args.cmd == "serve":
         snapshots = []

@@ -52,6 +52,7 @@ def prometheus_text(
     devprof=None,
     serve=None,
     fleet=None,
+    plan=None,
 ) -> str:
     """Prometheus text exposition of the process telemetry.  Counter names
     sanitize ``.`` → ``_`` under a ``peritext_`` prefix; histograms emit the
@@ -69,7 +70,12 @@ def prometheus_text(
     reason; a :class:`~..serve.FleetFrontend` lands as
     ``peritext_fleet_*`` gauges (host/lease counts, failover + migration
     tallies, durable-state bookkeeping) plus the fleet-wide verdict
-    counters with sheds labelled by reason."""
+    counters with sheds labelled by reason.  A serve snapshot's
+    ``fusion`` section lands as ``peritext_plan_fusion_*`` gauges (group
+    membership, dispatch amortization, window occupancy); a planner
+    verdict passed as ``plan`` (a :class:`~..plan.tuner.PlanProposal` or
+    its ``to_json()`` dict) lands as ``peritext_plan_*`` gauges (modeled
+    scores, savings fraction, the proposed statics)."""
     counters = counters or GLOBAL_COUNTERS
     histograms = histograms if histograms is not None else GLOBAL_HISTOGRAMS
     lines = []
@@ -252,6 +258,23 @@ def prometheus_text(
             quoted = (reason.replace("\\", "\\\\").replace('"', '\\"')
                       .replace("\n", "\\n"))
             lines.append(f'{m}{{reason="{quoted}"}} {_fmt(count)}')
+        fu = snap.get("fusion")
+        if fu:
+            # cross-tenant fusion gauges: how many tenants this host's
+            # dispatches amortize over (identity report when standalone)
+            for m, value in (
+                ("peritext_plan_fusion_grouped", int(fu["grouped"])),
+                ("peritext_plan_fusion_tenants", fu["tenants"]),
+                ("peritext_plan_fusion_lanes", fu["lanes"]),
+                ("peritext_plan_fusion_windows", fu["windows"]),
+                ("peritext_plan_fusion_dispatches", fu["dispatches"]),
+                ("peritext_plan_docs_per_dispatch",
+                 fu["docs_per_dispatch"]),
+                ("peritext_plan_window_occupancy",
+                 fu["window_occupancy"]),
+            ):
+                lines.append(f"# TYPE {m} gauge")
+                lines.append(f"{m} {_fmt(value)}")
     if fleet is not None:
         snap = fleet.snapshot()
         leases = snap["leases"]["leases"]
@@ -298,6 +321,26 @@ def prometheus_text(
             quoted = (reason.replace("\\", "\\\\").replace('"', '\\"')
                       .replace("\n", "\\n"))
             lines.append(f'{m}{{reason="{quoted}"}} {_fmt(count)}')
+    if plan is not None:
+        pj = plan.to_json() if hasattr(plan, "to_json") else dict(plan)
+        modeled = pj.get("modeled") or {}
+        proposal = pj.get("proposal") or {}
+        for m, value in (
+            ("peritext_plan_current_score", modeled.get("current_score")),
+            ("peritext_plan_proposed_score", modeled.get("proposed_score")),
+            ("peritext_plan_savings_frac", modeled.get("savings_frac")),
+            ("peritext_plan_utilization", modeled.get("utilization")),
+            ("peritext_plan_proposed_fused_depth",
+             proposal.get("fused_depth")),
+            ("peritext_plan_proposed_slot_capacity",
+             proposal.get("slot_capacity")),
+            ("peritext_plan_proposed_page_size", proposal.get("page_size")),
+            ("peritext_plan_proposed_window_seconds",
+             proposal.get("window_seconds")),
+        ):
+            if isinstance(value, (int, float)):
+                lines.append(f"# TYPE {m} gauge")
+                lines.append(f"{m} {_fmt(value)}")
     if session is not None:
         health = session.health()
         for key in sorted(health):
@@ -358,12 +401,13 @@ class MetricsServer:
         devprof=None,
         serve=None,
         fleet=None,
+        plan=None,
     ) -> None:
         def metrics() -> str:
             return prometheus_text(
                 counters=counters, histograms=histograms,
                 session=session, sentinel=sentinel, convergence=convergence,
-                devprof=devprof, serve=serve, fleet=fleet,
+                devprof=devprof, serve=serve, fleet=fleet, plan=plan,
             )
 
         def snapshot() -> str:
@@ -372,7 +416,7 @@ class MetricsServer:
                     counters=counters, session=session, sentinel=sentinel,
                     histograms=histograms, recorder=recorder,
                     convergence=convergence, devprof=devprof, serve=serve,
-                    fleet=fleet,
+                    fleet=fleet, plan=plan,
                 ),
                 default=str,
             )
@@ -404,6 +448,14 @@ class MetricsServer:
         if fleet is not None:
             routes["/fleet.json"] = (
                 lambda: json.dumps(fleet.snapshot()),
+                "application/json",
+            )
+        if plan is not None:
+            routes["/plan.json"] = (
+                lambda: json.dumps(
+                    plan.to_json() if hasattr(plan, "to_json")
+                    else dict(plan)
+                ),
                 "application/json",
             )
         self._httpd = ThreadingHTTPServer((host, port), _Handler)

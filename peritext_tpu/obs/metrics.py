@@ -47,7 +47,7 @@ GLOBAL_COUNTERS = Counters()
 
 #: counter/histogram namespaces that make up the fault-domain health surface
 _HEALTH_PREFIXES = ("streaming.", "transport.", "supervisor.", "merge.",
-                    "jit.", "convergence.", "serve.", "fleet.")
+                    "jit.", "convergence.", "serve.", "fleet.", "plan.")
 
 
 def health_snapshot(
@@ -60,6 +60,7 @@ def health_snapshot(
     devprof=None,
     serve=None,
     fleet=None,
+    plan=None,
 ) -> Dict[str, Any]:
     """One structured dict for a fleet health endpoint: every fault-domain
     counter (quarantines, corrupt frames, transport retries / behind peers,
@@ -81,8 +82,12 @@ def health_snapshot(
     memory-watermark snapshot appears under ``devprof``; with a
     :class:`~..serve.SessionMux` (or anything exposing the same
     ``snapshot()``), its session/queue/verdict/window state appears under
-    ``serve``.  Everything in the snapshot is JSON-serializable (the
-    exporter-schema golden test pins this)."""
+    ``serve``; with a planner verdict (a
+    :class:`~..plan.tuner.PlanProposal`, anything with ``to_json()``, or
+    a plain dict), the proposal/current/modeled body appears under
+    ``plan`` — the device-as-OS planner's advice rides the SAME health
+    surface the rest of the fleet scrapes.  Everything in the snapshot is
+    JSON-serializable (the exporter-schema golden test pins this)."""
     from .histograms import GLOBAL_HISTOGRAMS
 
     counters = counters or GLOBAL_COUNTERS
@@ -116,4 +121,8 @@ def health_snapshot(
         out["serve"] = serve.snapshot()
     if fleet is not None:
         out["fleet"] = fleet.snapshot()
+    if plan is not None:
+        out["plan"] = (
+            plan.to_json() if hasattr(plan, "to_json") else dict(plan)
+        )
     return out

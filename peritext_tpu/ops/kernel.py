@@ -739,6 +739,99 @@ def apply_batch_stacked_rounds_jit(state, stacked, *, loop_slots_seq,
     return fn(state, stacked, **statics)
 
 
+def _scatter_tenant_blocks(blocks, row_base, docs: int):
+    """Per-tenant row blocks -> one (docs, ...) staging plane, in-program.
+
+    ``blocks`` is (T, Dt, ...) — tenant t's Dt doc rows of one staging
+    plane — and ``row_base`` is a (T,) int32 DATA plane: tenant t's rows
+    land at ``row_base[t] + arange(Dt)``.  Scatter-ADD into zeros, not
+    dynamic-update-slice, on purpose: all-zero rows are no-op rows to the
+    apply phases, so a zero PAD block (T is pow-2 bucketed to keep one
+    compile shape while the active-tenant subset varies as data) adds
+    nothing wherever its row_base points, and overlapping pad targets
+    stay harmless.  Tenant blocks themselves never alias — the fusion
+    plan hands every tenant a disjoint doc-row range."""
+    t, dt = blocks.shape[0], blocks.shape[1]
+    rows = (row_base[:, None]
+            + jnp.arange(dt, dtype=jnp.int32)[None, :]).reshape(-1)
+    flat = blocks.reshape((t * dt,) + blocks.shape[2:])
+    out = jnp.zeros((docs,) + blocks.shape[2:], blocks.dtype)
+    return out.at[rows].add(flat)
+
+
+def apply_batch_stacked_rounds_multi(
+    state: PackedDocs,
+    stacked,  # the apply_batch 8-tuple, leaves shaped (R, T, Dt, ...)
+    row_base,  # (T,) int32 data plane: per-tenant doc-row offsets
+    *,
+    docs: int,  # static: the session's padded doc axis
+    loop_slots_seq,  # static tuple of per-round insert_loop_slots
+    insert_impl: str = "auto",
+) -> PackedDocs:
+    """The multi-tenant doc-row-offset form of
+    :func:`apply_batch_stacked_rounds` (cross-tenant fusion, plan/).
+
+    A fusion window usually touches a SUBSET of a lane's tenants; staging
+    the lane's full (D, K) planes would ship mostly zeros.  This entry
+    point ships only the active tenants' row blocks — (R, T, Dt, ...) per
+    staging plane — plus ``row_base``, and rebuilds the full-width planes
+    in-program via :func:`_scatter_tenant_blocks` before chaining the
+    same per-round padded apply the stacked form runs.  ``row_base`` is
+    DATA, so which tenants are active never recompiles; only the (T, Dt)
+    block shape is static, and T pow-2 bucketing keeps that a ladder."""
+    (ins_ref, ins_op, ins_char, del_t, marks, mark_count, maps,
+     map_count) = stacked
+    for r in range(len(loop_slots_seq)):
+        def sc(plane, _r=r):
+            return _scatter_tenant_blocks(plane[_r], row_base, docs)
+
+        arrays = (
+            sc(ins_ref), sc(ins_op), sc(ins_char), sc(del_t),
+            {c: sc(a) for c, a in marks.items()}, sc(mark_count),
+            {c: sc(a) for c, a in maps.items()}, sc(map_count),
+        )
+        state = apply_batch(
+            state, arrays, insert_impl=insert_impl,
+            insert_loop_slots=loop_slots_seq[r],
+        )
+    return state
+
+
+_STACKED_MULTI_STATICS = ("docs", "loop_slots_seq", "insert_impl")
+_apply_stacked_multi_jit = jax.jit(
+    apply_batch_stacked_rounds_multi,
+    static_argnames=_STACKED_MULTI_STATICS,
+    donate_argnums=0,
+)
+_apply_stacked_multi_jit_nodonate = jax.jit(
+    apply_batch_stacked_rounds_multi,
+    static_argnames=_STACKED_MULTI_STATICS,
+)
+
+
+def apply_batch_stacked_rounds_multi_jit(
+        state, stacked, row_base, *, loop_slots_seq,
+        insert_impl: str = "auto", donate: bool | None = None) -> PackedDocs:
+    """jit-compiled :func:`apply_batch_stacked_rounds_multi`; ``state``
+    donated per :func:`resolve_state_donation` (or the explicit
+    ``donate``)."""
+    if insert_impl == "auto":
+        insert_impl = resolve_insert_impl(state.elem_id)
+    if donate is None:
+        donate = resolve_state_donation(state.elem_id)
+    fn = (_apply_stacked_multi_jit if donate
+          else _apply_stacked_multi_jit_nodonate)
+    statics = dict(docs=int(state.elem_id.shape[0]),
+                   loop_slots_seq=tuple(loop_slots_seq),
+                   insert_impl=insert_impl)
+    if GLOBAL_DEVPROF.enabled:
+        _note_dispatch(
+            "apply_batch_stacked_rounds_multi", fn,
+            (state, stacked, row_base), statics,
+        )
+    return fn(state, stacked, row_base, **statics)
+
+
 def apply_batch_compact_rounds_jit(state, rounds, *, widths_seq,
                                    loop_slots_seq,
                                    insert_impl: str = "auto") -> PackedDocs:

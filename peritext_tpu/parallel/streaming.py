@@ -80,6 +80,7 @@ from ..ops.kernel import (
     apply_batch_staged_rounds_jit,
     apply_batch_stacked_rounds,
     apply_batch_stacked_rounds_jit,
+    apply_batch_stacked_rounds_multi_jit,
     encoded_arrays_of,
     resolve_insert_impl,
     resolve_state_donation,
@@ -746,6 +747,15 @@ class StreamingMerge:
         #: device_put staging, unpipelined drain) — the bench fused row's
         #: comparison arm and the equivalence tests' oracle side
         self.fused_pipeline = True
+        #: cross-tenant fusion window extents (plan/fusion.FusionGroup
+        #: window_rows): ``(row_bases, block_docs)`` set by the serve
+        #: tier's FusedMuxGroup around a drain whose window touched a
+        #: SUBSET of the lane's tenants.  When set, static-round commits
+        #: stage only the active tenants' row blocks and rebuild the full
+        #: (D, K) planes in-program (kernel.apply_batch_stacked_rounds
+        #: _multi: the offsets ride as DATA, so the active subset never
+        #: recompiles).  None = whole-lane staging, the stacked form.
+        self.fusion_rows = None
         # Per-ROW cumulative admitted inserts: a host-side upper bound on
         # device slot occupancy (slots only grow, one per admitted insert;
         # device-side convergence dedup can only make the true count
@@ -1455,6 +1465,15 @@ class StreamingMerge:
             bound = _width_bucket(int(self._cum_ins.max()))
             loop_seq.append(bound if bound < s_cap else None)
         if self.static_rounds:
+            if self.fusion_rows is not None:
+                # cross-tenant fusion window: only the active tenants'
+                # row blocks ship; T is pow-2 bucketed (zero pad blocks
+                # are no-op rows wherever their row_base points) so the
+                # static shape is a (T_bucket, block_docs) ladder while
+                # the subset itself rides as data
+                bases, block = self.fusion_rows
+                return ("stacked_multi", tuple(loop_seq), tuple(bases),
+                        int(block), _width_bucket(len(bases)))
             if (len(batch) == 1
                     and not resolve_state_donation(self.state.elem_id)):
                 # single-round serving commit, non-donating platform: the
@@ -1520,6 +1539,37 @@ class StreamingMerge:
                 {c: enc.marks[c] for c in MARK_COLS}, enc.mark_count,
                 {c: enc.map_ops[c] for c in MAP_STREAM_COLS}, enc.map_count,
             ))
+        if statics[0] == "stacked_multi":
+            # cross-tenant fusion form: per-round, slice the ACTIVE
+            # tenants' row blocks out of the (D, K) staging planes —
+            # (T_bucket, block, K) per plane, zero pad blocks beyond the
+            # active count — and stack along the round axis; the row_base
+            # data plane ships alongside
+            _, _, bases, block, t_pad = statics
+
+            def blocks(plane):
+                out = np.zeros((t_pad, block) + plane.shape[1:], plane.dtype)
+                for t, b in enumerate(bases):
+                    out[t] = plane[b:b + block]
+                return out
+
+            def round_tree(enc):
+                return (
+                    blocks(enc.ins_ref), blocks(enc.ins_op),
+                    blocks(enc.ins_char), blocks(enc.del_target),
+                    {c: blocks(enc.marks[c]) for c in MARK_COLS},
+                    blocks(enc.mark_count),
+                    {c: blocks(enc.map_ops[c]) for c in MAP_STREAM_COLS},
+                    blocks(enc.map_count),
+                )
+
+            per_round = [round_tree(enc) for enc, _ in batch]
+            stacked = jax.tree_util.tree_map(
+                lambda *leaves: np.stack(leaves), *per_round,
+            )
+            row_base = np.zeros(t_pad, np.int32)
+            row_base[: len(bases)] = bases
+            return jax.device_put((stacked, row_base))
         if statics[0] == "stacked":
             # static-round serving form: the padded (D, K) staging rows at
             # the session's fixed widths, stacked along a leading round axis
@@ -1580,6 +1630,10 @@ class StreamingMerge:
         the block cache with its result — returns True when that happened
         (the drain then skips the separate prefetch dispatch)."""
         self._apply_blocks = None
+        # one staged device program is about to launch (the digest-chained
+        # arm is still ONE program) — the serve tier's fusion accounting
+        # and the multi-tenant bench row measure deltas of this counter
+        GLOBAL_COUNTERS.add("streaming.fused_dispatches")
         if chain_digest and statics[0] in ("stacked", "flat"):
             self._dispatch_fused_batch_digest(batch, statics, inputs)
             return True
@@ -1600,6 +1654,11 @@ class StreamingMerge:
             loop_seq = statics[1]
             self.state = apply_batch_stacked_rounds_jit(
                 self.state, inputs, loop_slots_seq=loop_seq,
+            )
+        elif statics[0] == "stacked_multi":
+            stacked, row_base = inputs
+            self.state = apply_batch_stacked_rounds_multi_jit(
+                self.state, stacked, row_base, loop_slots_seq=statics[1],
             )
         else:
             _, loop_seq, widths_seq, ins_lens, del_lens, mark_lens, \

@@ -1,0 +1,34 @@
+"""Device-as-OS planner: the schedule is derived, not hand-picked.
+
+Two halves of one idea (ROADMAP "Device-as-OS serving"):
+
+* :mod:`.fusion` — deterministic cross-tenant fusion planning: which
+  tenants share a device lane, at which doc-row bases, and which row
+  extents a batching window stages (the serve tier's
+  ``FusedMuxGroup`` executes these plans; ``plan/fusion.py`` itself is
+  merge-scope — no wall clock, the assembled dispatch order must be a
+  pure function of the committed window).
+* :mod:`.model` + :mod:`.tuner` — the closed loop: a cost model over a
+  devprof snapshot (bucket occupancy, XLA cost/memory analyses,
+  page-pool fragmentation) proposes a typed :class:`~.tuner.PlanProposal`
+  — bucket widths, slot capacity, page size, fused depth, admission
+  window — minimizing modeled padded-FLOPs + recompiles under an
+  executable-bytes budget.  ``python -m peritext_tpu.obs plan`` is the
+  operator surface; the proposal is validated by replaying a bench row
+  against the perf ledger, never trusted on model faith alone.
+"""
+
+from .fusion import FusionGroup, LanePlan, LaneSlot, TenantSpec
+from .model import CostModel, load_devprof
+from .tuner import PlanProposal, propose
+
+__all__ = [
+    "CostModel",
+    "FusionGroup",
+    "LanePlan",
+    "LaneSlot",
+    "PlanProposal",
+    "TenantSpec",
+    "load_devprof",
+    "propose",
+]

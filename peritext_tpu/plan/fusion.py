@@ -1,0 +1,190 @@
+"""Cross-tenant fusion planning: tenants -> device lanes -> doc rows.
+
+A serving host today drains each tenant's :class:`~..serve.mux.SessionMux`
+session as its OWN staged device program — N tenants pay N dispatch
+floors per batching window.  A :class:`FusionGroup` assigns many tenants
+to shared ``static_rounds`` device lanes (one
+:class:`~..parallel.streaming.StreamingMerge` per storage layout), each
+tenant owning a DISJOINT doc-row range, so one window commits one staged
+program per touched lane no matter how many tenants rode it.  Documents
+are independent CRDTs and rows never alias, so per-tenant byte equality
+with the unfused path holds by construction; cross-tenant isolation is a
+row-range property, not a runtime check.
+
+This module is MERGE SCOPE (``analysis.engine.LintConfig
+.merge_scope_files``) even though it lives outside the merge
+directories: the group assembly decides device dispatch order, and a
+wall-clock or RNG read here would make the assembled program
+replica-local — the exact bug class PTL006 exists for.  All wall-clock
+ownership (window opening/closing, drain timing) stays in the serve
+tier's ``FusedMuxGroup`` wiring.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+#: storage layouts a lane may be built over (mirrors StreamingMerge)
+LANE_LAYOUTS = ("padded", "paged", "ragged")
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's lane requirements: a stable name, its doc-slot
+    budget, and the storage layout its sessions need."""
+
+    tenant: str
+    docs: int
+    layout: str = "padded"
+
+    def __post_init__(self):
+        if not self.tenant:
+            raise ValueError("tenant name must be non-empty")
+        if self.docs <= 0:
+            raise ValueError(f"tenant {self.tenant!r}: docs must be > 0")
+        if self.layout not in LANE_LAYOUTS:
+            raise ValueError(
+                f"tenant {self.tenant!r}: unknown layout {self.layout!r}"
+            )
+
+
+@dataclass(frozen=True)
+class LaneSlot:
+    """A tenant's placement inside a lane: ``[doc_base, doc_base+docs)``
+    of the lane session's doc axis belongs to exactly this tenant."""
+
+    tenant: str
+    lane: int
+    layout: str
+    doc_base: int
+    docs: int
+
+
+@dataclass(frozen=True)
+class LanePlan:
+    """One shared device lane: a ``static_rounds`` session of ``docs``
+    total rows, tiled by the tenants in ``slots`` (base-ascending)."""
+
+    lane: int
+    layout: str
+    docs: int
+    slots: Tuple[LaneSlot, ...]
+
+    def to_json(self) -> Dict:
+        return {
+            "lane": self.lane,
+            "layout": self.layout,
+            "docs": self.docs,
+            "tenants": [s.tenant for s in self.slots],
+        }
+
+
+class FusionGroup:
+    """Deterministic tenant -> (lane, doc_base) assignment plus the
+    per-window doc-row extents the multi-tenant staged dispatch needs.
+
+    Assignment is a pure function of the tenant specs: tenants sort by
+    ``(layout, tenant)`` and first-fit pack into lanes of at most
+    ``lane_capacity`` doc rows, one lane sequence per layout — two hosts
+    given the same specs assemble byte-identical groups.  ``lane_capacity``
+    bounds a lane's padded doc axis (its (D, K) staging planes are a real
+    per-round host->device cost), not the tenant count.
+    """
+
+    def __init__(self, tenants: Sequence[TenantSpec],
+                 lane_capacity: int = 4096) -> None:
+        if lane_capacity <= 0:
+            raise ValueError(f"lane_capacity must be > 0, got {lane_capacity}")
+        names = [t.tenant for t in tenants]
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate tenant names in fusion group")
+        for t in tenants:
+            if t.docs > lane_capacity:
+                raise ValueError(
+                    f"tenant {t.tenant!r} needs {t.docs} docs > "
+                    f"lane_capacity {lane_capacity}"
+                )
+        self.lane_capacity = int(lane_capacity)
+        lanes: list = []
+        slots: Dict[str, LaneSlot] = {}
+        # first-fit in sorted order: stable, and layout-grouped so a mixed
+        # window touches one lane per layout present, not an interleaving
+        open_lane: Dict[str, list] = {}
+        for spec in sorted(tenants, key=lambda t: (t.layout, t.tenant)):
+            cur = open_lane.get(spec.layout)
+            if cur is None or cur[1] + spec.docs > lane_capacity:
+                cur = open_lane[spec.layout] = [len(lanes), 0, spec.layout, []]
+                lanes.append(cur)
+            slot = LaneSlot(
+                tenant=spec.tenant, lane=cur[0], layout=spec.layout,
+                doc_base=cur[1], docs=spec.docs,
+            )
+            cur[1] += spec.docs
+            cur[3].append(slot)
+            slots[spec.tenant] = slot
+        self.lanes: Tuple[LanePlan, ...] = tuple(
+            LanePlan(lane=i, layout=layout, docs=docs, slots=tuple(ss))
+            for i, docs, layout, ss in lanes
+        )
+        self.slots: Dict[str, LaneSlot] = slots
+
+    # -- lookups -----------------------------------------------------------
+
+    def slot_of(self, tenant: str) -> LaneSlot:
+        slot = self.slots.get(tenant)
+        if slot is None:
+            raise KeyError(f"unknown tenant {tenant!r}")
+        return slot
+
+    def lane_of(self, tenant: str) -> LanePlan:
+        return self.lanes[self.slot_of(tenant).lane]
+
+    # -- per-window assembly ----------------------------------------------
+
+    def window_rows(
+        self, lane: int, active: Sequence[str],
+    ) -> Optional[Tuple[Tuple[int, ...], int]]:
+        """The staged dispatch's doc-row extents for one window: the row
+        bases of the ACTIVE tenants on ``lane`` (base-ascending — dispatch
+        order is a function of placement, never of arrival) plus the
+        uniform per-tenant block size.  Returns None when the active
+        tenants' doc budgets differ — the multi-tenant staged form ships
+        one ``(T, block_docs, ...)`` tensor set, so a ragged tenant mix
+        falls back to full-lane staging (still one program)."""
+        plan = self.lanes[lane]
+        chosen = sorted(
+            (self.slots[t] for t in set(active)),
+            key=lambda s: s.doc_base,
+        )
+        for s in chosen:
+            if s.lane != lane:
+                raise ValueError(
+                    f"tenant {s.tenant!r} is on lane {s.lane}, not {lane}"
+                )
+        if not chosen:
+            return None
+        block = chosen[0].docs
+        if any(s.docs != block for s in chosen):
+            return None
+        if len(chosen) == len(plan.slots) and plan.docs == block * len(chosen):
+            # every tenant active: full-lane staging is strictly cheaper
+            # (no offset plane, shared compile with the stacked form)
+            return None
+        return tuple(s.doc_base for s in chosen), block
+
+    def window_occupancy(self, lane: int, active: Sequence[str]) -> float:
+        """Active doc rows / lane doc rows for one window (the fusion
+        analog of the bucket-occupancy tables' padding efficiency)."""
+        plan = self.lanes[lane]
+        if not plan.docs:
+            return 0.0
+        live = sum(self.slots[t].docs for t in set(active))
+        return live / plan.docs
+
+    def to_json(self) -> Dict:
+        return {
+            "lanes": [p.to_json() for p in self.lanes],
+            "tenants": len(self.slots),
+            "lane_capacity": self.lane_capacity,
+        }

@@ -1,0 +1,272 @@
+"""Cost model over a devprof snapshot: what the statics actually cost.
+
+Every shape constant in the serving stack is a static someone once
+hand-picked: the round stream widths (``round_*_capacity``), the slot
+capacity, the P=64 page size, the fused depth ladder, the admission
+window clamps.  PR 5's :mod:`~..obs.devprof` already measures what those
+choices cost — per-site XLA cost/memory analyses keyed by shape bucket,
+the bucket-occupancy (padding waste) tables, page-pool fragmentation —
+so the model here is READ, not guessed: it parses one devprof snapshot
+into the observed configuration plus enough per-term structure to score
+a candidate configuration's modeled padded-FLOPs, recompile count, and
+executable-bytes footprint.  :mod:`.tuner` searches candidates over it;
+``python -m peritext_tpu.obs plan`` is the operator surface.
+
+Wall-clock numbers appear only as data READ FROM the snapshot (this is
+observability scope); nothing here reads a clock or touches a device.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+#: the bucket-occupancy key spelling (obs/devprof.occupancy_key)
+OCC_KEY_RE = re.compile(
+    r"^D(?P<docs>\d+)\.ki(?P<ki>\d+)\.kd(?P<kd>\d+)"
+    r"\.km(?P<km>\d+)\.kp(?P<kp>\d+)$"
+)
+
+#: modeled FLOPs charged per padded op slot when the snapshot carries no
+#: captured cost analyses (capture_costs off): the model still ranks
+#: candidates by padded capacity, just in op units instead of FLOPs
+DEFAULT_FLOPS_PER_OP = 1.0
+
+#: per compiled variant: executable-bytes estimate used when the
+#: snapshot's memory section can't price one (argument/temp bytes of the
+#: biggest captured bucket stand in otherwise)
+DEFAULT_EXECUTABLE_BYTES = 1 << 20
+
+#: fraction of device memory the compiled-program cache may claim
+DEFAULT_BUDGET_FRACTION = 0.10
+
+
+def load_devprof(source: Any) -> Dict[str, Any]:
+    """A devprof snapshot dict from a path, JSON string, or dict.
+
+    Accepts the raw :meth:`~..obs.devprof.DeviceProfiler.snapshot` body,
+    a ``/devprof.json`` scrape, or a ``/health.json``-style wrapper
+    carrying a ``devprof`` key (the ``obs`` CLI loaders' discipline)."""
+    if isinstance(source, (str, Path)):
+        text = Path(source).read_text()
+        snap = json.loads(text)
+    elif isinstance(source, dict):
+        snap = source
+    else:
+        raise TypeError(f"cannot load devprof from {type(source).__name__}")
+    if not isinstance(snap, dict):
+        raise ValueError("devprof snapshot must be a JSON object")
+    if "sites" not in snap and isinstance(snap.get("devprof"), dict):
+        snap = snap["devprof"]
+    if "sites" not in snap or "occupancy" not in snap:
+        raise ValueError(
+            "not a devprof snapshot: missing 'sites'/'occupancy' sections"
+        )
+    return snap
+
+
+def _pow2_at_least(n: int, floor: int = 1) -> int:
+    cap = max(int(floor), 1)
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+class CostModel:
+    """Deterministic scoring of serving configurations against one
+    devprof snapshot.
+
+    A configuration is the dict the tuner proposes over: ``insert_width``
+    / ``delete_width`` / ``mark_width`` / ``map_width`` (the round stream
+    widths), ``slot_capacity``, ``page_size``, ``fused_depth``.  The
+    score is ``modeled padded-FLOPs + RECOMPILE_WEIGHT * recompiles``,
+    with :meth:`executable_bytes` as the side constraint the tuner
+    enforces.  Same snapshot -> same numbers, always: every term is
+    arithmetic over the snapshot's own tables.
+    """
+
+    #: one recompile's score weight, in modeled-FLOP units.  An XLA
+    #: compile of the staged apply costs seconds of wall — worth more
+    #: than any single round's padded compute; calibrated against
+    #: DISPATCH_WEIGHT so a deeper fused ladder pays for its extra
+    #: variants once the capture shows tens of rounds to amortize over
+    RECOMPILE_WEIGHT = 1e7
+    #: one device dispatch's score weight (the ~11 ms/dispatch platform
+    #: floor the fused pipeline exists to amortize), in modeled-FLOP
+    #: units — this is what makes fused depth a real trade instead of
+    #: "fewest variants always wins"
+    DISPATCH_WEIGHT = 1e6
+
+    def __init__(self, snapshot: Dict[str, Any]) -> None:
+        self.snapshot = load_devprof(snapshot)
+        occ = self.snapshot.get("occupancy") or {}
+        self.rows = []
+        for key in sorted(occ):
+            m = OCC_KEY_RE.match(key)
+            if not m:
+                continue
+            entry = occ[key]
+            self.rows.append({
+                "docs": int(m.group("docs")),
+                "widths": (int(m.group("ki")), int(m.group("kd")),
+                           int(m.group("km")), int(m.group("kp"))),
+                "rounds": int(entry.get("rounds", 0)),
+                "real_ops": int(entry.get("real_ops", 0)),
+                "padded_capacity": int(entry.get("padded_capacity", 0)),
+            })
+        self.total_real_ops = sum(r["real_ops"] for r in self.rows)
+        self.total_padded = sum(r["padded_capacity"] for r in self.rows)
+        self.total_rounds = sum(r["rounds"] for r in self.rows)
+        self._flops_per_op = self._derive_flops_per_op()
+
+    # -- observed terms ----------------------------------------------------
+
+    def _derive_flops_per_op(self) -> float:
+        """Modeled FLOPs per padded op slot, from the captured XLA cost
+        analyses when present (total modeled flops across apply-site
+        buckets / total padded capacity), else the unit default."""
+        flops = 0.0
+        for site in sorted(self.snapshot.get("sites") or {}):
+            buckets = (self.snapshot["sites"][site] or {}).get("buckets") or {}
+            for key in sorted(buckets):
+                cost = (buckets[key] or {}).get("cost") or {}
+                f = cost.get("flops")
+                if isinstance(f, (int, float)) and f > 0:
+                    flops += float(f) * int(buckets[key].get("dispatches", 1))
+        if flops > 0 and self.total_padded:
+            return flops / self.total_padded
+        return DEFAULT_FLOPS_PER_OP
+
+    def observed_config(self) -> Dict[str, Any]:
+        """The configuration the snapshot was captured UNDER — recovered
+        from the snapshot itself (occupancy keys carry the widths; the
+        page-pool section carries the page size), so the proposal's
+        baseline is what actually ran, not what someone remembers
+        configuring."""
+        widths = max(
+            (r["widths"] for r in self.rows), default=(64, 32, 32, 16),
+        )
+        # fused depth: the deepest round-chained dispatch the staged
+        # sites saw (distinct shapes on the stacked/staged sites form the
+        # R-ladder; depth itself isn't in the bucket key, so the ladder
+        # size is the observable)
+        sites = self.snapshot.get("sites") or {}
+        fused_sites = [
+            s for s in sites
+            if "staged_rounds" in s or "stacked_rounds" in s
+        ]
+        fused_depth = 8 if fused_sites else 1
+        pool = self.snapshot.get("page_pool") or {}
+        cfg = {
+            "insert_width": widths[0],
+            "delete_width": widths[1],
+            "mark_width": widths[2],
+            "map_width": widths[3],
+            "slot_capacity": self._observed_slot_capacity(),
+            "page_size": int(pool.get("page_size", 64)),
+            "fused_depth": fused_depth,
+        }
+        return cfg
+
+    def _observed_slot_capacity(self) -> int:
+        """Slot capacity from the page-pool section when paged (allocated
+        slots per resident doc, pow-2), else a conservative pow-2 over
+        the per-doc admitted insert estimate."""
+        pool = self.snapshot.get("page_pool") or {}
+        docs = int(pool.get("docs_resident", 0))
+        if docs and pool.get("allocated_slots"):
+            return _pow2_at_least(
+                -(-int(pool["allocated_slots"]) // docs), 64,
+            )
+        per_doc = self._inserts_per_doc()
+        return _pow2_at_least(int(per_doc * 2) or 64, 64)
+
+    def _inserts_per_doc(self) -> float:
+        """Estimated admitted inserts per doc over the capture: real ops
+        attributed to the insert stream by width share, / docs."""
+        ops = 0.0
+        docs = 0
+        for r in self.rows:
+            k = sum(r["widths"])
+            if k:
+                ops += r["real_ops"] * (r["widths"][0] / k)
+            docs = max(docs, r["docs"])
+        return ops / docs if docs else 0.0
+
+    def utilization(self) -> float:
+        """Real ops / padded capacity over the whole capture."""
+        if not self.total_padded:
+            return 1.0
+        return self.total_real_ops / self.total_padded
+
+    # -- candidate terms ---------------------------------------------------
+
+    def padded_flops(self, config: Dict[str, Any]) -> float:
+        """Modeled padded-FLOPs of replaying the capture under
+        ``config``: each occupancy row's padded capacity rescaled by the
+        candidate/observed total-width ratio (the (D, K) staging planes
+        and the apply's per-slot scan both scale linearly in K), priced
+        at the captured FLOPs-per-op."""
+        k_new = (config["insert_width"] + config["delete_width"]
+                 + config["mark_width"] + config["map_width"])
+        total = 0.0
+        for r in self.rows:
+            k_old = sum(r["widths"])
+            scale = (k_new / k_old) if k_old else 1.0
+            total += r["padded_capacity"] * scale
+        return total * self._flops_per_op
+
+    def recompiles(self, config: Dict[str, Any]) -> int:
+        """Modeled compiled-variant count under ``config``: one apply
+        variant per distinct width set (the one-shape serving discipline
+        keeps this 1), times the fused-depth ladder (a drain of R rounds
+        compiles each depth 1..R it ever commits at — log2 ladder), plus
+        the log2 slot-window ladder up to the slot capacity."""
+        import math
+
+        depth_ladder = int(math.log2(config["fused_depth"])) + 1
+        slot_ladder = max(1, int(math.log2(max(config["slot_capacity"], 2))))
+        return depth_ladder + slot_ladder
+
+    def executable_bytes(self, config: Dict[str, Any]) -> int:
+        """Modeled compiled-program cache footprint: variants x the
+        per-variant executable estimate (peak captured bucket memory
+        stands in for executable size when the snapshot has one)."""
+        per = DEFAULT_EXECUTABLE_BYTES
+        peaks = []
+        for site in sorted(self.snapshot.get("sites") or {}):
+            buckets = (self.snapshot["sites"][site] or {}).get("buckets") or {}
+            for key in sorted(buckets):
+                mem = (buckets[key] or {}).get("memory") or {}
+                pb = mem.get("peak_bytes")
+                if isinstance(pb, (int, float)) and pb > 0:
+                    peaks.append(int(pb))
+        if peaks:
+            per = max(peaks)
+        return self.recompiles(config) * per
+
+    def memory_budget(self) -> Optional[int]:
+        """The executable-bytes budget: a fraction of the device memory
+        the snapshot observed in use at peak (None when the backend
+        exposes no memory stats — the tuner then skips the constraint)."""
+        mem = self.snapshot.get("memory") or {}
+        peak = mem.get("peak_bytes_in_use")
+        if isinstance(peak, (int, float)) and peak > 0:
+            # peak observed use stands in for device capacity scale: the
+            # cache may claim DEFAULT_BUDGET_FRACTION of 10x the peak
+            return int(peak * 10 * DEFAULT_BUDGET_FRACTION)
+        return None
+
+    def dispatches(self, config: Dict[str, Any]) -> float:
+        """Modeled dispatch count of replaying the capture's rounds at
+        ``config``'s fused depth (a drain of R pending rounds is one
+        staged program)."""
+        depth = max(1, int(config["fused_depth"]))
+        return -(-self.total_rounds // depth) if self.total_rounds else 0
+
+    def score(self, config: Dict[str, Any]) -> float:
+        return (self.padded_flops(config)
+                + self.RECOMPILE_WEIGHT * self.recompiles(config)
+                + self.DISPATCH_WEIGHT * self.dispatches(config))

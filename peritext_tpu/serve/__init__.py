@@ -22,6 +22,12 @@ traded latency for batch occupancy on purpose.  Three cooperating pieces:
   overload degrades through the PR-1 quarantine/fallback ladder
   (``force_fallback``: scalar replay, degraded but correct) instead of
   shedding one hot doc's writes forever.
+* :mod:`.fused` — :class:`FusedMuxGroup`: many tenants' muxes fused onto
+  shared ``static_rounds`` device lanes (doc-row ranges assigned by the
+  plan tier's :class:`~..plan.fusion.FusionGroup`), so one batching
+  window commits ONE staged device program per touched lane instead of
+  one per tenant — per-tenant admission, verdicts, and patch streams are
+  untouched, and byte equality with the unfused path holds per tenant.
 * :mod:`.traffic` — the sustained OPEN-LOOP traffic generator behind
   ``bench.py --mode serve``: arrival times are fixed by the offered rate,
   never by service completions, so queue growth under saturation is
@@ -55,6 +61,7 @@ from .admission import (
 )
 from .auth import AuthError, SessionKeyring
 from .fleet import CutoverError, FleetFrontend, FleetHost, FleetStats, HostDown
+from .fused import FusedMuxGroup, default_lane_factory
 from .mux import BatchWindowTuner, SessionMux
 from .traffic import (
     LadderRung,
@@ -74,6 +81,7 @@ __all__ = [
     "FleetFrontend",
     "FleetHost",
     "FleetStats",
+    "FusedMuxGroup",
     "HostDown",
     "LadderRung",
     "OpenLoopResult",
@@ -91,6 +99,7 @@ __all__ = [
     "SessionMux",
     "Verdict",
     "build_arrivals",
+    "default_lane_factory",
     "run_open_loop",
     "sustained_ladder",
 ]

@@ -167,8 +167,30 @@ class SessionMux:
         host: str = "local",
         auth=None,
         auth_per_frame: bool = False,
+        doc_base: int = 0,
+        doc_capacity: Optional[int] = None,
     ) -> None:
         self.session = session
+        #: the doc-row slice of ``session`` this mux owns: a standalone mux
+        #: owns the whole doc axis; a FusedMuxGroup member owns the
+        #: disjoint ``[doc_base, doc_base + doc_capacity)`` range its
+        #: LaneSlot assigned — isolation between fused tenants is this
+        #: range discipline, never a runtime filter
+        self.doc_base = int(doc_base)
+        if not 0 <= self.doc_base <= session.num_docs:
+            raise ValueError(
+                f"doc_base {doc_base} outside session's {session.num_docs} docs"
+            )
+        self.doc_capacity = (
+            int(doc_capacity) if doc_capacity is not None
+            else session.num_docs - self.doc_base
+        )
+        if self.doc_base + self.doc_capacity > session.num_docs:
+            raise ValueError(
+                f"doc range [{self.doc_base}, "
+                f"{self.doc_base + self.doc_capacity}) exceeds session's "
+                f"{session.num_docs} docs"
+            )
         self.admission = admission if admission is not None else AdmissionController()
         self.tuner = tuner if tuner is not None else BatchWindowTuner()
         #: per-session wire auth (serve/auth.SessionKeyring): when set,
@@ -198,6 +220,11 @@ class SessionMux:
         #: round) are appended here — the traffic generator's per-rung
         #: percentile source (the histograms keep the fleet-wide view)
         self.latency_sink: Optional[List[float]] = None
+        #: when this mux rides a fused group, the group's
+        #: ``fusion_snapshot`` callable — snapshot()'s ``fusion`` key
+        #: reports the shared window's stats instead of the standalone
+        #: one-dispatch-per-round identity
+        self._fusion_stats: Optional[Callable[[], Dict]] = None
         #: shed count at the last committed round — snapshot()'s
         #: ``recent_sheds`` (sheds since the tier last kept up) derives
         #: from it, so a host that shed once during a blip and then ran
@@ -218,11 +245,11 @@ class SessionMux:
         never learns whether capacity exists)."""
         if self.auth is not None and not self.auth.verify(client, token):
             return None, self.admission.shed_out_of_band(SHED_UNAUTHORIZED)
-        if self._next_doc >= self.session.num_docs:
+        if self._next_doc >= self.doc_capacity:
             return None, self.admission.shed_out_of_band(SHED_CAPACITY)
         sid = self._next_session
         self._next_session += 1
-        doc = self._next_doc
+        doc = self.doc_base + self._next_doc
         self._next_doc += 1
         self._sessions[sid] = ClientSession(
             session_id=sid, client=client, doc_index=doc,
@@ -330,32 +357,39 @@ class SessionMux:
         assert self._window_opened is not None
         return (self.clock() - self._window_opened) >= self.window_seconds()
 
-    def pump(self, force: bool = False) -> int:
-        """Close the open round if its window expired (or ``force``) and
-        drain it through the device: bulk-ingest the buffered frames
-        (corrupt frames quarantine their doc — per-doc fault isolation,
-        never an exception out of the serving loop), run device rounds to
-        empty, release queue space, and feed the window tuner + latency
-        histograms.  Returns the number of frames applied."""
-        if not self._buffer or not (force or self.window_expired()):
-            return 0
+    def _take_batch(self) -> List[Tuple[int, int, bytes, float]]:
+        """Close the open round: detach the buffered frames and reset the
+        window.  The round-pump's first third, split out so a fused group
+        can take EVERY member's batch before any lane drains."""
         batch, self._buffer = self._buffer, []
         self._window_opened = None
-        t0 = self.clock()
+        return batch
+
+    def _ingest_batch(self, batch: Sequence[Tuple[int, int, bytes, float]],
+                      ) -> None:
+        """Bulk-ingest a taken batch into the backing session (corrupt
+        frames quarantine their doc — per-doc fault isolation, never an
+        exception out of the serving loop).  No drain: the caller owns
+        when the device program runs."""
         self.session.ingest_frames(
             [(doc, frame) for _, doc, frame, _ in batch],
             on_corrupt="quarantine",
         )
-        self.session.drain()
-        t1 = self.clock()
-        wall = max(0.0, t1 - t0)
+
+    def _settle_batch(self, batch: Sequence[Tuple[int, int, bytes, float]],
+                      wall: float, now: float) -> None:
+        """Account a committed batch after its drain: release queue
+        space, feed the window tuner + latency histograms, advance the
+        round/apply tallies.  ``wall`` is the committed round's wall (on
+        a fused group: the SHARED window's wall — every rider pays the
+        window it rode); ``now`` is the commit clock."""
         self.rounds += 1
         self.applied += len(batch)
         self.tuner.observe(wall)
         self.admission.observe_drain(len(batch), wall)
         for sid, _, _, enq in batch:
             self.admission.mark_applied(sid, 1)
-            lat = max(0.0, t1 - enq)
+            lat = max(0.0, now - enq)
             GLOBAL_HISTOGRAMS.observe("serve.apply_seconds", lat)
             if self.latency_sink is not None:
                 self.latency_sink.append(lat)
@@ -366,6 +400,23 @@ class SessionMux:
             # the tier is keeping up again: sheds before this round are
             # history, not current health
             self._shed_mark = self.admission.stats.shed
+
+    def pump(self, force: bool = False) -> int:
+        """Close the open round if its window expired (or ``force``) and
+        drain it through the device: bulk-ingest the buffered frames,
+        run device rounds to empty, release queue space, and feed the
+        window tuner + latency histograms.  Returns the number of frames
+        applied.  (The take/ingest/settle thirds are split methods so
+        :class:`~.fused.FusedMuxGroup` can recompose them around ONE
+        shared lane drain.)"""
+        if not self._buffer or not (force or self.window_expired()):
+            return 0
+        batch = self._take_batch()
+        t0 = self.clock()
+        self._ingest_batch(batch)
+        self.session.drain()
+        t1 = self.clock()
+        self._settle_batch(batch, max(0.0, t1 - t0), t1)
         return len(batch)
 
     def flush(self) -> int:
@@ -418,7 +469,7 @@ class SessionMux:
         sizes = self.session._reshard_sizes()
         slot_load = 0
         host_bound = 0
-        for d in range(self._next_doc):
+        for d in range(self.doc_base, self.doc_base + self._next_doc):
             size = int(sizes[d]) if d < len(sizes) else 0
             if self.session.docs[d].fallback:
                 host_bound += size
@@ -439,6 +490,27 @@ class SessionMux:
         """Sustained-overload flag: backpressure currently engaged, or the
         open buffer alone can't drain (queue at max)."""
         return self.admission.backpressure
+
+    def fusion_snapshot(self) -> Dict:
+        """The ``/serve.json`` ``fusion`` section: how many tenants share
+        this mux's device dispatches.  Standalone, the identity report —
+        one tenant, one lane, one dispatch per committed round.  On a
+        fused group member, the group's shared-window stats (injected via
+        ``_fusion_stats``), so EVERY tenant's scrape shows the
+        amortization it actually got."""
+        if self._fusion_stats is not None:
+            return self._fusion_stats()
+        return {
+            "grouped": False,
+            "tenants": 1,
+            "lanes": 1,
+            "windows": self.rounds,
+            "dispatches": self.rounds,
+            "docs_per_dispatch": float(self._next_doc),
+            "window_occupancy": round(
+                self._next_doc / self.doc_capacity, 4
+            ) if self.doc_capacity else 0.0,
+        }
 
     def snapshot(self) -> Dict:
         """The ``/serve.json`` body (golden-shape test pins these keys):
@@ -464,8 +536,9 @@ class SessionMux:
             "sessions": len(open_sessions),
             "sessions_total": len(self._sessions),
             "docs": self._next_doc,
-            "doc_capacity": self.session.num_docs,
+            "doc_capacity": self.doc_capacity,
             "degraded_docs": self.degraded_docs,
+            "fusion": self.fusion_snapshot(),
             "rounds": self.rounds,
             "applied_frames": self.applied,
             "buffered_frames": len(self._buffer),

@@ -1,0 +1,32 @@
+"""graftlint fixture: the cross-tenant fusion mistake PTL006 exists for.
+
+Fusion-group assembly (plan/fusion.py) decides which tenants' drain
+batches ride the SAME staged device program and in which doc-row order —
+merge scope, even though it lives outside the merge directories (the
+``merge_scope_files`` entry pins it in).  The tempting bug is ordering or
+admitting tenants into a window by a wall-clock read ("who arrived
+first"), which makes the fused dispatch order replica-local: two hosts
+replaying the same committed windows would assemble different programs
+and the byte-equality oracle (fused vs per-session drains) dies.  This
+file is the TRUE POSITIVE proving the rule fires on exactly that; never
+"fix" it.
+"""
+
+import time
+
+
+class WallClockFusionGroup:
+    def __init__(self):
+        self._arrivals = {}
+
+    def admit(self, tenant):
+        # PTL006: wall-clock stamp deciding fusion-window membership
+        self._arrivals[tenant] = time.monotonic()
+
+    def window_order(self, window_opened, window_seconds):
+        # the assembled doc-row order now depends on WHEN this replica
+        # observed each tenant, not on the committed window contents
+        return sorted(
+            t for t, at in self._arrivals.items()
+            if at - window_opened < window_seconds
+        )

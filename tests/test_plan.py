@@ -1,0 +1,427 @@
+"""Device-as-OS planner tests (ISSUE 13): cross-tenant fusion planning
+(tenant -> lane -> doc-row assignment), the FusedMuxGroup serving wiring
+(fused-vs-unfused byte equality, per-tenant verdict isolation, zero
+steady-state compiles), and the closed-loop cost-model planner
+(PlanProposal golden schema + determinism on the committed smoke
+snapshot, CLI exit codes, exporter surfaces)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from peritext_tpu.parallel.codec import encode_frame
+from peritext_tpu.parallel.streaming import StreamingMerge
+from peritext_tpu.plan import (
+    CostModel,
+    FusionGroup,
+    LanePlan,
+    PlanProposal,
+    TenantSpec,
+    load_devprof,
+    propose,
+)
+from peritext_tpu.serve import (
+    ADMIT,
+    AdmissionController,
+    FusedMuxGroup,
+    SessionMux,
+    default_lane_factory,
+)
+from peritext_tpu.testing.fuzz import generate_workload
+
+ACTORS = ("doc1", "doc2", "doc3")
+
+#: the committed plan-smoke devprof capture the golden tests read
+SNAPSHOT = Path(__file__).resolve().parents[1] / "perf" / "plan_devprof.json"
+
+SESSION_KW = dict(
+    slot_capacity=128, mark_capacity=64, tomb_capacity=96,
+    round_insert_capacity=32, round_delete_capacity=16,
+    round_mark_capacity=16,
+)
+
+
+def frame_plans(names, windows, seed, ops_per_doc=24):
+    """One causally-ordered workload per tenant, striped across windows."""
+    workloads = generate_workload(seed=seed, num_docs=len(names),
+                                  ops_per_doc=ops_per_doc)
+    plans = {}
+    for name, w in zip(names, workloads):
+        changes = sorted((ch for log in w.values() for ch in log),
+                         key=lambda c: (c.actor, c.seq))
+        plans[name] = [
+            encode_frame(changes[i::windows]) for i in range(windows)
+        ]
+    return plans
+
+
+def window_plan(names, plans, windows):
+    """Alternating full/sparse windows + a tail that drains leftovers."""
+    out, cursor = [], {n: 0 for n in names}
+    for w in range(windows):
+        active = list(names) if w % 2 == 0 else names[(w // 2) % 4::4]
+        step = []
+        for n in active:
+            if cursor[n] < windows:
+                step.append((n, plans[n][cursor[n]]))
+                cursor[n] += 1
+        out.append(step)
+    tail = [(n, plans[n][c]) for n in names for c in range(cursor[n], windows)]
+    if tail:
+        out.append(tail)
+    return out
+
+
+def build_group(specs, admission_factory=None):
+    group = FusedMuxGroup(
+        specs, default_lane_factory(ACTORS, **SESSION_KW),
+        admission_factory=admission_factory, host="test",
+    )
+    sids = {}
+    for spec in specs:
+        sid, verdict = group.open_session(spec.tenant, "client")
+        assert verdict.admitted
+        sids[spec.tenant] = sid
+    return group, sids
+
+
+def build_solo(specs, admission_factory=None):
+    muxes, sids = {}, {}
+    for spec in specs:
+        mux = SessionMux(
+            StreamingMerge(num_docs=1, actors=ACTORS,
+                           static_rounds=(spec.layout == "padded"),
+                           layout=spec.layout, **SESSION_KW),
+            admission=(admission_factory() if admission_factory else None),
+            host="test-solo",
+        )
+        sid, verdict = mux.open_session("client")
+        assert verdict.admitted
+        muxes[spec.tenant], sids[spec.tenant] = mux, sid
+    return muxes, sids
+
+
+def drive_group(group, sids, plan):
+    for step in plan:
+        for n, frame in step:
+            assert group.submit(n, sids[n], frame).admitted
+        group.flush()
+
+
+def drive_solo(muxes, sids, plan):
+    for step in plan:
+        touched = []
+        for n, frame in step:
+            assert muxes[n].submit(sids[n], frame).admitted
+            touched.append(n)
+        for n in dict.fromkeys(touched):
+            muxes[n].flush()
+
+
+# ---------------------------------------------------------------------------
+# fusion planning (pure assignment, no device)
+# ---------------------------------------------------------------------------
+
+
+class TestFusionGroup:
+    def test_assignment_is_deterministic_and_disjoint(self):
+        specs = [TenantSpec(tenant=f"t{i}", docs=1 + i % 3) for i in range(9)]
+        a = FusionGroup(specs, lane_capacity=64)
+        b = FusionGroup(list(reversed(specs)), lane_capacity=64)
+        assert a.to_json() == b.to_json()
+        rows = []
+        for slot in a.slots.values():
+            rows.append((slot.lane, slot.doc_base, slot.doc_base + slot.docs))
+        rows.sort()
+        for (lane1, _, end1), (lane2, base2, _) in zip(rows, rows[1:]):
+            if lane1 == lane2:
+                assert end1 <= base2, "tenant doc ranges alias"
+
+    def test_lane_capacity_opens_new_lanes(self):
+        specs = [TenantSpec(tenant=f"t{i}", docs=4) for i in range(6)]
+        g = FusionGroup(specs, lane_capacity=8)
+        assert len(g.lanes) == 3
+        for plan in g.lanes:
+            assert plan.docs <= 8
+            assert isinstance(plan, LanePlan)
+
+    def test_layouts_never_share_a_lane(self):
+        specs = [TenantSpec(tenant="p0", docs=2),
+                 TenantSpec(tenant="p1", docs=2),
+                 TenantSpec(tenant="q0", docs=2, layout="paged")]
+        g = FusionGroup(specs)
+        assert len(g.lanes) == 2
+        assert {p.layout for p in g.lanes} == {"padded", "paged"}
+
+    def test_window_rows_uniform_subset(self):
+        specs = [TenantSpec(tenant=f"t{i}", docs=2) for i in range(4)]
+        g = FusionGroup(specs)
+        rows = g.window_rows(0, ["t1", "t3"])
+        assert rows == ((2, 6), 2)
+
+    def test_window_rows_full_lane_and_ragged_mix_fall_back(self):
+        specs = [TenantSpec(tenant="a", docs=2), TenantSpec(tenant="b", docs=2),
+                 TenantSpec(tenant="c", docs=4)]
+        g = FusionGroup(specs)
+        # ragged active mix (2-doc + 4-doc blocks) -> full-lane staging
+        assert g.window_rows(0, ["a", "c"]) is None
+        # every tenant active -> full-lane staging is strictly cheaper
+        assert g.window_rows(0, ["a", "b", "c"]) is None
+
+    def test_window_occupancy(self):
+        specs = [TenantSpec(tenant=f"t{i}", docs=1) for i in range(8)]
+        g = FusionGroup(specs)
+        assert g.window_occupancy(0, ["t0", "t1"]) == pytest.approx(0.25)
+        assert g.window_occupancy(0, [s.tenant for s in specs]) == 1.0
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            TenantSpec(tenant="", docs=1)
+        with pytest.raises(ValueError):
+            TenantSpec(tenant="t", docs=0)
+        with pytest.raises(ValueError):
+            TenantSpec(tenant="t", docs=1, layout="columnar")
+        with pytest.raises(ValueError):
+            FusionGroup([TenantSpec(tenant="t", docs=1)] * 2)
+        with pytest.raises(ValueError):
+            FusionGroup([TenantSpec(tenant="t", docs=9)], lane_capacity=8)
+
+    def test_wrong_lane_rejected(self):
+        specs = [TenantSpec(tenant="p", docs=1),
+                 TenantSpec(tenant="q", docs=1, layout="paged")]
+        g = FusionGroup(specs)
+        with pytest.raises(ValueError):
+            g.window_rows(g.slots["p"].lane, ["q"])
+
+
+# ---------------------------------------------------------------------------
+# fused serving: byte equality, isolation, steady state
+# ---------------------------------------------------------------------------
+
+
+class TestFusedServing:
+    @pytest.mark.parametrize("seed", [3, 11, 27])
+    def test_fused_byte_equal_to_standalone(self, seed):
+        names = [f"t{i:02d}" for i in range(6)]
+        specs = [TenantSpec(tenant=n, docs=1) for n in names]
+        plans = frame_plans(names, 4, seed)
+        plan = window_plan(names, plans, 4)
+        group, gsids = build_group(specs)
+        drive_group(group, gsids, plan)
+        muxes, ssids = build_solo(specs)
+        drive_solo(muxes, ssids, plan)
+        for n in names:
+            assert group.patches(n, gsids[n]) == muxes[n].patches(ssids[n])
+            assert group.read(n, gsids[n]) == muxes[n].read(ssids[n])
+        fusion = group.fusion_snapshot()
+        assert fusion["grouped"] is True
+        assert fusion["lanes"] == 1
+        assert fusion["windows"] == len(plan)
+
+    def test_mixed_layout_window_stays_byte_equal(self):
+        """Padded, paged, and ragged tenants in ONE window: one lane per
+        layout (padded static_rounds, paged/ragged fused pipeline), one
+        shared drain per touched lane, every tenant byte-equal to its
+        standalone twin."""
+        specs = ([TenantSpec(tenant=f"p{i}", docs=1) for i in range(2)]
+                 + [TenantSpec(tenant=f"q{i}", docs=1, layout="paged")
+                    for i in range(2)]
+                 + [TenantSpec(tenant=f"r{i}", docs=1, layout="ragged")
+                    for i in range(2)])
+        names = [s.tenant for s in specs]
+        plans = frame_plans(names, 3, seed=41)
+        plan = window_plan(names, plans, 3)
+        group, gsids = build_group(specs)
+        assert len(group.group.lanes) == 3
+        drive_group(group, gsids, plan)
+        muxes, ssids = build_solo(specs)
+        drive_solo(muxes, ssids, plan)
+        for n in names:
+            assert group.patches(n, gsids[n]) == muxes[n].patches(ssids[n])
+            assert group.read(n, gsids[n]) == muxes[n].read(ssids[n])
+
+    def test_verdict_identity_and_isolation_under_overload(self):
+        """Each tenant's admission verdicts under overload are IDENTICAL
+        to its standalone twin's, and one tenant's burst never leaks into
+        another tenant's verdicts — isolation is per-controller, not a
+        shared-queue side effect."""
+        tight = dict(max_depth=4, high_watermark=0.5, low_watermark=0.25,
+                     shed_after=2, session_quota=None)
+        names = ["busy", "idle"]
+        specs = [TenantSpec(tenant=n, docs=1) for n in names]
+        plans = frame_plans(names, 2, seed=7)
+        group, gsids = build_group(
+            specs, admission_factory=lambda: AdmissionController(**tight))
+        muxes, ssids = build_solo(
+            specs, admission_factory=lambda: AdmissionController(**tight))
+        burst = plans["busy"] * 6
+        fused_verdicts = [group.submit("busy", gsids["busy"], f) for f in burst]
+        solo_verdicts = [muxes["busy"].submit(ssids["busy"], f) for f in burst]
+        assert ([(v.kind, v.reason) for v in fused_verdicts]
+                == [(v.kind, v.reason) for v in solo_verdicts])
+        kinds = {v.kind for v in fused_verdicts}
+        assert kinds != {ADMIT}, "burst never tripped admission"
+        # the idle tenant is untouched by its neighbor's overload —
+        # mirrored into both arms so the accounting stays comparable
+        assert group.submit("idle", gsids["idle"], plans["idle"][0]).kind \
+            == ADMIT
+        assert muxes["idle"].submit(ssids["idle"], plans["idle"][0]).kind \
+            == ADMIT
+        group.flush()
+        for n in names:
+            muxes[n].flush()
+        for n in names:
+            fused = group.muxes[n].admission.stats
+            solo = muxes[n].admission.stats
+            assert (fused.submitted, fused.admitted, fused.delayed,
+                    fused.shed) == (solo.submitted, solo.admitted,
+                                    solo.delayed, solo.shed)
+
+    def test_repeat_window_plan_compiles_nothing(self):
+        from peritext_tpu.observability import RecompileSentinel
+
+        names = [f"t{i}" for i in range(4)]
+        specs = [TenantSpec(tenant=n, docs=1) for n in names]
+        plans = frame_plans(names, 3, seed=13)
+        plan = window_plan(names, plans, 3)
+        cold, csids = build_group(specs)
+        drive_group(cold, csids, plan)
+        with RecompileSentinel() as sentinel:
+            sentinel.mark()
+            warm, wsids = build_group(specs)
+            drive_group(warm, wsids, plan)
+            sentinel.assert_steady_state("fused multi-tenant repeat plan")
+        for n in names:
+            assert warm.read(n, wsids[n]) == cold.read(n, csids[n])
+
+    def test_one_dispatch_per_window_per_lane(self):
+        from peritext_tpu.obs import GLOBAL_COUNTERS
+
+        names = [f"t{i}" for i in range(8)]
+        specs = [TenantSpec(tenant=n, docs=1) for n in names]
+        plans = frame_plans(names, 4, seed=19)
+        plan = window_plan(names, plans, 4)
+        group, gsids = build_group(specs)
+        d0 = GLOBAL_COUNTERS.get("streaming.fused_dispatches")
+        drive_group(group, gsids, plan)
+        delta = int(GLOBAL_COUNTERS.get("streaming.fused_dispatches") - d0)
+        assert delta == len(plan), (
+            f"{delta} staged programs over {len(plan)} windows")
+        assert group.fusion_snapshot()["dispatches"] == len(plan)
+
+
+# ---------------------------------------------------------------------------
+# the closed-loop planner
+# ---------------------------------------------------------------------------
+
+
+class TestPlanProposal:
+    def test_golden_schema_on_committed_snapshot(self):
+        proposal = propose(SNAPSHOT)
+        body = proposal.to_json()
+        assert set(body) == {"proposal", "current", "modeled"}
+        assert set(body["proposal"]) == {
+            "insert_width", "delete_width", "mark_width", "map_width",
+            "slot_capacity", "page_size", "fused_depth", "window_seconds",
+        }
+        for key in ("current_score", "proposed_score", "savings_frac",
+                    "padded_flops_current", "padded_flops_proposed",
+                    "recompiles_current", "recompiles_proposed",
+                    "dispatches_current", "dispatches_proposed",
+                    "executable_bytes", "budget_bytes", "utilization",
+                    "tolerance"):
+            assert key in body["modeled"], key
+        assert isinstance(proposal, PlanProposal)
+
+    def test_proposal_is_deterministic(self):
+        snap = load_devprof(SNAPSHOT)
+        assert propose(snap).to_json() == propose(snap).to_json()
+
+    def test_beats_current_matches_modeled_scores(self):
+        proposal = propose(SNAPSHOT)
+        cur = proposal.modeled["current_score"]
+        new = proposal.modeled["proposed_score"]
+        assert proposal.beats_current() == ((cur - new) / cur > 0.10)
+        # an infinite tolerance band can never be beaten
+        assert not proposal.beats_current(tolerance=float("inf"))
+
+    def test_load_devprof_contract(self, tmp_path):
+        snap = load_devprof(SNAPSHOT)
+        # the /health.json-style wrapper is unwrapped
+        assert load_devprof({"devprof": snap}) == snap
+        with pytest.raises(ValueError):
+            load_devprof({"not": "a snapshot"})
+        bad = tmp_path / "garbage.json"
+        bad.write_text("{not json")
+        with pytest.raises(json.JSONDecodeError):
+            load_devprof(bad)
+
+    def test_cost_model_scores_proposed_no_worse(self):
+        model = CostModel(load_devprof(SNAPSHOT))
+        proposal = propose(SNAPSHOT)
+        cand = {k: getattr(proposal, k)
+                for k in ("insert_width", "delete_width", "mark_width",
+                          "map_width", "slot_capacity", "page_size",
+                          "fused_depth")}
+        assert model.score(cand) <= model.score(model.observed_config())
+
+    def test_cli_exit_codes(self, capsys, tmp_path):
+        from peritext_tpu.obs.__main__ import main as obs_main
+
+        proposal = propose(SNAPSHOT)
+        rc = obs_main(["plan", str(SNAPSHOT), "--json"])
+        assert rc == (1 if proposal.beats_current() else 0)
+        body = json.loads(capsys.readouterr().out)
+        assert body["proposal"] == proposal.to_json()["proposal"]
+        assert body["beats_current"] == proposal.beats_current()
+        # an unbeatable tolerance band is exit 0 ("statics are fine")
+        assert obs_main(["plan", str(SNAPSHOT), "--json",
+                         "--tolerance", "1000000"]) == 0
+        bad = tmp_path / "garbage.json"
+        bad.write_text("{not json")
+        assert obs_main(["plan", str(bad)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# surfaces: lint scope, health, gauges
+# ---------------------------------------------------------------------------
+
+
+class TestPlanSurfaces:
+    def test_fusion_assembly_is_merge_scope_for_graftlint(self):
+        from peritext_tpu.analysis.engine import LintConfig
+
+        scope = LintConfig().merge_scope_files
+        assert "plan/fusion.py" in scope
+        assert "plan/model.py" not in scope  # observability: clocks legal
+
+    def test_health_snapshot_carries_plan_verdict(self):
+        from peritext_tpu.obs import health_snapshot
+
+        proposal = propose(SNAPSHOT)
+        snap = health_snapshot(plan=proposal)
+        assert snap["plan"] == proposal.to_json()
+        assert json.loads(json.dumps(snap))["plan"] == proposal.to_json()
+
+    def test_prometheus_plan_gauges(self):
+        from peritext_tpu.obs import prometheus_text
+
+        proposal = propose(SNAPSHOT)
+        text = prometheus_text(plan=proposal)
+        for metric in ("peritext_plan_current_score",
+                       "peritext_plan_proposed_score",
+                       "peritext_plan_savings_frac",
+                       "peritext_plan_proposed_fused_depth"):
+            assert metric in text, metric
+
+    def test_prometheus_fusion_gauges_from_mux(self):
+        from peritext_tpu.obs import prometheus_text
+
+        names = ["t0", "t1"]
+        specs = [TenantSpec(tenant=n, docs=1) for n in names]
+        group, _ = build_group(specs)
+        text = prometheus_text(serve=group.muxes["t0"])
+        assert "peritext_plan_fusion_grouped 1" in text
+        assert "peritext_plan_fusion_tenants 2" in text
+        assert "peritext_plan_fusion_lanes 1" in text

@@ -485,10 +485,17 @@ class TestSessionMux:
         snap = mux.snapshot()
         assert set(snap) == {
             "host", "layout", "fused_pipeline", "sessions", "sessions_total",
-            "docs", "doc_capacity", "degraded_docs", "rounds",
+            "docs", "doc_capacity", "degraded_docs", "fusion", "rounds",
             "applied_frames", "buffered_frames", "overloaded",
             "recent_sheds", "load", "queue", "window", "session_table",
         }
+        # the fusion section: standalone identity report (a FusedMuxGroup
+        # member reports the shared window's stats under the SAME keys)
+        assert set(snap["fusion"]) == {
+            "grouped", "tenants", "lanes", "windows", "dispatches",
+            "docs_per_dispatch", "window_occupancy",
+        }
+        assert snap["fusion"]["grouped"] is False
         # the load section is FleetRouter.observe keyword-compatible (the
         # fleet frontend feeds placement straight from this surface)
         assert {"slot_load", "host_bound_load", "docs"} <= set(snap["load"])
