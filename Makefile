@@ -9,7 +9,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 	bench bench-smoke bench-streaming bench-fused entry dryrun lint lint-baseline \
 	clean obs fleet perf-gate serve-smoke bench-serve paged-smoke bench-longdoc \
 	fused-smoke fleet-serve-smoke bench-fleet-serve bench-markheavy \
-	ragged-smoke plan-smoke bench-serve-fused
+	ragged-smoke plan-smoke bench-serve-fused mesh-smoke bench-mesh
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -110,6 +110,19 @@ plan-smoke:
 bench-serve-fused:
 	$(PY) bench.py --mode serve-fused
 
+# mesh-sharded host smoke (mirrors the CI mesh-smoke job): 1/2/4/8-shard
+# doc-axis drains byte-equal to single-device across all three layouts,
+# one shard_map program per drain batch, zero steady-state compiles, the
+# collective reshard byte-preserving, peritext_mesh_* gauges rendered
+# (artifacts land in /tmp/pt-mesh)
+mesh-smoke:
+	$(CPU_ENV) $(PY) scripts/mesh_smoke.py --out /tmp/pt-mesh
+
+# sustained mesh drain throughput: the 1/2/4/8-shard rung sweep with byte
+# equality and the one-dispatch contract asserted in-row
+bench-mesh:
+	$(PY) bench.py --mode mesh
+
 # mark-heavy editorial pass (span-overlap explosion) vs the scalar oracle
 bench-markheavy:
 	$(PY) bench.py --mode markheavy
@@ -140,7 +153,7 @@ bench-engine:  # device-only streaming replay: the engine limit vs the link
 # ledger, then gated with per-row tolerance bands (exit 1 on regression)
 perf-gate:
 	cp perf/reference_ledger.jsonl /tmp/pt-perf-gate.jsonl
-	PT_BENCH_LADDER_ROWS="streaming,streaming_fused,wire,serve_sustained,serve_multitenant,batch_longdoc,batch_8k_ragged,markheavy,fleet_serve" $(PY) bench.py \
+	PT_BENCH_LADDER_ROWS="streaming,streaming_fused,wire,serve_sustained,serve_multitenant,batch_longdoc,batch_8k_ragged,markheavy,fleet_serve,serve_mesh_sustained" $(PY) bench.py \
 		--mode ladder --smoke --platform cpu --devprof \
 		--ledger /tmp/pt-perf-gate.jsonl
 	$(PY) -m peritext_tpu.obs perf /tmp/pt-perf-gate.jsonl --gate

@@ -907,6 +907,7 @@ def orchestrate(args, passthrough) -> int:
             "batch": "crdt_ops_per_sec_per_chip",
             "serve": "serve_sustained_docs_per_sec",
             "serve-fused": "serve_multitenant_dispatch_amortization",
+            "mesh": "mesh_sustained_ops_per_sec",
             "storm": "reconnect_storm_drain_ops_per_sec",
             "longdoc": "longdoc_ragged_ops_per_sec",
             "markheavy": "markheavy_ops_per_sec",
@@ -1947,6 +1948,109 @@ def run_sweep(args) -> dict:
     }
 
 
+def run_mesh(args) -> dict:
+    """Mesh-sharded host row (ISSUE 14): the doc-axis ``shard_map`` fused
+    drain swept over shard counts, byte equality vs the single-device
+    fused path asserted in-row.
+
+    Each rung builds a fresh paged-layout session over a 1/2/4/8-device
+    mesh (virtual CPU devices on a single-chip host — the flag must land
+    before the backend initializes, hence the env fixup below), replays
+    the same fuzz workload through the fused drain, asserts digest +
+    ``read_all`` equality against the meshless fused reference, and times
+    steady-state replay sessions (the warmup session pays the rung's
+    compiles; the jit + mesh_fn caches carry them across sessions).  A
+    drain batch is ONE staged program for the whole mesh, so the rung's
+    fused-dispatch count rides along with ``speedup_vs_1shard``."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from peritext_tpu.obs import GLOBAL_COUNTERS
+    from peritext_tpu.parallel.streaming import StreamingMerge
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    d = args.docs
+    opd = args.ops_per_doc
+    workloads = generate_workload(seed=args.seed + 19, num_docs=d,
+                                  ops_per_doc=opd)
+    changes = [[ch for log in w.values() for ch in log] for w in workloads]
+    total_ops = sum(len(c.ops) for log in changes for c in log)
+
+    def replay(mesh):
+        sess = StreamingMerge(
+            num_docs=d, actors=("doc1", "doc2", "doc3"),
+            layout="paged", mesh=mesh,
+            slot_capacity=max(256, 4 * opd), mark_capacity=max(128, opd),
+            tomb_capacity=max(128, opd),
+        )
+        for doc, log in enumerate(changes):
+            sess.ingest(doc, log)
+        sess.drain()
+        return sess
+
+    ref = replay(None)
+    ref_digest = ref.digest()
+    ref_spans = ref.read_all()
+
+    devices = jax.devices()
+    shard_counts = [n for n in (1, 2, 4, 8)
+                    if n <= len(devices) and d % n == 0]
+    iters = max(2, args.iters // 2)
+    rungs = []
+    base_ops_per_sec = None
+    for n in shard_counts:
+        mesh = Mesh(np.asarray(devices[:n]), ("docs",))
+        # warmup replay: pays the rung's compiles AND is the oracle check
+        sess = replay(mesh)
+        assert sess.digest() == ref_digest, f"{n}-shard digest diverged"
+        assert sess.read_all() == ref_spans, f"{n}-shard read_all diverged"
+        d0 = GLOBAL_COUNTERS.get("streaming.fused_dispatches")
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            sess = replay(mesh)
+        elapsed = max(time.perf_counter() - t0, 1e-9)
+        ops_per_sec = total_ops * iters / elapsed
+        if base_ops_per_sec is None:
+            base_ops_per_sec = ops_per_sec
+        stats = sess._mesh_stats()
+        rungs.append({
+            "shards": n,
+            "ops_per_sec": round(ops_per_sec, 1),
+            "seconds": round(elapsed, 3),
+            "sessions": iters,
+            "fused_dispatches": int(
+                GLOBAL_COUNTERS.get("streaming.fused_dispatches") - d0
+            ),
+            "speedup_vs_1shard": round(ops_per_sec / base_ops_per_sec, 3),
+            "imbalance_ratio": stats.get("imbalance_ratio"),
+            "ici_page_moves": stats.get("ici_page_moves"),
+            "equality": "byte-identical",
+        })
+    widest = rungs[-1]
+    return {
+        "metric": "mesh_sustained_ops_per_sec",
+        "value": widest["ops_per_sec"],
+        "unit": "ops/s",
+        "vs_baseline": None,
+        "baseline_impl": "single-device fused drain, byte equality in-row",
+        "layout": "paged",
+        "docs": d,
+        "ops_per_doc": opd,
+        "shards": widest["shards"],
+        "speedup_vs_1shard": widest["speedup_vs_1shard"],
+        "rungs": rungs,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def ladder_rows(platform: str):
     """The evidence-ladder row specs: (name, BASELINE config tag, worker
     args, platform, timeout).  Ordered so the highest-value rows land first
@@ -1972,6 +2076,9 @@ def ladder_rows(platform: str):
         # the multi-tenant fused-dispatch row (ISSUE 13): N small tenants
         # on one lane vs per-session drains, byte equality asserted in-row
         ("serve_multitenant", "-", ["--mode", "serve-fused"], platform, t),
+        # the mesh-sharded host row (ISSUE 14): shard_map fused drain over
+        # 1/2/4/8 virtual devices, single-device byte equality in-row
+        ("serve_mesh_sustained", "-", ["--mode", "mesh"], "cpu", t),
         ("reconnect_storm", "-", ["--mode", "storm"], platform, t),
         ("batch_longdoc", "4b", ["--mode", "longdoc"], platform, t),
         ("markheavy",    "-",  ["--mode", "markheavy"], platform, t),
@@ -2181,7 +2288,8 @@ def main() -> None:
         "--mode",
         choices=("batch", "streaming", "streaming-fused", "engine", "wire",
                  "sweep", "baselines", "fleet", "serve", "serve-fused",
-                 "storm", "longdoc", "markheavy", "fleet-serve", "ladder"),
+                 "mesh", "storm", "longdoc", "markheavy", "fleet-serve",
+                 "ladder"),
         default=None,
         help="batch = one-shot converge (configs 2-4); streaming = config 5 "
              "end-to-end; engine = device-only streaming replay (the engine "
@@ -2192,6 +2300,8 @@ def main() -> None:
              "at a p99 apply-latency SLO, ISSUE 7); serve-fused = N small "
              "tenants fused onto one device lane vs per-session dispatch "
              "(dispatch amortization + byte equality, ISSUE 13); "
+             "mesh = doc-axis-sharded shard_map fused drain swept over "
+             "shard counts (single-device byte equality in-row, ISSUE 14); "
              "storm = reconnect-storm "
              "backlog drain under serving load; longdoc = long-tail "
              "paged-vs-padded comparison (one essay among a tweet fleet, "
@@ -2301,6 +2411,9 @@ def main() -> None:
     elif args.mode == "serve-fused":
         # --docs = the tenant count (one doc slot per small tenant)
         defaults = (16, 48, 0, 0) if args.smoke else (32, 96, 0, 0)
+    elif args.mode == "mesh":
+        # docs stay divisible by every swept shard count (1/2/4/8)
+        defaults = (16, 48, 0, 0) if args.smoke else (64, 96, 0, 0)
     elif args.mode == "storm":
         defaults = (4, 30, 0, 0) if args.smoke else (8, 64, 0, 0)
     elif args.mode == "longdoc":
@@ -2324,7 +2437,8 @@ def main() -> None:
                "engine": run_engine, "batch": run,
                "wire": run_wire, "sweep": run_sweep, "baselines": run_baselines,
                "fleet": run_fleet_heal, "serve": run_serve,
-               "serve-fused": run_serve_fused, "storm": run_storm,
+               "serve-fused": run_serve_fused, "mesh": run_mesh,
+               "storm": run_storm,
                "longdoc": run_longdoc, "markheavy": run_markheavy,
                "fleet-serve": run_fleet_serve}
     if args.devprof:

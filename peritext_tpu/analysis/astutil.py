@@ -131,9 +131,24 @@ def jit_roots(tree: ast.Module) -> Tuple[Dict[str, JitSpec], Dict[int, JitSpec]]
                 callables[target.id] = spec
         if node.value.args:
             wrapped = dotted_name(node.value.args[0])
+            if wrapped is None:
+                wrapped = _shard_map_body(node.value.args[0])
             if wrapped in defs:
                 root_defs[id(defs[wrapped])] = spec
     return callables, root_defs
+
+
+def _shard_map_body(node: ast.AST) -> Optional[str]:
+    """The mapped body's name if ``node`` is a ``shard_map(body, ...)``
+    call — the body executes under the enclosing trace, so
+    ``jit(shard_map(body, ...))`` roots ``body`` exactly like
+    ``jit(body)`` would."""
+    if not (isinstance(node, ast.Call) and node.args):
+        return None
+    name = dotted_name(node.func)
+    if name is None or not name.endswith("shard_map"):
+        return None
+    return dotted_name(node.args[0])
 
 
 def traced_params(fn: ast.AST, spec: JitSpec) -> Set[str]:
@@ -169,6 +184,11 @@ def called_local_names(fn: ast.AST) -> Set[str]:
             and func.value.id in ("self", "cls")
         ):
             out.add(func.attr)
+        # shard_map(body, ...) runs body under the caller's trace: an edge
+        # to body, not just to shard_map itself
+        body = _shard_map_body(node)
+        if body is not None:
+            out.add(body)
     return out
 
 

@@ -150,6 +150,8 @@ class DeviceProfiler:
         self._page_pool: Optional[Dict[str, Any]] = None
         self._page_pool_peak_util = 0.0
         self._ragged: Optional[Dict[str, int]] = None
+        self._mesh: Optional[Dict[str, Any]] = None
+        self._mesh_peak_imbalance = 0.0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -173,6 +175,8 @@ class DeviceProfiler:
             self._page_pool = None
             self._page_pool_peak_util = 0.0
             self._ragged = None
+            self._mesh = None
+            self._mesh_peak_imbalance = 0.0
 
     def __enter__(self) -> "DeviceProfiler":
         return self.enable()
@@ -285,6 +289,18 @@ class DeviceProfiler:
             util = float(stats.get("pool_utilization") or 0.0)
             self._page_pool_peak_util = max(self._page_pool_peak_util, util)
 
+    def observe_mesh(self, stats: Dict[str, Any]) -> None:
+        """Fold one mesh-shard snapshot (a sharded session's
+        ``_mesh_stats()`` / the sharded store's ``shard_stats()``) in:
+        latest snapshot kept whole (per-shard load/utilization, the
+        cumulative ICI page-move count) plus a peak shard-imbalance
+        watermark across the profiled region — the doc-axis analog of the
+        page-pool waste story."""
+        with self._lock:
+            self._mesh = dict(stats)
+            ratio = float(stats.get("imbalance_ratio") or 0.0)
+            self._mesh_peak_imbalance = max(self._mesh_peak_imbalance, ratio)
+
     def observe_ragged(self, docs_walked: int, pages_walked: int,
                        real_ops: int, padded_slot_waste: int = 0,
                        dispatches: int = 1) -> None:
@@ -379,6 +395,12 @@ class DeviceProfiler:
                 else None
             )
             ragged = dict(self._ragged) if self._ragged is not None else None
+            mesh = (
+                dict(self._mesh,
+                     peak_imbalance=round(self._mesh_peak_imbalance, 4))
+                if self._mesh is not None
+                else None
+            )
         return {
             "enabled": self.enabled,
             "capture_costs": self.capture_costs,
@@ -396,6 +418,8 @@ class DeviceProfiler:
             "page_pool": page_pool,
             # None until a ragged apply reports in (same discipline)
             "ragged": ragged,
+            # None until a mesh-sharded session reports in (same discipline)
+            "mesh": mesh,
         }
 
 

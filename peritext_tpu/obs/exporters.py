@@ -63,7 +63,10 @@ def prometheus_text(
     fleet-level totals; a :class:`~.devprof.DeviceProfiler` lands as
     per-site ``peritext_device_*`` gauges (distinct compiled shapes,
     dispatches, modeled flops/bytes totals, peak executable memory) plus
-    the bucket-occupancy and device-memory-watermark totals; a
+    the bucket-occupancy and device-memory-watermark totals, and — when a
+    mesh-sharded session reported in — ``peritext_mesh_*`` gauges
+    (per-shard pool load/utilization, shard-imbalance ratio, cumulative
+    ICI page moves); a
     :class:`~..serve.SessionMux` lands as ``peritext_serve_*`` gauges
     (sessions, bounded-queue depth/peak, backpressure flag, autotuned
     window) plus the typed-verdict counters, with sheds labelled by
@@ -206,6 +209,31 @@ def prometheus_text(
             ):
                 lines.append(f"# TYPE {m} gauge")
                 lines.append(f"{m} {_fmt(value)}")
+        ms = dp.get("mesh")
+        if ms:
+            # mesh-shard gauges (store/sharded shard_stats via the session's
+            # _mesh_stats): doc-axis balance across the sharded page pools
+            # plus the cumulative ICI page-move tally from reshards
+            for m, value in (
+                ("peritext_mesh_shards", ms["shards"]),
+                ("peritext_mesh_rows_per_shard", ms["rows_per_shard"]),
+                ("peritext_mesh_shard_imbalance_ratio",
+                 ms["imbalance_ratio"]),
+                ("peritext_mesh_peak_imbalance_ratio",
+                 ms.get("peak_imbalance", ms["imbalance_ratio"])),
+                ("peritext_mesh_ici_page_moves",
+                 ms.get("ici_page_moves", 0)),
+            ):
+                lines.append(f"# TYPE {m} gauge")
+                lines.append(f"{m} {_fmt(value)}")
+            m = "peritext_mesh_shard_load"
+            lines.append(f"# TYPE {m} gauge")
+            for shard, value in enumerate(ms.get("shard_load") or ()):
+                lines.append(f'{m}{{shard="{shard}"}} {_fmt(value)}')
+            m = "peritext_mesh_shard_pool_utilization"
+            lines.append(f"# TYPE {m} gauge")
+            for shard, value in enumerate(ms.get("shard_utilization") or ()):
+                lines.append(f'{m}{{shard="{shard}"}} {_fmt(value)}')
         mem = dp["memory"]
         if mem["available"]:
             for m, value in (

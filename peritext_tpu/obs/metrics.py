@@ -61,6 +61,7 @@ def health_snapshot(
     serve=None,
     fleet=None,
     plan=None,
+    mesh=None,
 ) -> Dict[str, Any]:
     """One structured dict for a fleet health endpoint: every fault-domain
     counter (quarantines, corrupt frames, transport retries / behind peers,
@@ -86,7 +87,10 @@ def health_snapshot(
     :class:`~..plan.tuner.PlanProposal`, anything with ``to_json()``, or
     a plain dict), the proposal/current/modeled body appears under
     ``plan`` — the device-as-OS planner's advice rides the SAME health
-    surface the rest of the fleet scrapes.  Everything in the snapshot is
+    surface the rest of the fleet scrapes; with a mesh-shard stats dict
+    (a sharded session's ``_mesh_stats()`` / sharded store's
+    ``shard_stats()``), the per-shard load/utilization and ICI page-move
+    tallies appear under ``mesh``.  Everything in the snapshot is
     JSON-serializable (the exporter-schema golden test pins this)."""
     from .histograms import GLOBAL_HISTOGRAMS
 
@@ -125,4 +129,6 @@ def health_snapshot(
         out["plan"] = (
             plan.to_json() if hasattr(plan, "to_json") else dict(plan)
         )
+    if mesh is not None:
+        out["mesh"] = dict(mesh)
     return out

@@ -93,7 +93,7 @@ from .causal import causal_schedule
 from .codec import decode_frame, encode_frame, strip_trace_context
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .mesh import convergence_digest, shard_docs
+from .mesh import DOC_AXIS, convergence_digest, shard_docs
 
 @partial(jax.jit, static_argnums=1)
 def _resolve_digest_jit(state: PackedDocs, comment_capacity: int, row_mask):
@@ -203,9 +203,6 @@ def _split_blocks(state: PackedDocs, bounds: tuple):
 
 
 
-_GATHER_ROWS_CACHE: Dict = {}
-
-
 def _gather_rows(state: PackedDocs, rows_idx, mesh) -> PackedDocs:
     """K-row gather along the doc axis for the touched-rows digest.
 
@@ -222,43 +219,46 @@ def _gather_rows(state: PackedDocs, rows_idx, mesh) -> PackedDocs:
 
 
 def gather_rows_fn(mesh):
-    """The jitted K-row gather for ``mesh`` (cached).  Exposed as a
-    function so the HLO-inspection test can ``.lower()`` exactly the
-    program :func:`_gather_rows` dispatches."""
-    fn = _GATHER_ROWS_CACHE.get(mesh)
-    if fn is None:
+    """The jitted K-row gather for ``mesh`` (cached through
+    :func:`~.mesh_fused.mesh_fn` — bounded, keyed by mesh VALUE rather than
+    the live object, so repeated test meshes share one compiled entry
+    instead of accumulating stale ones).  Exposed as a function so the
+    HLO-inspection test can ``.lower()`` exactly the program
+    :func:`_gather_rows` dispatches."""
+    from .mesh_fused import mesh_fn
+
+    def build():
         if mesh is None:
-            fn = jax.jit(lambda st, idx: tuple(x[idx] for x in st))
-        else:
-            from jax.experimental.shard_map import shard_map
+            return jax.jit(lambda st, idx: tuple(x[idx] for x in st))
+        from jax.experimental.shard_map import shard_map
 
-            from .mesh import DOC_AXIS
+        from .mesh import DOC_AXIS
 
-            def per_shard(local, idx):
-                d_local = local[0].shape[0]
-                start = jax.lax.axis_index(DOC_AXIS) * d_local
-                rel = idx - start
-                inb = (rel >= 0) & (rel < d_local)
-                safe = jnp.clip(rel, 0, d_local - 1)
-                out = []
-                for x in local:
-                    g = x[safe]
-                    m = inb.reshape((-1,) + (1,) * (g.ndim - 1))
-                    if g.dtype == jnp.bool_:
-                        g = jax.lax.psum(
-                            jnp.where(m, g.astype(jnp.int32), 0), DOC_AXIS
-                        ).astype(jnp.bool_)
-                    else:
-                        g = jax.lax.psum(jnp.where(m, g, 0), DOC_AXIS)
-                    out.append(g)
-                return tuple(out)
+        def per_shard(local, idx):
+            d_local = local[0].shape[0]
+            start = jax.lax.axis_index(DOC_AXIS) * d_local
+            rel = idx - start
+            inb = (rel >= 0) & (rel < d_local)
+            safe = jnp.clip(rel, 0, d_local - 1)
+            out = []
+            for x in local:
+                g = x[safe]
+                m = inb.reshape((-1,) + (1,) * (g.ndim - 1))
+                if g.dtype == jnp.bool_:
+                    g = jax.lax.psum(
+                        jnp.where(m, g.astype(jnp.int32), 0), DOC_AXIS
+                    ).astype(jnp.bool_)
+                else:
+                    g = jax.lax.psum(jnp.where(m, g, 0), DOC_AXIS)
+                out.append(g)
+            return tuple(out)
 
-            fn = jax.jit(shard_map(
-                per_shard, mesh=mesh,
-                in_specs=(P(DOC_AXIS), P()), out_specs=P(),
-            ))
-        _GATHER_ROWS_CACHE[mesh] = fn
-    return fn
+        return jax.jit(shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P(DOC_AXIS), P()), out_specs=P(),
+        ))
+
+    return mesh_fn(mesh, "gather_rows", build)
 
 
 @partial(jax.jit, static_argnums=1)
@@ -1323,14 +1323,14 @@ class StreamingMerge:
 
     def _fused_eligible(self) -> bool:
         """Whether commits route through the fused device-resident round
-        pipeline: meshless (sharded sessions commit per round — their
-        dispatch is shape-disciplined over the mesh), single-block (the
-        donated state program covers the whole doc axis), and not an
-        engine-capture session (capture records per-ROUND device inputs,
-        the replay contract bench.run_engine/engine_profile consume)."""
+        pipeline: single-block (the donated state program covers the whole
+        doc axis — mesh sessions always qualify, their block IS the padded
+        batch, and their batch commits as ONE shard_map'd staged program
+        over the mesh) and not an engine-capture session (capture records
+        per-ROUND device inputs, the replay contract
+        bench.run_engine/engine_profile consume)."""
         return (
             self.fused_pipeline
-            and self.mesh is None
             and self._capture_rounds is None
             and self._padded_docs <= self._read_chunk
         )
@@ -1464,6 +1464,23 @@ class StreamingMerge:
             self._cum_ins += enc.ins_count
             bound = _width_bucket(int(self._cum_ins.max()))
             loop_seq.append(bound if bound < s_cap else None)
+        if self.mesh is not None:
+            # mesh-sharded fused form: per-round (D, K) staging planes
+            # stack on a leading round axis — zero-padded to the batch-max
+            # width per stream kind first (zero op ids are no-op slots, so
+            # rounds of different widths share one stacked shape) — and
+            # the stacked program runs under shard_map on the doc axis:
+            # the whole batch commits as ONE dispatch for the whole mesh.
+            # fusion_rows is ignored here (full-lane staging): the
+            # offset-plane subset form would need per-shard row bases, and
+            # the mesh trades that staging saving for the single dispatch.
+            ki = max(enc.ins_ref.shape[1] for enc, _ in batch)
+            kd = max(enc.del_target.shape[1] for enc, _ in batch)
+            km = max(enc.marks[MARK_COLS[0]].shape[1] for enc, _ in batch)
+            kp = max(
+                enc.map_ops[MAP_STREAM_COLS[0]].shape[1] for enc, _ in batch
+            )
+            return ("mesh_stacked", tuple(loop_seq), (ki, kd, km, kp))
         if self.static_rounds:
             if self.fusion_rows is not None:
                 # cross-tenant fusion window: only the active tenants'
@@ -1570,6 +1587,37 @@ class StreamingMerge:
             row_base = np.zeros(t_pad, np.int32)
             row_base[: len(bases)] = bases
             return jax.device_put((stacked, row_base))
+        if statics[0] == "mesh_stacked":
+            # mesh-sharded form: per-round planes zero-pad to the batch-max
+            # width per kind, stack on the round axis, and ship with ONE
+            # sharded device_put — round axis replicated, doc axis
+            # partitioned over the mesh (every shard receives only its own
+            # rows of every round)
+            ki, kd, km, kp = statics[2]
+
+            def pad_to(a, w):
+                if a.shape[1] == w:
+                    return a
+                out = np.zeros((a.shape[0], w) + a.shape[2:], a.dtype)
+                out[:, : a.shape[1]] = a
+                return out
+
+            tree = (
+                np.stack([pad_to(enc.ins_ref, ki) for enc, _ in batch]),
+                np.stack([pad_to(enc.ins_op, ki) for enc, _ in batch]),
+                np.stack([pad_to(enc.ins_char, ki) for enc, _ in batch]),
+                np.stack([pad_to(enc.del_target, kd) for enc, _ in batch]),
+                {col: np.stack([pad_to(enc.marks[col], km)
+                                for enc, _ in batch]) for col in MARK_COLS},
+                np.stack([enc.mark_count for enc, _ in batch]),
+                {col: np.stack([pad_to(enc.map_ops[col], kp)
+                                for enc, _ in batch])
+                 for col in MAP_STREAM_COLS},
+                np.stack([enc.map_count for enc, _ in batch]),
+            )
+            return jax.device_put(
+                tree, NamedSharding(self.mesh, P(None, DOC_AXIS))
+            )
         if statics[0] == "stacked":
             # static-round serving form: the padded (D, K) staging rows at
             # the session's fixed widths, stacked along a leading round axis
@@ -1634,7 +1682,10 @@ class StreamingMerge:
         # arm is still ONE program) — the serve tier's fusion accounting
         # and the multi-tenant bench row measure deltas of this counter
         GLOBAL_COUNTERS.add("streaming.fused_dispatches")
-        if chain_digest and statics[0] in ("stacked", "flat"):
+        if statics[0] in ("mesh_stacked", "mesh_paged", "mesh_ragged") \
+                and GLOBAL_DEVPROF.enabled:
+            GLOBAL_DEVPROF.observe_mesh(self._mesh_stats())
+        if chain_digest and statics[0] in ("stacked", "flat", "mesh_stacked"):
             self._dispatch_fused_batch_digest(batch, statics, inputs)
             return True
         if statics[0] == "compact1":
@@ -1650,6 +1701,14 @@ class StreamingMerge:
             self.state = apply_batch_jit(
                 self.state, inputs, insert_loop_slots=statics[1],
             )
+        elif statics[0] == "mesh_stacked":
+            fn = self._mesh_stacked_fn(statics[1])
+            if GLOBAL_DEVPROF.enabled:
+                note_jit_dispatch(
+                    "apply_batch_stacked_rounds.mesh", fn,
+                    (self.state, inputs),
+                )
+            self.state = fn(self.state, inputs)
         elif statics[0] == "stacked":
             loop_seq = statics[1]
             self.state = apply_batch_stacked_rounds_jit(
@@ -1688,7 +1747,15 @@ class StreamingMerge:
                        *self._digest_tables(0, self._padded_docs))
         insert_impl = resolve_insert_impl(self.state.elem_id)
         donate = resolve_state_donation(self.state.elem_id)
-        if statics[0] == "stacked":
+        if statics[0] == "mesh_stacked":
+            # the shard_map twin: apply + resolve + per-doc digest in the
+            # SAME sharded program — the per-shard digest vectors come back
+            # doc-sharded and the host combine (digest()) sums them exactly
+            # as it does meshless
+            fn = self._mesh_stacked_digest_fn(statics[1])
+            args = (self.state, inputs, *digest_args)
+            kw = {}
+        elif statics[0] == "stacked":
             fn = (_stacked_rounds_digest_jit if donate
                   else _stacked_rounds_digest_jit_nodonate)
             args = (self.state, inputs, *digest_args)
@@ -1721,6 +1788,101 @@ class StreamingMerge:
         self._resolved_cache = (self.rounds, {0: entry})
         self._start_digest_readback(entry)
         GLOBAL_COUNTERS.add("streaming.digest_chained")
+
+    # -- the mesh-sharded fused programs (round 19) --------------------------
+    #
+    # The stacked fixed-shape form runs under shard_map on the doc axis:
+    # every shard applies its own rows of every round from the one staged
+    # tensor set, so a drain batch is ONE dispatch for the whole mesh.
+    # Programs cache through mesh_fused.mesh_fn (bounded, mesh-VALUE keyed)
+    # and close over statics only — plan planes and stream staging ride as
+    # data, so repeat mesh drains compile nothing (sentinel-pinned).
+
+    def _mesh_stacked_fn(self, loop_seq):
+        from jax.experimental.shard_map import shard_map
+
+        from .mesh_fused import mesh_fn
+
+        mesh = self.mesh
+        insert_impl = resolve_insert_impl(self.state.elem_id)
+        donate = resolve_state_donation(self.state.elem_id)
+
+        def build():
+            def body(state, stacked):
+                return apply_batch_stacked_rounds(
+                    state, stacked, loop_slots_seq=loop_seq,
+                    insert_impl=insert_impl,
+                )
+
+            sm = shard_map(
+                body, mesh=mesh,
+                in_specs=(P(DOC_AXIS), P(None, DOC_AXIS)),
+                out_specs=P(DOC_AXIS),
+            )
+            return jax.jit(sm, donate_argnums=(0,) if donate else ())
+
+        return mesh_fn(
+            mesh, ("stacked_apply", loop_seq, insert_impl, donate), build
+        )
+
+    def _mesh_stacked_digest_fn(self, loop_seq):
+        from jax.experimental.shard_map import shard_map
+
+        from .mesh_fused import mesh_fn
+
+        mesh = self.mesh
+        insert_impl = resolve_insert_impl(self.state.elem_id)
+        donate = resolve_state_donation(self.state.elem_id)
+        cc = self.comment_capacity
+
+        def build():
+            def body(state, stacked, row_mask, sess_attr, sess_key,
+                     comment_hash, row_map, obj_attr, obj_key):
+                # row_map values are GLOBAL override indices into the
+                # replicated obj_attr/obj_key tables, so the per-shard body
+                # reads them unchanged
+                return _stacked_rounds_digest(
+                    state, stacked, row_mask, sess_attr, sess_key,
+                    comment_hash, row_map, obj_attr, obj_key,
+                    loop_slots_seq=loop_seq, insert_impl=insert_impl,
+                    comment_capacity=cc,
+                )
+
+            sm = shard_map(
+                body, mesh=mesh,
+                in_specs=(P(DOC_AXIS), P(None, DOC_AXIS), P(DOC_AXIS),
+                          P(), P(), P(DOC_AXIS), P(DOC_AXIS), P(), P()),
+                out_specs=(P(DOC_AXIS), P(DOC_AXIS), P(DOC_AXIS)),
+            )
+            return jax.jit(sm, donate_argnums=(0,) if donate else ())
+
+        return mesh_fn(
+            mesh, ("stacked_digest", loop_seq, insert_impl, donate, cc),
+            build,
+        )
+
+    def _mesh_stats(self) -> Dict:
+        """Per-shard load snapshot behind the ``peritext_mesh_*`` gauges:
+        shard count, per-shard cumulative admitted inserts (the padded
+        layout's live-slot proxy) and the max/mean imbalance ratio.  The
+        paged subclass overrides with real per-shard pool occupancy."""
+        n = self.mesh.size
+        rows = self._padded_docs // n
+        per = np.asarray(self._cum_ins).reshape(n, rows).sum(axis=1)
+        mean = float(per.mean())
+        return {
+            "shards": n,
+            "rows_per_shard": rows,
+            "shard_load": [int(x) for x in per],
+            "shard_utilization": [
+                round(float(x) / (rows * self._slot_capacity), 4)
+                for x in per
+            ],
+            "imbalance_ratio": (
+                round(float(per.max()) / mean, 4) if mean > 0 else 1.0
+            ),
+            "ici_page_moves": 0,
+        }
 
     def _apply_compact(self, enc: _RoundBuffers, widths) -> PackedDocs:
         """Dispatch one round via kernel.apply_batch_compact_jit: the host

@@ -90,12 +90,31 @@ class FusionGroup:
     given the same specs assemble byte-identical groups.  ``lane_capacity``
     bounds a lane's padded doc axis (its (D, K) staging planes are a real
     per-round host->device cost), not the tenant count.
+
+    ``shard_rows`` aligns placement to a mesh-sharded lane session: a
+    tenant's ``[doc_base, doc_base+docs)`` never straddles a multiple of
+    ``shard_rows`` mid-shard (bases bump to the next shard boundary when
+    the block would spill over), so one tenant's window drain touches
+    whole shards or stays inside one — the shard_map fused commit never
+    sees a tenant split unevenly across devices.
     """
 
     def __init__(self, tenants: Sequence[TenantSpec],
-                 lane_capacity: int = 4096) -> None:
+                 lane_capacity: int = 4096,
+                 shard_rows: Optional[int] = None) -> None:
         if lane_capacity <= 0:
             raise ValueError(f"lane_capacity must be > 0, got {lane_capacity}")
+        if shard_rows is not None:
+            if shard_rows <= 0:
+                raise ValueError(
+                    f"shard_rows must be > 0, got {shard_rows}")
+            if lane_capacity % shard_rows:
+                raise ValueError(
+                    f"lane_capacity {lane_capacity} must be a multiple of "
+                    f"shard_rows {shard_rows}: a lane is a whole number of "
+                    "mesh shards"
+                )
+        self.shard_rows = int(shard_rows) if shard_rows else None
         names = [t.tenant for t in tenants]
         if len(set(names)) != len(names):
             raise ValueError("duplicate tenant names in fusion group")
@@ -113,14 +132,16 @@ class FusionGroup:
         open_lane: Dict[str, list] = {}
         for spec in sorted(tenants, key=lambda t: (t.layout, t.tenant)):
             cur = open_lane.get(spec.layout)
-            if cur is None or cur[1] + spec.docs > lane_capacity:
+            base = self._aligned_base(cur[1], spec.docs) if cur else 0
+            if cur is None or base + spec.docs > lane_capacity:
                 cur = open_lane[spec.layout] = [len(lanes), 0, spec.layout, []]
                 lanes.append(cur)
+                base = 0
             slot = LaneSlot(
                 tenant=spec.tenant, lane=cur[0], layout=spec.layout,
-                doc_base=cur[1], docs=spec.docs,
+                doc_base=base, docs=spec.docs,
             )
-            cur[1] += spec.docs
+            cur[1] = base + spec.docs
             cur[3].append(slot)
             slots[spec.tenant] = slot
         self.lanes: Tuple[LanePlan, ...] = tuple(
@@ -128,6 +149,19 @@ class FusionGroup:
             for i, docs, layout, ss in lanes
         )
         self.slots: Dict[str, LaneSlot] = slots
+
+    def _aligned_base(self, used: int, docs: int) -> int:
+        """The next doc base that keeps ``[base, base+docs)`` off a
+        mid-shard boundary: within-shard when the block fits in the
+        current shard's remainder, else bumped to the next multiple of
+        ``shard_rows`` (multi-shard tenants always start on one)."""
+        s = self.shard_rows
+        if not s:
+            return used
+        off = used % s
+        if off and off + docs > s:
+            return used + (s - off)
+        return used
 
     # -- lookups -----------------------------------------------------------
 
@@ -187,4 +221,5 @@ class FusionGroup:
             "lanes": [p.to_json() for p in self.lanes],
             "tenants": len(self.slots),
             "lane_capacity": self.lane_capacity,
+            "shard_rows": self.shard_rows,
         }

@@ -80,7 +80,8 @@ class CostModel:
 
     A configuration is the dict the tuner proposes over: ``insert_width``
     / ``delete_width`` / ``mark_width`` / ``map_width`` (the round stream
-    widths), ``slot_capacity``, ``page_size``, ``fused_depth``.  The
+    widths), ``slot_capacity``, ``page_size``, ``fused_depth``, plus the
+    optional ``shards`` (mesh device count on the doc axis).  The
     score is ``modeled padded-FLOPs + RECOMPILE_WEIGHT * recompiles``,
     with :meth:`executable_bytes` as the side constraint the tuner
     enforces.  Same snapshot -> same numbers, always: every term is
@@ -216,7 +217,12 @@ class CostModel:
             k_old = sum(r["widths"])
             scale = (k_new / k_old) if k_old else 1.0
             total += r["padded_capacity"] * scale
-        return total * self._flops_per_op
+        # the shard term: a mesh-sharded host splits the doc axis over
+        # ``shards`` devices, so per-device padded compute divides while
+        # the dispatch/recompile floors (paid once per shard_map program,
+        # not per shard) stay whole
+        shards = max(1, int(config.get("shards", 1)))
+        return total * self._flops_per_op / shards
 
     def recompiles(self, config: Dict[str, Any]) -> int:
         """Modeled compiled-variant count under ``config``: one apply

@@ -76,7 +76,9 @@ class FusedMuxGroup:
     :class:`~..plan.fusion.LanePlan` (see :func:`default_lane_factory`).
     Each tenant's mux is reachable via :meth:`mux` and behaves exactly
     like a standalone one for submit/patches/verdicts — only
-    :meth:`pump` timing is shared.
+    :meth:`pump` timing is shared.  When the lane sessions run on a mesh,
+    pass ``shard_rows`` (the lane's rows-per-shard) so tenant row ranges
+    never straddle a shard mid-boundary.
     """
 
     def __init__(
@@ -85,6 +87,7 @@ class FusedMuxGroup:
         session_factory: Callable[[LanePlan], StreamingMerge],
         *,
         lane_capacity: int = 4096,
+        shard_rows: Optional[int] = None,
         admission_factory: Optional[Callable[[], AdmissionController]] = None,
         tuner: Optional[BatchWindowTuner] = None,
         degrade_after: int = 8,
@@ -92,7 +95,8 @@ class FusedMuxGroup:
         counters: Optional[Counters] = None,
         host: str = "local",
     ) -> None:
-        self.group = FusionGroup(tenants, lane_capacity=lane_capacity)
+        self.group = FusionGroup(tenants, lane_capacity=lane_capacity,
+                                 shard_rows=shard_rows)
         self.clock = clock
         self.host = host
         self.counters = counters if counters is not None else GLOBAL_COUNTERS

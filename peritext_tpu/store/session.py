@@ -133,7 +133,10 @@ def _resolve_digest_paged_jit(
 
 class PagedStreamingMerge(StreamingMerge):
     """StreamingMerge whose resident element planes live in a page pool
-    (module doc).  Meshless sessions only for now; ``static_rounds`` (the
+    (module doc).  Under ``mesh=`` the pool shards per shard
+    (store/sharded.ShardedPagedDocStore) and the fused commit runs the
+    whole drain batch's group chain as ONE ``shard_map`` program with
+    per-shard plan planes as data (round 19); ``static_rounds`` (the
     serving tier's one-shape discipline) stays on the padded layout."""
 
     _layout = "paged"
@@ -146,8 +149,6 @@ class PagedStreamingMerge(StreamingMerge):
                  **kwargs) -> None:
         if layout != "paged":
             raise ValueError(f"PagedStreamingMerge is layout='paged', got {layout!r}")
-        if kwargs.get("mesh") is not None:
-            raise ValueError("layout='paged' does not support a mesh yet")
         if kwargs.get("static_rounds"):
             raise ValueError(
                 "layout='paged' is incompatible with static_rounds: the "
@@ -161,16 +162,30 @@ class PagedStreamingMerge(StreamingMerge):
                 f"slot_capacity {self._slot_capacity} must be a multiple of "
                 f"page_size {self.page_size} under layout='paged'"
             )
-        self._store = PagedDocStore(
-            self._padded_docs,
-            slot_capacity=self._slot_capacity,
-            mark_capacity=self._mark_capacity,
-            tomb_capacity=self._tomb_capacity,
-            map_capacity=self._map_capacity,
-            page_size=self.page_size,
-            initial_pages=pool_pages,
-            max_pool_pages=max_pool_pages,
-        )
+        if self.mesh is not None:
+            from .sharded import ShardedPagedDocStore
+
+            self._store = ShardedPagedDocStore(
+                self._padded_docs, self.mesh,
+                slot_capacity=self._slot_capacity,
+                mark_capacity=self._mark_capacity,
+                tomb_capacity=self._tomb_capacity,
+                map_capacity=self._map_capacity,
+                page_size=self.page_size,
+                initial_pages=pool_pages,
+                max_pool_pages=max_pool_pages,
+            )
+        else:
+            self._store = PagedDocStore(
+                self._padded_docs,
+                slot_capacity=self._slot_capacity,
+                mark_capacity=self._mark_capacity,
+                tomb_capacity=self._tomb_capacity,
+                map_capacity=self._map_capacity,
+                page_size=self.page_size,
+                initial_pages=pool_pages,
+                max_pool_pages=max_pool_pages,
+            )
         #: per-(round, epoch) materialized-block cache (<= 2 blocks, the
         #: paged analog of the padded path's _apply_blocks reuse)
         self._mat_cache: tuple = ((-1, -1), {})
@@ -258,6 +273,8 @@ class PagedStreamingMerge(StreamingMerge):
         round, plan that round's page groups and SNAPSHOT their page-table
         slabs (``PagedDocStore.group_plan``) — everything that reads or
         mutates allocator state happens here, in round order."""
+        if self.mesh is not None:
+            return self._prep_mesh_fused_batch(batch)
         plans = []
         for enc, widths in batch:
             self._cum_ins += enc.ins_count
@@ -282,6 +299,8 @@ class PagedStreamingMerge(StreamingMerge):
         """Worker-safe staging: slice each group's stream tensors out of
         its round's staging buffers and upload the whole (round, group)
         input sequence with one ``jax.device_put``."""
+        if statics[0] == "mesh_paged":
+            return self._stage_mesh_fused_batch(batch, statics)
         _, plans = statics
         group_inputs = []
         for (enc, _), (widths, plan) in zip(batch, plans):
@@ -298,6 +317,8 @@ class PagedStreamingMerge(StreamingMerge):
         for drain-loop compatibility but never chains here (returns
         False): a paged digest twin of the group-chain program is an open
         rung — the drain keeps the separate prefetch dispatch instead."""
+        if statics[0] == "mesh_paged":
+            return self._dispatch_mesh_fused_batch(batch, statics, inputs)
         from ..ops.kernel import apply_batch_paged_groups_jit
 
         from ..ops.kernel import (
@@ -345,6 +366,177 @@ class PagedStreamingMerge(StreamingMerge):
         if GLOBAL_DEVPROF.enabled:
             GLOBAL_DEVPROF.observe_page_pool(self._store.pool_stats())
         return False
+
+    # -- mesh-sharded fused commit (round 19) --------------------------------
+
+    def _prep_mesh_fused_batch(self, batch):
+        """The meshless prep's round walk, but groups are planned PER SHARD
+        with LOCAL row ids (pad = rows_per_shard, the locally-OOB drop
+        sentinel) and LOCAL page tables built straight off the per-shard
+        allocators — never by translating global page ids, so pad entries
+        are each shard's OWN null page.  The bucket ladder unifies across
+        shards: one (round, bucket) group spans the whole mesh at the
+        max-shard row bucket; shards short of rows ride as all-pad no-op
+        lanes (zero streams + null tables are free by the same argument as
+        padding rows)."""
+        store = self._store
+        n = store.n_shards
+        rps = store.rows_per_shard
+        plans = []
+        for enc, widths in batch:
+            self._cum_ins += enc.ins_count
+            rows = np.nonzero(enc.num_ops)[0]
+            if not len(rows):
+                plans.append((widths, []))
+                continue
+            store.ensure_rows(rows, self._cum_ins[rows])
+            buckets: Dict[int, Dict[int, list]] = {}
+            for row in rows:
+                row = int(row)
+                g = min(_pow2(max(1, store.num_pages(row))),
+                        store.max_doc_pages)
+                buckets.setdefault(g, {}).setdefault(row // rps, []).append(row)
+            plan = []
+            for g in sorted(buckets):
+                by_shard = buckets[g]
+                b = _pow2(max(len(v) for v in by_shard.values()))
+                shard_rows = [sorted(by_shard.get(s, ())) for s in range(n)]
+                row_idx = np.full((n, b), rps, np.int64)
+                table = np.zeros((n, b, g), np.int32)
+                for s in range(n):
+                    alloc = store.alloc.shards[s]
+                    for i, r in enumerate(shard_rows[s]):
+                        row_idx[s, i] = r - s * rps
+                        pages = alloc.pages_of(r)
+                        table[s, i, : len(pages)] = pages
+                plan.append((shard_rows, g, b, row_idx, table))
+            plans.append((widths, plan))
+        return ("mesh_paged", tuple(plans))
+
+    def _stage_mesh_fused_batch(self, batch, statics):
+        """Every (round, group) input grows a leading ``(n_shards,)`` axis
+        — shard ``s``'s local row ids, local page-table slab and stream
+        slice — and the whole chain ships with ONE sharded device_put, so
+        each shard receives exactly its own planes and the dispatch below
+        needs no in-program resharding."""
+        from ..parallel.mesh_fused import shard_leading
+
+        _, plans = statics
+        n = self._store.n_shards
+
+        def stack(a, shard_rows, b):
+            a = np.asarray(a)
+            out = np.zeros((n, b) + a.shape[1:], a.dtype)
+            for s in range(n):
+                rows = shard_rows[s]
+                if len(rows):
+                    out[s, : len(rows)] = a[rows]
+            return out
+
+        group_inputs = []
+        for (enc, _), (widths, plan) in zip(batch, plans):
+            for shard_rows, g, b, row_idx, table in plan:
+                streams = (
+                    stack(enc.ins_ref, shard_rows, b),
+                    stack(enc.ins_op, shard_rows, b),
+                    stack(enc.ins_char, shard_rows, b),
+                    stack(enc.del_target, shard_rows, b),
+                    {c: stack(enc.marks[c], shard_rows, b)
+                     for c in sorted(enc.marks)},
+                    stack(enc.mark_count, shard_rows, b),
+                    {c: stack(enc.map_ops[c], shard_rows, b)
+                     for c in sorted(enc.map_ops)},
+                    stack(enc.map_count, shard_rows, b),
+                )
+                group_inputs.append((row_idx, table, streams))
+        return shard_leading(tuple(group_inputs), self.mesh)
+
+    def _mesh_paged_fn(self):
+        """The drain batch's whole (round, group) chain as ONE compiled
+        ``shard_map`` program: each shard runs
+        ops/kernel.apply_batch_paged_groups over its local pool block with
+        its own plan planes (sliced off the staged leading shard axis).
+        The jit retraces per chain structure exactly like the meshless
+        bucket ladder — one executable per (group shapes, widths) chain,
+        shared across the mesh and cached per mesh fingerprint."""
+        from ..ops.kernel import (
+            apply_batch_paged_groups,
+            resolve_insert_impl,
+            resolve_state_donation,
+        )
+        from ..parallel.mesh_fused import mesh_fn
+
+        mesh = self.mesh
+        impl = resolve_insert_impl(self._store.pool_elem)
+        donate = resolve_state_donation(self._store.pool_elem)
+
+        def build():
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def body(pool_elem, pool_char, aux, group_inputs):
+                local = jax.tree_util.tree_map(lambda x: x[0], group_inputs)
+                return apply_batch_paged_groups(
+                    pool_elem, pool_char, aux, local,
+                    loop_slots_seq=(None,) * len(local),
+                    insert_impl=impl,
+                )
+
+            wrapped = shard_map(
+                body, mesh=mesh,
+                in_specs=(P(_mesh.DOC_AXIS),) * 4,
+                out_specs=(P(_mesh.DOC_AXIS),) * 3,
+            )
+            return jax.jit(
+                wrapped, donate_argnums=(0, 1, 2) if donate else ())
+
+        return mesh_fn(mesh, ("paged_groups", impl, donate), build)
+
+    def _dispatch_mesh_fused_batch(self, batch, statics, inputs) -> bool:
+        """One program for the whole mesh drain batch + the same per-round
+        bookkeeping as the meshless dispatch.  Returns False (the paged
+        digest twin stays an open rung under the mesh too — the drain
+        keeps the separate prefetch dispatch)."""
+        _, plans = statics
+        store = self._store
+        if inputs:
+            fn = self._mesh_paged_fn()
+            if GLOBAL_DEVPROF.enabled:
+                note_jit_dispatch(
+                    "apply_batch_paged_groups.mesh", fn,
+                    (store.pool_elem, store.pool_char, store.aux, inputs),
+                )
+            store.pool_elem, store.pool_char, store.aux = fn(
+                store.pool_elem, store.pool_char, store.aux, inputs
+            )
+            GLOBAL_COUNTERS.add("streaming.fused_dispatches")
+        for (enc, _), (widths, plan) in zip(batch, plans):
+            cap_total = 0
+            rows = np.nonzero(enc.num_ops)[0]
+            for shard_rows, g, b, _, _ in plan:
+                cap = b * store.n_shards * sum(widths)
+                cap_total += cap
+                if GLOBAL_DEVPROF.enabled:
+                    g_rows = [r for sr in shard_rows for r in sr]
+                    GLOBAL_DEVPROF.observe_round(
+                        occupancy_key(b * store.n_shards, *widths),
+                        int(enc.num_ops[g_rows].sum()), cap,
+                        origin="streaming.paged.fused",
+                    )
+            self._commit_caps[id(enc)] = cap_total
+            if len(rows):
+                self._digest_row_valid[rows] = False
+            self.rounds += 1
+            GLOBAL_COUNTERS.add("streaming.rounds")
+        if GLOBAL_DEVPROF.enabled:
+            GLOBAL_DEVPROF.observe_page_pool(store.pool_stats())
+            GLOBAL_DEVPROF.observe_mesh(self._mesh_stats())
+        return False
+
+    def _mesh_stats(self) -> Dict:
+        """Real per-shard pool occupancy (the padded base reports the
+        cum-insert proxy) plus the ICI page-move counter."""
+        return dict(self._store.shard_stats())
 
     def _emit_round_stats(self, batch, scheduled: int,
                           schedule_s: float, apply_s: float,
@@ -564,6 +756,9 @@ class RaggedStreamingMerge(PagedStreamingMerge):
         super().__init__(num_docs, actors, *args, layout="paged", **kwargs)
         #: (alloc_epoch, pool_pages) -> (RaggedPlan, device plane tuple)
         self._ragged_cache: tuple = ((-1, -1), None)
+        #: mesh twin: (alloc_epoch, pages_per_shard) -> ((docs_walked,
+        #: pages_walked), stacked per-shard device planes)
+        self._mesh_ragged_cache: tuple = ((-1, -1), None)
 
     def health(self) -> Dict:
         h = super().health()
@@ -664,21 +859,158 @@ class RaggedStreamingMerge(PagedStreamingMerge):
             rows = np.nonzero(enc.num_ops)[0]
             if len(rows):
                 self._store.ensure_rows(rows, self._cum_ins[rows])
+        if self.mesh is not None:
+            return ("mesh_ragged", len(batch))
         return ("ragged", len(batch))
 
     def _stage_fused_batch(self, batch, statics):
         d = self._padded_docs
-        return jax.device_put(tuple(
+        inputs = tuple(
             (
                 group_stream_arrays(enc, None, d),
                 jnp.asarray(enc.ins_count, jnp.int32),
                 jnp.asarray(enc.del_count, jnp.int32),
             )
             for enc, _ in batch
-        ))
+        )
+        if statics[0] == "mesh_ragged":
+            from ..parallel.mesh_fused import shard_leading
+
+            return shard_leading(inputs, self.mesh)
+        return jax.device_put(inputs)
+
+    def _mesh_ragged_planes(self):
+        """Per-shard ragged plans — LOCAL row ids over each shard's local
+        pool block, built straight off the per-shard allocators (the
+        owner sentinel is ``rows_per_shard``, the prev-page sentinel each
+        shard's OWN null page 0) — stacked on a leading shard axis and
+        cached device-side keyed by (alloc_epoch, per-shard pool size):
+        the meshless ``_ragged_planes`` discipline, one plane set per
+        shard, re-uploaded only when the allocator state changes."""
+        from ..parallel.mesh_fused import shard_leading
+
+        store = self._store
+        key = (store.alloc_epoch, store.pages_per_shard)
+        cached_key, cached = self._mesh_ragged_cache
+        if cached_key != key:
+            n, rps = store.n_shards, store.rows_per_shard
+            ps = store.pages_per_shard
+            p = store.page_size
+            row_idx = np.tile(np.arange(rps, dtype=np.int64), (n, 1))
+            owner = np.full((n, ps), rps, np.int32)
+            pos_base = np.zeros((n, ps), np.int32)
+            prev_page = np.zeros((n, ps), np.int32)
+            page_count = np.zeros((n, rps), np.int32)
+            page_table = np.zeros((n, rps, store.max_doc_pages), np.int32)
+            pages_walked = 0
+            for s in range(n):
+                alloc = store.alloc.shards[s]
+                for doc in alloc.docs():
+                    row = doc - s * rps
+                    pages = alloc.pages_of(doc)
+                    page_count[s, row] = len(pages)
+                    pages_walked += len(pages)
+                    for k, pg in enumerate(pages):
+                        owner[s, pg] = row
+                        pos_base[s, pg] = k * p
+                        prev_page[s, pg] = pages[k - 1] if k else 0
+                        page_table[s, row, k] = pg
+            planes = shard_leading(
+                (row_idx, owner, pos_base, prev_page, page_count,
+                 page_table),
+                self.mesh,
+            )
+            cached = ((self._padded_docs, pages_walked), planes)
+            self._mesh_ragged_cache = (key, cached)
+        return cached
+
+    def _mesh_ragged_fn(self):
+        """The ONE mesh ragged apply executable: per-round ``shard_map``
+        dispatch whose body walks each shard's local pool with its own
+        plan planes.  Like the meshless ragged engine, rounds dispatch one
+        at a time against the same compiled program — chaining a drain's
+        rounds into one program would mint one XLA shape per drain depth,
+        the ladder this layout exists to kill."""
+        from ..ops.kernel import resolve_ragged_impl, resolve_state_donation
+        from ..ops.ragged import apply_batch_ragged
+        from ..parallel.mesh_fused import mesh_fn
+
+        mesh = self.mesh
+        impl = resolve_ragged_impl(self._store.pool_elem)
+        donate = resolve_state_donation(self._store.pool_elem)
+
+        def build():
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def body(pool_elem, pool_char, aux, planes, earrays,
+                     ins_counts, del_counts):
+                (row_idx, owner, pos_base, prev_page, page_count,
+                 page_table) = jax.tree_util.tree_map(
+                    lambda x: x[0], planes)
+                return apply_batch_ragged(
+                    pool_elem, pool_char, aux, row_idx, owner, pos_base,
+                    prev_page, page_count, page_table, earrays,
+                    ins_counts, del_counts, ragged_impl=impl,
+                )
+
+            # check_rep=False: the ragged pool walk is lax.fori_loop-based
+            # and shard_map has no replication rule for while — every
+            # operand and result is explicitly doc-axis-sharded anyway
+            wrapped = shard_map(
+                body, mesh=mesh,
+                in_specs=(P(_mesh.DOC_AXIS),) * 7,
+                out_specs=(P(_mesh.DOC_AXIS),) * 3,
+                check_rep=False,
+            )
+            return jax.jit(
+                wrapped, donate_argnums=(0, 1, 2) if donate else ())
+
+        return mesh_fn(mesh, ("ragged_apply", impl, donate), build)
+
+    def _dispatch_mesh_fused_batch(self, batch, statics, inputs) -> bool:
+        store = self._store
+        (docs_walked, pages_walked), planes = self._mesh_ragged_planes()
+        fn = self._mesh_ragged_fn()
+        GLOBAL_COUNTERS.add("streaming.fused_dispatches")
+        for (enc, widths), (earrays, ins_counts, del_counts) in zip(
+            batch, inputs
+        ):
+            rows = np.nonzero(enc.num_ops)[0]
+            real = int(enc.num_ops.sum())
+            if GLOBAL_DEVPROF.enabled:
+                note_jit_dispatch(
+                    "apply_batch_ragged.mesh", fn,
+                    (store.pool_elem, store.pool_char, store.aux, planes,
+                     earrays, ins_counts, del_counts),
+                )
+            store.pool_elem, store.pool_char, store.aux = fn(
+                store.pool_elem, store.pool_char, store.aux, planes,
+                earrays, ins_counts, del_counts,
+            )
+            self._commit_caps[id(enc)] = real
+            if GLOBAL_DEVPROF.enabled:
+                GLOBAL_DEVPROF.observe_round(
+                    occupancy_key(self._padded_docs, *widths), real,
+                    max(real, 1), origin="streaming.ragged",
+                )
+                GLOBAL_DEVPROF.observe_ragged(
+                    docs_walked=docs_walked, pages_walked=pages_walked,
+                    real_ops=real,
+                )
+            if len(rows):
+                self._digest_row_valid[rows] = False
+            self.rounds += 1
+            GLOBAL_COUNTERS.add("streaming.rounds")
+        if GLOBAL_DEVPROF.enabled:
+            GLOBAL_DEVPROF.observe_page_pool(store.pool_stats())
+            GLOBAL_DEVPROF.observe_mesh(self._mesh_stats())
+        return False
 
     def _dispatch_fused_batch(self, batch, statics, inputs,
                               chain_digest: bool = False) -> bool:
+        if statics[0] == "mesh_ragged":
+            return self._dispatch_mesh_fused_batch(batch, statics, inputs)
         from ..ops.ragged import apply_batch_ragged_jit
 
         store = self._store

@@ -1,0 +1,414 @@
+"""ShardedPagedDocStore: the page pool split into per-shard pools (round 19).
+
+One mesh host runs ONE logical page pool, physically split into ``n`` equal
+per-shard pools along the page axis — shard ``s`` owns global pages
+``[s * Ps, (s + 1) * Ps)``.  Placement keeps every doc's pages on the shard
+that owns the doc's row range (``shard_of_row = row // rows_per_shard``), so
+the ragged kernel's per-doc ``(max_doc_pages, P)`` window — the shard unit
+the pool was designed around — never straddles an ICI link and the fused
+mesh commits (store/session.py) can run each shard's groups entirely
+locally under ``shard_map``.
+
+Invariants on top of :class:`~.paged.PagedDocStore`'s:
+
+* **Every shard has its own null page** (local page 0 = global ``s * Ps``,
+  reserved and permanently all-zero).  The per-shard apply programs re-zero
+  their LOCAL page 0 after the scatter, which is exactly the base
+  program's null-page discipline seen through ``shard_map``.
+* **Per-doc placement**: a doc's pages live on its row's shard, always.
+  ``ensure_rows`` allocates from the row's shard; when any shard runs dry
+  EVERY shard grows to the same per-shard size (the pool must stay ``n``
+  equal blocks for the global-id arithmetic and the sharded device layout).
+* **reshard() moves pages over ICI, not through the host**: the row
+  permutation first allocates destination locals in each receiving shard
+  (lowest-free-first, disjoint from both the pages staying and the pages
+  leaving, so the one-program gather→ppermute→scatter in
+  parallel/mesh_fused.page_mover_fn is sound), then runs the mover, then
+  reseats the per-shard allocators and re-zeroes the vacated sources
+  inside the same program.
+
+The facade (:class:`ShardedAllocator`) presents the per-shard allocators
+under the base allocator interface with GLOBAL page ids, so every
+inherited read/digest/evacuate/compact path works unchanged; only the
+allocation, growth and permutation verbs needed shard-aware overrides.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..obs import GLOBAL_COUNTERS
+from ..parallel.mesh import shard_docs
+from ..parallel.mesh_fused import mesh_fn, page_mover_fn, shard_leading
+from .alloc import PageAllocator, PoolExhausted
+from .paged import DEFAULT_PAGE_SIZE, PagedDocStore, _pow2
+
+
+class ShardedAllocator:
+    """Per-shard :class:`PageAllocator` bank behind the base allocator
+    interface.  Doc rows are GLOBAL; page ids returned by query verbs are
+    GLOBAL (``s * pages_per_shard + local``); each shard's allocator holds
+    LOCAL ids and is keyed by global doc rows (a row lives on exactly one
+    shard).  Mutating verbs route to the owning shard — cross-shard
+    requests are placement-invariant violations and raise."""
+
+    def __init__(self, n_shards: int, pages_per_shard: int,
+                 rows_per_shard: int) -> None:
+        self.n_shards = int(n_shards)
+        self.pages_per_shard = int(pages_per_shard)
+        self.rows_per_shard = int(rows_per_shard)
+        self.shards: List[PageAllocator] = [
+            PageAllocator(pages_per_shard) for _ in range(n_shards)
+        ]
+
+    # -- shard arithmetic ----------------------------------------------------
+
+    def shard_of_row(self, row: int) -> int:
+        return int(row) // self.rows_per_shard
+
+    def _to_global(self, shard: int, locals_: Sequence[int]) -> List[int]:
+        base = shard * self.pages_per_shard
+        return [base + int(p) for p in locals_]
+
+    # -- base allocator interface (global view) ------------------------------
+
+    @property
+    def total_pages(self) -> int:
+        return self.n_shards * self.pages_per_shard
+
+    @property
+    def reserved(self) -> int:
+        return sum(a.reserved for a in self.shards)
+
+    @property
+    def free_pages(self) -> int:
+        return sum(a.free_pages for a in self.shards)
+
+    @property
+    def pages_in_use(self) -> int:
+        return sum(a.pages_in_use for a in self.shards)
+
+    def pages_of(self, doc: int) -> List[int]:
+        s = self.shard_of_row(doc)
+        return self._to_global(s, self.shards[s].pages_of(doc))
+
+    def num_pages(self, doc: int) -> int:
+        return self.shards[self.shard_of_row(doc)].num_pages(doc)
+
+    def docs(self) -> List[int]:
+        out: List[int] = []
+        for a in self.shards:
+            out.extend(a.docs())
+        return sorted(out)
+
+    def ensure(self, doc: int, num_pages: int) -> List[int]:
+        s = self.shard_of_row(doc)
+        return self._to_global(s, self.shards[s].ensure(doc, num_pages))
+
+    def free_doc(self, doc: int) -> List[int]:
+        s = self.shard_of_row(doc)
+        return self._to_global(s, self.shards[s].free_doc(doc))
+
+    def evacuate(self, doc: int) -> List[int]:
+        return self.free_doc(doc)
+
+    def grow(self, new_total: int) -> int:
+        raise NotImplementedError(
+            "sharded pools grow per shard (ShardedPagedDocStore._grow_pool)"
+        )
+
+    def compact_plan(self) -> Dict[int, int]:
+        """Per-shard compaction expressed in global ids — every move stays
+        inside its shard, so the pool's sharded device layout survives the
+        gather unchanged."""
+        mapping: Dict[int, int] = {}
+        for s, a in enumerate(self.shards):
+            base = s * self.pages_per_shard
+            for old, new in a.compact_plan().items():
+                mapping[base + old] = base + new
+        return mapping
+
+    def apply_compact(self, mapping: Dict[int, int]) -> None:
+        per_shard: List[Dict[int, int]] = [{} for _ in self.shards]
+        ps = self.pages_per_shard
+        for old, new in mapping.items():
+            if old // ps != new // ps:
+                raise ValueError("sharded compact must not cross shards")
+            per_shard[old // ps][old % ps] = new % ps
+        for a, m in zip(self.shards, per_shard):
+            a.apply_compact(m)
+
+    def reseat(self, pages_by_doc: Dict[int, List[int]]) -> None:
+        ps = self.pages_per_shard
+        per_shard: List[Dict[int, List[int]]] = [{} for _ in self.shards]
+        for doc, pages in pages_by_doc.items():
+            s = self.shard_of_row(doc)
+            locals_ = [int(p) - s * ps for p in pages]
+            if any(p < 0 or p >= ps for p in locals_):
+                raise ValueError(
+                    f"doc {doc} reseated with pages outside shard {s}"
+                )
+            per_shard[s][int(doc)] = locals_
+        for a, m in zip(self.shards, per_shard):
+            a.reseat(m)
+
+
+class ShardedPagedDocStore(PagedDocStore):
+    """Doc-axis-sharded :class:`PagedDocStore` over ``mesh`` (module doc).
+
+    Device arrays: ``pool_elem`` / ``pool_char`` are ``(n * Ps, P)`` with
+    the PAGE axis sharded over the doc axis (each shard holds its own
+    ``(Ps, P)`` block); the dense aux rows shard on the DOC axis.  Both
+    therefore enter the fused ``shard_map`` commit programs with
+    ``P(DOC_AXIS)`` specs and zero resharding."""
+
+    def __init__(
+        self,
+        num_docs: int,
+        mesh,
+        slot_capacity: int,
+        mark_capacity: int,
+        tomb_capacity: Optional[int] = None,
+        map_capacity: int = 32,
+        page_size: int = DEFAULT_PAGE_SIZE,
+        initial_pages: Optional[int] = None,
+        max_pool_pages: Optional[int] = None,
+    ) -> None:
+        n = mesh.size
+        if num_docs % n:
+            raise ValueError(
+                f"num_docs {num_docs} must be a multiple of the mesh size {n}"
+            )
+        # build the base store at its meshless shape first (allocator and
+        # device arrays are replaced below; the aux schema, capacities and
+        # host planes are exactly the base's)
+        super().__init__(
+            num_docs, slot_capacity, mark_capacity,
+            tomb_capacity=tomb_capacity, map_capacity=map_capacity,
+            page_size=page_size,
+        )
+        self.mesh = mesh
+        self.n_shards = n
+        self.rows_per_shard = num_docs // n
+        # per-shard ceiling: every resident doc of the shard fully grown,
+        # plus the shard's null page (the base's ceiling seen per shard)
+        ceil = 1 + self.rows_per_shard * self.max_doc_pages
+        if max_pool_pages is not None:
+            ceil = min(ceil, max(2, int(max_pool_pages) // n))
+        self.max_shard_pages = ceil
+        self.max_pool_pages = n * ceil
+        start = initial_pages or min(
+            ceil, _pow2(1 + max(self.rows_per_shard, 8))
+        )
+        start = max(2, min(int(start), ceil))
+        self.pages_per_shard = start
+        self.alloc = ShardedAllocator(n, start, self.rows_per_shard)
+        self.pool_elem = self._put_pages(
+            jnp.zeros((n * start, page_size), jnp.int32))
+        self.pool_char = self._put_pages(
+            jnp.zeros((n * start, page_size), jnp.int32))
+        self.aux = shard_docs(self.aux, mesh)
+        #: pages moved between shards over ICI so far (reshard telemetry)
+        self.ici_page_moves = 0
+
+    def _put_pages(self, pool):
+        return shard_leading(pool, self.mesh)
+
+    # -- allocation: per-shard free lists, uniform growth --------------------
+
+    def ensure_rows(self, rows: Sequence[int], used_slots: Sequence[int]) -> None:
+        """Base contract, but a row can only draw from ITS shard's free
+        list — the global count being ample does not help a dry shard, so
+        the dry-shard check is per row and growth is all-shards-uniform."""
+        order = np.argsort(np.asarray(rows, np.int64), kind="stable")
+        rows_arr = np.asarray(rows, np.int64)[order]
+        used_arr = np.asarray(used_slots, np.int64)[order]
+        for row, used in zip(rows_arr, used_arr):
+            row = int(row)
+            shard = self.alloc.shards[self.alloc.shard_of_row(row)]
+            need = self.pages_needed(int(used))
+            delta = need - shard.num_pages(row)
+            if delta > 0 and delta > shard.free_pages:
+                self._grow_pool(
+                    shard.pages_in_use + shard.reserved + delta
+                )
+            self.alloc.ensure(row, need)
+            if delta > 0:
+                self.alloc_epoch += 1
+            self._num_pages[row] = self.alloc.num_pages(row)
+            self._used_hint[row] = max(self._used_hint[row], int(used))
+
+    def _grow_pool(self, min_shard_pages: int) -> None:
+        """Grow EVERY shard to the same new per-shard size (>= the base's
+        doubling curve).  The device remap keeps each shard's block
+        contiguous — ``(n*Ps, P) -> (n, Ps, P) -> pad -> (n*Ps', P)`` — so
+        local page ids survive and only the global-id base shifts."""
+        ps = self.pages_per_shard
+        target = _pow2(max(int(min_shard_pages), 2 * ps))
+        target = min(target, self.max_shard_pages)
+        if target < min_shard_pages:
+            raise PoolExhausted(
+                min_shard_pages - ps,
+                min(a.free_pages for a in self.alloc.shards),
+                self.alloc.total_pages,
+            )
+        added = target - ps
+        if added <= 0:
+            return
+        n = self.n_shards
+        pad = jnp.zeros((n, added, self.page_size), jnp.int32)
+
+        def regrow(pool):
+            blocks = pool.reshape(n, ps, self.page_size)
+            wide = jnp.concatenate([blocks, pad], axis=1)
+            return self._put_pages(wide.reshape(n * target, self.page_size))
+
+        self.pool_elem = regrow(self.pool_elem)
+        self.pool_char = regrow(self.pool_char)
+        for a in self.alloc.shards:
+            a.grow(target)
+        self.pages_per_shard = target
+        self.alloc.pages_per_shard = target
+        self.growths += 1
+        self.alloc_epoch += 1
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def compact(self) -> int:
+        """Base compaction, intra-shard by construction (the facade's plan
+        never crosses shards); the gather uses an IDENTITY default so free
+        pages keep their (all-zero) content without a cross-shard read of
+        shard 0's null, and the result re-pins the sharded layout."""
+        mapping = self.alloc.compact_plan()
+        moved = sum(1 for old, new in sorted(mapping.items()) if old != new)
+        if moved:
+            src = np.arange(self.alloc.total_pages, dtype=np.int32)
+            for old, new in sorted(mapping.items()):
+                src[new] = old
+            idx = jnp.asarray(src)
+            self.pool_elem = self._put_pages(
+                jnp.take(self.pool_elem, idx, axis=0))
+            self.pool_char = self._put_pages(
+                jnp.take(self.pool_char, idx, axis=0))
+        self.alloc.apply_compact(mapping)
+        if moved:
+            self.alloc_epoch += 1
+        self._num_pages[:] = 0
+        for doc in self.alloc.docs():
+            self._num_pages[doc] = self.alloc.num_pages(doc)
+        return moved
+
+    def permute_rows(self, src: np.ndarray) -> None:
+        """The collective reshard protocol: new row ``r`` takes old row
+        ``src[r]``.  Rows that stay on their shard move tables only (the
+        base discipline); rows that change shard move their PAGES over ICI
+        in one :func:`~..parallel.mesh_fused.page_mover_fn` program —
+        destination locals allocated first (disjoint from pages staying
+        AND leaving), vacated sources re-zeroed in-program."""
+        src = np.asarray(src, np.int64)
+        n, ps, rps = self.n_shards, self.pages_per_shard, self.rows_per_shard
+        alloc = self.alloc
+        old_pages = {
+            d: alloc.shards[alloc.shard_of_row(d)].pages_of(d)
+            for a in alloc.shards for d in a.docs()
+        }
+        staying: List[set] = [set() for _ in range(n)]
+        leaving: List[set] = [set() for _ in range(n)]
+        new_maps: List[Dict[int, List[int]]] = [{} for _ in range(n)]
+        cross = []  # (src_shard, dst_shard, new_row, src_locals)
+        for r in range(len(src)):
+            o = int(src[r])
+            pages = old_pages.get(o)
+            if not pages:
+                continue
+            so, sn = alloc.shard_of_row(o), alloc.shard_of_row(r)
+            if so == sn:
+                new_maps[sn][r] = pages
+                staying[sn].update(pages)
+            else:
+                cross.append((so, sn, r, pages))
+                leaving[so].update(pages)
+        if cross:
+            # capacity: each receiving shard needs dst locals outside
+            # (staying + leaving); grow all shards first if any is short
+            need_in = [0] * n
+            for _, sn, _, pages in cross:
+                need_in[sn] += len(pages)
+            worst = max(
+                1 + len(staying[s]) + len(leaving[s]) + need_in[s]
+                for s in range(n)
+            )
+            if worst > ps:
+                self._grow_pool(worst)
+                ps = self.pages_per_shard
+            free: List[List[int]] = [
+                sorted(set(range(1, ps)) - staying[s] - leaving[s])
+                for s in range(n)
+            ]
+            send: Dict[tuple, List[int]] = {}
+            recv: Dict[tuple, List[int]] = {}
+            moved = 0
+            for so, sn, r, pages in sorted(cross, key=lambda c: (c[1], c[2])):
+                dst = free[sn][: len(pages)]
+                del free[sn][: len(pages)]
+                new_maps[sn][r] = dst
+                d = (sn - so) % n
+                send.setdefault((so, d), []).extend(pages)
+                recv.setdefault((sn, d), []).extend(dst)
+                moved += len(pages)
+            m_pages = max(len(v) for v in send.values())
+            m_zero = max((len(leaving[s]) for s in range(n)), default=1)
+            m_zero = max(m_zero, 1)
+            send_idx = np.zeros((n, n - 1, m_pages), np.int32)
+            recv_idx = np.full((n, n - 1, m_pages), ps, np.int32)
+            zero_idx = np.full((n, m_zero), ps, np.int32)
+            for (s, d), v in send.items():
+                send_idx[s, d - 1, : len(v)] = v
+            for (s, d), v in recv.items():
+                recv_idx[s, d - 1, : len(v)] = v
+            for s in range(n):
+                vac = sorted(leaving[s])
+                zero_idx[s, : len(vac)] = vac
+            fn = mesh_fn(
+                self.mesh, ("page_mover", m_pages, m_zero),
+                lambda: page_mover_fn(self.mesh, m_pages, m_zero),
+            )
+            idx_tree = shard_leading(
+                (send_idx, recv_idx, zero_idx), self.mesh)
+            self.pool_elem, self.pool_char = fn(
+                self.pool_elem, self.pool_char, *idx_tree)
+            self.ici_page_moves += moved
+            GLOBAL_COUNTERS.add("store.ici_page_moves", moved)
+        for a, m in zip(alloc.shards, new_maps):
+            a.reseat(m)
+        idx = jnp.asarray(src)
+        self.aux = shard_docs(
+            tuple(jnp.take(a, idx, axis=0) for a in self.aux), self.mesh)
+        self._num_pages = self._num_pages[src]
+        self._used_hint = self._used_hint[src]
+        self.alloc_epoch += 1
+
+    # -- telemetry -----------------------------------------------------------
+
+    def shard_stats(self) -> Dict:
+        """Per-shard pool snapshot behind the ``peritext_mesh_*`` gauges."""
+        per_use = [a.pages_in_use for a in self.alloc.shards]
+        cap = self.pages_per_shard - 1
+        mean = sum(per_use) / len(per_use) if per_use else 0.0
+        return {
+            "shards": self.n_shards,
+            "rows_per_shard": self.rows_per_shard,
+            "pages_per_shard": self.pages_per_shard,
+            "shard_load": per_use,
+            "shard_utilization": [
+                round(u / cap, 4) if cap else 0.0 for u in per_use
+            ],
+            "imbalance_ratio": (
+                round(max(per_use) / mean, 4) if mean else 1.0
+            ),
+            "ici_page_moves": self.ici_page_moves,
+        }

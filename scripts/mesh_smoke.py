@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""mesh-sharded host smoke: the doc-axis mesh's CI contract (and
+``make mesh-smoke``).
+
+Asserts, on 8 virtual CPU devices, the promises ISSUE 14 makes:
+
+* **byte equality** — a drain on a 1/2/4/8-shard doc-axis mesh is
+  indistinguishable from the single-device fused path: spans,
+  incremental patches and full-state digests bit-equal across ALL three
+  storage layouts (padded, paged, ragged), several fuzz seeds;
+* **one staged program per drain batch** — the whole mesh commits as a
+  single ``shard_map`` dispatch (``streaming.fused_dispatches`` delta);
+* **zero steady-state compiles** — a fresh session replaying the same
+  shapes on an equivalent mesh dispatches only already-compiled mesh
+  programs (RecompileSentinel);
+* **the collective reshard preserves bytes** — the sharded page pool's
+  ICI ``reshard()`` moves pages over permute collectives without
+  changing a single observable byte, and counts its moves;
+* **observable** — devprof grows a ``mesh`` section (per-shard load /
+  utilization, imbalance watermark) and the ``peritext_mesh_*`` gauges
+  render in the Prometheus exposition.
+
+Artifacts (``mesh-report.json``, the devprof snapshot, the gauge text)
+are written for upload.  Exit nonzero on any violation.
+"""
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+LAYOUTS = ("padded", "paged", "ragged")
+
+
+def _mesh(n):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:n]), ("docs",))
+
+
+def _changes(workloads):
+    return [[ch for log in w.values() for ch in log] for w in workloads]
+
+
+def _replay(layout, mesh, changes, **kw):
+    from peritext_tpu.parallel.streaming import StreamingMerge
+
+    kw.setdefault("slot_capacity", 256)
+    kw.setdefault("mark_capacity", 128)
+    kw.setdefault("tomb_capacity", 128)
+    sess = StreamingMerge(
+        num_docs=len(changes), actors=("doc1", "doc2", "doc3"),
+        layout=layout, mesh=mesh, **kw,
+    )
+    for doc, log in enumerate(changes):
+        sess.ingest(doc, log)
+    sess.drain()
+    return sess
+
+
+def _snapshot(sess):
+    # read_patches_all consumes the patch stream: capture once per session
+    return sess.digest(), sess.read_all(), sess.read_patches_all()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seeds", type=int, nargs="*", default=[3, 21])
+    parser.add_argument("--out", default="mesh-artifacts",
+                        help="artifact directory")
+    args = parser.parse_args()
+
+    import jax
+
+    from peritext_tpu.obs import GLOBAL_COUNTERS, GLOBAL_DEVPROF
+    from peritext_tpu.obs.exporters import prometheus_text
+    from peritext_tpu.observability import RecompileSentinel
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    devices = jax.devices()
+    assert len(devices) >= 8, (
+        f"mesh smoke needs 8 virtual devices, got {len(devices)} "
+        "(set XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+    )
+    shard_counts = (1, 2, 4, 8)
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    report = {"seeds": args.seeds, "shard_counts": list(shard_counts),
+              "layouts": {}}
+
+    GLOBAL_DEVPROF.reset()
+    with GLOBAL_DEVPROF:
+        # -- equality sweep: every layout x shard count vs single-device ----
+        for layout in LAYOUTS:
+            rows = []
+            for seed in args.seeds:
+                changes = _changes(
+                    generate_workload(seed, num_docs=16, ops_per_doc=40)
+                )
+                digest, spans, patches = _snapshot(
+                    _replay(layout, None, changes)
+                )
+                for n in shard_counts:
+                    d0 = GLOBAL_COUNTERS.get("streaming.fused_dispatches")
+                    sess = _replay(layout, _mesh(n), changes)
+                    dispatches = (
+                        GLOBAL_COUNTERS.get("streaming.fused_dispatches") - d0
+                    )
+                    tag = f"{layout} seed {seed} shards {n}"
+                    assert sess.digest() == digest, f"{tag}: digest diverged"
+                    assert sess.read_all() == spans, f"{tag}: spans diverged"
+                    assert sess.read_patches_all() == patches, (
+                        f"{tag}: patches diverged"
+                    )
+                    assert dispatches == 1, (
+                        f"{tag}: drain batch took {dispatches} staged "
+                        "programs, the mesh contract is ONE"
+                    )
+                    rows.append({"seed": seed, "shards": n,
+                                 "digest": digest,
+                                 "fused_dispatches": dispatches,
+                                 "mesh": sess._mesh_stats() if n > 1 else None})
+            report["layouts"][layout] = rows
+
+        # -- zero steady-state compiles on an equivalent mesh ---------------
+        changes = _changes(
+            generate_workload(seed=45, num_docs=16, ops_per_doc=32)
+        )
+        for layout in LAYOUTS:
+            _replay(layout, _mesh(8), changes)  # cold: pays the compiles
+        with RecompileSentinel() as sentinel:
+            sentinel.mark()
+            for layout in LAYOUTS:
+                _replay(layout, _mesh(8), changes)
+            sentinel.assert_steady_state("fresh-session mesh replay")
+        report["steady_state_compiles"] = 0
+
+        # -- the sharded pool's collective reshard --------------------------
+        changes = _changes(
+            generate_workload(seed=77, num_docs=16, ops_per_doc=40)
+        )
+        digest, spans, patches = _snapshot(_replay("paged", None, changes))
+        sess = _replay("paged", _mesh(4), changes)
+        before = GLOBAL_COUNTERS.get("store.ici_page_moves")
+        sess.reshard()
+        assert sess.digest() == digest, "post-reshard digest diverged"
+        assert sess.read_all() == spans, "post-reshard spans diverged"
+        assert sess.read_patches_all() == patches, "post-reshard patches"
+        moved = GLOBAL_COUNTERS.get("store.ici_page_moves") - before
+        stats = sess._store.shard_stats()
+        report["reshard"] = {"ici_page_moves": moved,
+                             "shard_stats": stats,
+                             "equality": "byte-identical"}
+
+    # -- the observability surface ------------------------------------------
+    snap = GLOBAL_DEVPROF.snapshot()
+    assert snap["mesh"] is not None, "devprof mesh section never populated"
+    assert snap["mesh"]["shards"] >= 2, snap["mesh"]
+    gauges = prometheus_text(devprof=GLOBAL_DEVPROF)
+    for metric in ("peritext_mesh_shards", "peritext_mesh_shard_load",
+                   "peritext_mesh_shard_imbalance_ratio",
+                   "peritext_mesh_peak_imbalance_ratio"):
+        assert f"# TYPE {metric} gauge" in gauges, f"{metric} gauge missing"
+    report["devprof_mesh"] = snap["mesh"]
+
+    (out / "mesh-report.json").write_text(json.dumps(report, indent=2))
+    (out / "devprof-snapshot.json").write_text(json.dumps(snap, indent=2))
+    (out / "mesh-gauges.prom").write_text(gauges)
+    print(json.dumps({"ok": True,
+                      "reshard": report["reshard"]["ici_page_moves"],
+                      "mesh": report["devprof_mesh"],
+                      "layouts": {k: len(v)
+                                  for k, v in report["layouts"].items()}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,38 @@
+"""graftlint fixture: the mesh-region mistake PTL003 exists for.
+
+The mesh-sharded commit path wraps the staged K-round body in
+``jax.jit(shard_map(body, ...))`` so a drain batch is ONE dispatch for
+the whole mesh.  The body executes under the enclosing trace, so a
+"quick peek" ``.item()`` inside a helper the shard-mapped body calls is
+a host sync from INSIDE the mesh region — it stalls every shard on the
+doc axis, not just one device, and re-serializes the single staged
+program the mesh path exists to keep async.  This file is the TRUE
+POSITIVE proving PTL003 sees through the ``shard_map`` wrapper; never
+"fix" it.
+"""
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _shard_debug_total(rows):
+    total = rows.sum()
+    # PTL003: host sync inside the shard_map region, reachable from the
+    # jit root below through the mapped body's file-local call graph
+    return total.item()
+
+
+def _mesh_round_body(rows, stream):
+    rows = rows + stream
+    _shard_debug_total(rows)
+    return rows
+
+
+mesh_fused_commit = jax.jit(
+    shard_map(
+        _mesh_round_body,
+        in_specs=(P("docs"), P("docs")),
+        out_specs=P("docs"),
+    )
+)

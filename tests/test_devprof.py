@@ -218,7 +218,7 @@ def test_bucket_table_distinct_shapes_match_sentinel(recompile_sentinel,
 
 GOLDEN_DEVPROF_KEYS = {
     "enabled", "capture_costs", "sites", "occupancy", "occupancy_totals",
-    "memory", "page_pool", "ragged",
+    "memory", "page_pool", "ragged", "mesh",
 }
 GOLDEN_SITE_KEYS = {"distinct_shapes", "dispatches", "buckets"}
 GOLDEN_BUCKET_KEYS = {"dispatches", "sig", "cost", "memory"}
@@ -287,6 +287,38 @@ class TestDevprofExporterGoldenShapes:
         assert 'peritext_device_distinct_shapes{site="_golden_probe"} 1' in text
         for line in text.splitlines():
             assert line.startswith("#") or len(line.split()) == 2
+
+    def test_mesh_section_and_gauges(self):
+        p = _profiled_probe()
+        snap = p.snapshot()
+        assert snap["mesh"] is None  # meshless processes export no section
+        stats = {
+            "shards": 4, "rows_per_shard": 4,
+            "shard_load": [3, 4, 3, 2],
+            "shard_utilization": [0.5, 0.75, 0.5, 0.25],
+            "imbalance_ratio": 1.33, "ici_page_moves": 12,
+        }
+        p.observe_mesh(stats)
+        p.observe_mesh(dict(stats, imbalance_ratio=1.1))
+        mesh = p.snapshot()["mesh"]
+        assert mesh["imbalance_ratio"] == 1.1
+        assert mesh["peak_imbalance"] == 1.33  # watermark survives the dip
+        text = prometheus_text(devprof=p)
+        for gauge in (
+            "peritext_mesh_shards",
+            "peritext_mesh_rows_per_shard",
+            "peritext_mesh_shard_imbalance_ratio",
+            "peritext_mesh_peak_imbalance_ratio",
+            "peritext_mesh_ici_page_moves",
+            "peritext_mesh_shard_load",
+            "peritext_mesh_shard_pool_utilization",
+        ):
+            assert f"# TYPE {gauge} gauge" in text, gauge
+        assert 'peritext_mesh_shard_load{shard="1"} 4' in text
+        assert 'peritext_mesh_shard_pool_utilization{shard="3"} 0.25' in text
+        health = health_snapshot(mesh=stats)
+        assert health["mesh"]["shards"] == 4
+        json.dumps(health, default=str)
 
     def test_devprof_json_endpoint(self):
         server = MetricsServer(devprof=_profiled_probe())

@@ -64,6 +64,10 @@ class TestRules:
         # loop (reachable from the jit root through a chained helper)
         # re-serializes the dispatch pipeline the fusion exists to remove
         assert ("PTL003", "jax.block_until_ready(state)") in hits
+        # the mesh-region mistake: a host sync in a helper the
+        # shard-mapped body calls — jit(shard_map(body)) roots body, so
+        # the sync stalls every shard of the one staged mesh program
+        assert ("PTL003", "return total.item()") in hits
         assert ("PTL005", "except Exception:") in hits
         assert ("PTL006", "rng = random.Random()") in hits
         # the serving-tier placement mistake: a wall-clock read sneaking

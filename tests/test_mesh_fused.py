@@ -1,0 +1,189 @@
+"""Mesh-sharded fused commits (ISSUE 14): byte equality with the
+single-device fused path across every storage layout, the one-staged-
+program-per-drain dispatch discipline, zero steady-state compiles, and
+the bounded per-mesh program cache.
+
+Runs on the 8 virtual CPU devices conftest.py forces, so every shard
+count up to 8 is exercised without hardware."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from peritext_tpu.obs import GLOBAL_COUNTERS
+from peritext_tpu.parallel.streaming import StreamingMerge
+from peritext_tpu.testing.fuzz import (
+    generate_markheavy_workload,
+    generate_workload,
+)
+
+LAYOUTS = ("padded", "paged", "ragged")
+
+
+def _mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), ("docs",))
+
+
+def _changes(workloads):
+    return [[ch for log in w.values() for ch in log] for w in workloads]
+
+
+def _replay(layout, mesh, changes, **kw):
+    kw.setdefault("slot_capacity", 256)
+    kw.setdefault("mark_capacity", 128)
+    kw.setdefault("tomb_capacity", 128)
+    sess = StreamingMerge(
+        num_docs=len(changes), actors=("doc1", "doc2", "doc3"),
+        layout=layout, mesh=mesh, **kw,
+    )
+    for doc, log in enumerate(changes):
+        sess.ingest(doc, log)
+    sess.drain()
+    return sess
+
+
+def _snapshot(sess):
+    # read_patches_all consumes the patch stream, so capture each
+    # session's triple exactly once and compare the captures
+    return sess.digest(), sess.read_all(), sess.read_patches_all()
+
+
+def _assert_equal(sess, ref_snap, label):
+    digest, spans, patches = ref_snap
+    assert sess.digest() == digest, f"{label}: digest diverged"
+    assert sess.read_all() == spans, f"{label}: read_all diverged"
+    assert sess.read_patches_all() == patches, f"{label}: patches diverged"
+
+
+# ---------------------------------------------------------------------------
+# byte equality: sharded fused commit == single-device, every layout
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("layout", LAYOUTS)
+@pytest.mark.parametrize("seed", (3, 21, 77))
+def test_mesh_drain_matches_single_device(layout, seed):
+    changes = _changes(generate_workload(seed, num_docs=16, ops_per_doc=40))
+    ref = _snapshot(_replay(layout, None, changes))
+    for n in (2, 8):
+        sess = _replay(layout, _mesh(n), changes)
+        _assert_equal(sess, ref, f"{layout} seed={seed} shards={n}")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_mesh_drain_markheavy_family(layout):
+    # same session shape as the seed sweep above so the compiled-program
+    # ladder is shared — only the op mix (span-overlap explosion) changes
+    changes = _changes(
+        generate_markheavy_workload(seed=5, num_docs=16, ops_per_doc=40)
+    )
+    ref = _snapshot(_replay(layout, None, changes))
+    sess = _replay(layout, _mesh(8), changes)
+    _assert_equal(sess, ref, f"{layout} markheavy shards=8")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_mesh_drain_longdoc_family(layout):
+    # one essay among a fleet of tweets: the per-shard page loads (and the
+    # ragged walk lengths) skew hard across the mesh (session shape kept
+    # on the shared compile ladder — the skew is the point, not the size)
+    long = _changes(generate_workload(seed=9, num_docs=1, ops_per_doc=96))
+    short = _changes(generate_workload(seed=1009, num_docs=15, ops_per_doc=16))
+    changes = long + short
+    ref = _snapshot(_replay(layout, None, changes))
+    sess = _replay(layout, _mesh(8), changes)
+    _assert_equal(sess, ref, f"{layout} longdoc shards=8")
+
+
+# ---------------------------------------------------------------------------
+# dispatch + compile discipline
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_mesh_drain_is_one_fused_dispatch(layout):
+    changes = _changes(generate_workload(seed=31, num_docs=16, ops_per_doc=40))
+    sess = StreamingMerge(
+        num_docs=16, actors=("doc1", "doc2", "doc3"),
+        layout=layout, mesh=_mesh(8),
+        slot_capacity=256, mark_capacity=128, tomb_capacity=128,
+    )
+    for doc, log in enumerate(changes):
+        sess.ingest(doc, log)
+    d0 = GLOBAL_COUNTERS.get("streaming.fused_dispatches")
+    sess.drain()
+    assert GLOBAL_COUNTERS.get("streaming.fused_dispatches") - d0 == 1, (
+        f"{layout}: a mesh drain batch must be ONE staged program"
+    )
+
+
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_mesh_repeat_drain_compiles_nothing(layout, recompile_sentinel):
+    changes = _changes(generate_workload(seed=45, num_docs=16, ops_per_doc=32))
+    _replay(layout, _mesh(8), changes)  # cold: pays the compile ladder
+    recompile_sentinel.mark()
+    warm = _replay(layout, _mesh(8), changes)
+    recompile_sentinel.assert_steady_state(
+        f"fresh-session {layout} mesh replay"
+    )
+    cold = _snapshot(_replay(layout, None, changes))
+    _assert_equal(warm, cold, f"{layout} steady-state shards=8")
+
+
+# ---------------------------------------------------------------------------
+# the sharded page pool's collective reshard
+# ---------------------------------------------------------------------------
+
+
+def test_paged_reshard_preserves_bytes_and_counts_moves():
+    changes = _changes(generate_workload(seed=77, num_docs=16, ops_per_doc=40))
+    ref = _snapshot(_replay("paged", None, changes))
+    sess = _replay("paged", _mesh(8), changes)
+    before = GLOBAL_COUNTERS.get("store.ici_page_moves")
+    out = sess.reshard()
+    _assert_equal(sess, ref, "paged post-reshard shards=8")
+    moved = sess._store.ici_page_moves
+    assert GLOBAL_COUNTERS.get("store.ici_page_moves") - before == moved
+    stats = sess._store.shard_stats()
+    assert stats["shards"] == 8
+    assert len(stats["shard_load"]) == 8
+    assert stats["imbalance_ratio"] >= 1.0
+    assert out is not None
+
+
+# ---------------------------------------------------------------------------
+# per-mesh program caches: fingerprint-keyed, bounded
+# ---------------------------------------------------------------------------
+
+
+def test_gather_rows_cache_keyed_by_mesh_fingerprint():
+    from peritext_tpu.parallel import mesh_fused
+    from peritext_tpu.parallel.streaming import gather_rows_fn
+
+    # re-requesting the gather for an equivalent mesh must hit the shared
+    # bounded cache (fingerprint-keyed), never build a second executable
+    mesh = Mesh(np.asarray(jax.devices()), ("docs",))
+    first = gather_rows_fn(mesh)
+    size = mesh_fused.mesh_fn_cache_size()
+    assert gather_rows_fn(Mesh(np.asarray(jax.devices()), ("docs",))) is first
+    assert mesh_fused.mesh_fn_cache_size() == size
+    # the cache key is the mesh FINGERPRINT, not the live object: a
+    # fingerprint-equal key probe lands on the same entry
+    key = (mesh_fused.mesh_fingerprint(mesh), "gather_rows")
+    assert any(k == key for k in mesh_fused._MESH_FN_CACHE)
+
+
+def test_mesh_fn_cache_is_bounded():
+    from peritext_tpu.parallel import mesh_fused
+
+    for i in range(mesh_fused.MESH_FN_CACHE_BOUND + 16):
+        mesh_fused.mesh_fn(None, ("bound_probe", i), lambda: object())
+    assert mesh_fused.mesh_fn_cache_size() <= mesh_fused.MESH_FN_CACHE_BOUND
+    # and re-requesting a live key returns the cached object, not a rebuild
+    probe = mesh_fused.mesh_fn(None, ("bound_probe_live",), lambda: object())
+    again = mesh_fused.mesh_fn(None, ("bound_probe_live",),
+                               lambda: object())
+    assert probe is again
