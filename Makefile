@@ -9,7 +9,8 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 	bench bench-smoke bench-streaming bench-fused entry dryrun lint lint-baseline \
 	clean obs fleet perf-gate serve-smoke bench-serve paged-smoke bench-longdoc \
 	fused-smoke fleet-serve-smoke bench-fleet-serve bench-markheavy \
-	ragged-smoke plan-smoke bench-serve-fused mesh-smoke bench-mesh
+	ragged-smoke plan-smoke bench-serve-fused mesh-smoke bench-mesh \
+	latency-smoke
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -53,6 +54,14 @@ fleet:
 # artifacts land in /tmp/pt-serve
 serve-smoke:
 	$(CPU_ENV) $(PY) scripts/serve_smoke.py --out /tmp/pt-serve
+
+# time-to-visibility latency-plane smoke (mirrors the CI obs-smoke job's
+# latency step): an armed serve session -> sum-consistent stage records +
+# /latency.json + peritext_latency_* families, the `obs why` exit
+# contract (0 clean / 1 regressed / 2 unreadable), and the <2% arming
+# overhead pin (artifacts land in /tmp/pt-latency)
+latency-smoke:
+	$(CPU_ENV) $(PY) scripts/latency_smoke.py --out /tmp/pt-latency
 
 # sustained open-loop serving ladder: docs/s at the p99 apply-latency SLO
 bench-serve:

@@ -1375,6 +1375,31 @@ def run_serve(args) -> dict:
         if mid_best is not None:
             best = mid_best
     value = best.rate_per_s if best is not None else 0.0
+
+    # traced pass: one extra sustained-rate rung on a fresh mux with the
+    # latency plane armed — OUTSIDE the measured ladder, so arming cost
+    # can never touch the headline.  read_every marks visibility, so the
+    # row's decomposition carries the full admit→visibility story, and
+    # the sum-consistency oracle is asserted IN-ROW.
+    from peritext_tpu.obs.latency import LatencyPlane
+    from peritext_tpu.serve import build_arrivals, run_open_loop
+
+    tmux, tframes = mux_factory()
+    tmux.latency_plane = LatencyPlane().enable()
+    trace_rate = max(base, value / 2.0) if value else base
+    traced = run_open_loop(
+        tmux, build_arrivals(tframes, trace_rate, duration),
+        deadline_s=max(duration * 4, duration + 2.0), read_every=4,
+    )
+    lat = traced.latency
+    assert lat is not None and lat["records"] > 0, (
+        "armed latency plane sampled no drain batches in the traced rung"
+    )
+    assert lat["sum_consistent"], f"latency decomposition inconsistent: {lat}"
+    assert all(v >= 0 for v in lat["stages_ms"].values()), (
+        f"negative stage duration: {lat['stages_ms']}"
+    )
+
     return {
         "metric": "serve_sustained_docs_per_sec",
         "value": round(value, 1),
@@ -1390,6 +1415,8 @@ def run_serve(args) -> dict:
         "breaking_rung": broke.to_json() if broke is not None else None,
         # every offered rate sustained: the true ceiling is above the sweep
         "ladder_exhausted": broke is None,
+        "latency": lat,
+        "traced_rate_per_s": round(trace_rate, 1),
         "rungs": [r.to_json() for r in rungs],
         "window": (best.result.window_seconds if best is not None else None),
         "platform": jax.devices()[0].platform,
@@ -1538,6 +1565,14 @@ def run_serve_fused(args) -> dict:
     drive_solo(*build_solo())
 
     group, gsids = build_group()
+    # arm ONE shared plane across every fused lane: the row's per-stage
+    # decomposition spans the whole tenant fleet, and the patch-equality
+    # reads below double as the visibility watermark
+    from peritext_tpu.obs.latency import LatencyPlane
+
+    plane = LatencyPlane().enable()
+    for n in names:
+        group.muxes[n].latency_plane = plane
     fused_dispatches, fused_wall = drive_group(group, gsids)
     muxes, ssids = build_solo()
     solo_dispatches, solo_wall = drive_solo(muxes, ssids)
@@ -1553,6 +1588,14 @@ def run_serve_fused(args) -> dict:
     fusion = group.fusion_snapshot()
     amortization = (solo_dispatches / fused_dispatches
                     if fused_dispatches else 0.0)
+    lat = plane.decomposition()
+    assert lat["records"] > 0, (
+        "armed latency plane sampled no fused drain batches"
+    )
+    assert lat["sum_consistent"], f"latency decomposition inconsistent: {lat}"
+    assert all(v >= 0 for v in lat["stages_ms"].values()), (
+        f"negative stage duration: {lat['stages_ms']}"
+    )
     return {
         "metric": "serve_multitenant_dispatch_amortization",
         "value": round(amortization, 2),
@@ -1573,6 +1616,7 @@ def run_serve_fused(args) -> dict:
             [muxes[n].latency_sink for n in names]
         ),
         "byte_equal": True,
+        "latency": lat,
         "docs_per_dispatch": fusion["docs_per_dispatch"],
         "window_occupancy": fusion["window_occupancy"],
         "platform": jax.devices()[0].platform,

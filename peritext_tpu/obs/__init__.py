@@ -30,6 +30,13 @@ every streaming-perf PR is judged by.  Four cooperating pieces:
 * :mod:`.ledger` — the append-only JSONL perf history (bench ladder rows +
   devprof snapshots keyed by git sha / device / config) behind
   ``python -m peritext_tpu.obs perf`` and the CI perf-gate job.
+* :mod:`.latency` — the time-to-visibility latency plane: per-drain-batch
+  stage-watermark records (admit → window → stage → dispatch → commit →
+  visibility) fed by the serve tier, per-stage histograms + SLO burn-rate
+  gauges (``peritext_latency_*``, ``/latency.json``), and the
+  ``python -m peritext_tpu.obs why`` attribution engine that names the
+  dominant moved stage when the perf gate fails.  Off by default;
+  ``GLOBAL_LATENCY.enable()`` arms the serve-tier hooks.
 * :mod:`.exporters` — Prometheus text exposition and JSON snapshot
   endpoints (:class:`MetricsServer`, mounted by ``ReplicaServer``:
   ``/metrics`` with ``peritext_convergence_*`` gauges, ``/health.json``,
@@ -60,6 +67,13 @@ from .histograms import (
     LATENCY_BUCKETS_S,
     SIZE_BUCKETS,
 )
+from .latency import (
+    GLOBAL_LATENCY,
+    LatencyPlane,
+    STAGES,
+    attribute,
+    check_sum_consistency,
+)
 from .metrics import Counters, GLOBAL_COUNTERS, health_snapshot
 from .recorder import FlightRecorder
 from .sentinel import RecompileSentinel
@@ -85,19 +99,24 @@ __all__ = [
     "GLOBAL_COUNTERS",
     "GLOBAL_DEVPROF",
     "GLOBAL_HISTOGRAMS",
+    "GLOBAL_LATENCY",
     "GLOBAL_TRACER",
     "Histogram",
     "HistogramRegistry",
     "LATENCY_BUCKETS_S",
+    "LatencyPlane",
     "MergeStats",
     "MetricsServer",
     "PeerLag",
     "RecompileSentinel",
     "SIZE_BUCKETS",
+    "STAGES",
     "Span",
     "TraceContext",
     "Tracer",
     "ambient_parent",
+    "attribute",
+    "check_sum_consistency",
     "current_span",
     "health_snapshot",
     "merge_traces",

@@ -35,6 +35,16 @@ one JSONL record per run) and renders the LAST record as a diff table
 against its rolling same-device reference; ``--gate`` makes a regression
 beyond the tolerance bands exit 1 — the CI perf-gate job.
 
+The ``why`` command is the perf gate's attribution engine
+(:func:`peritext_tpu.obs.latency.attribute`): it judges the ledger's last
+record exactly like ``perf``, then explains WHAT moved — diffing the
+failing row's per-stage latency decomposition (admit → window → stage →
+dispatch → commit → visibility) against the per-stage median over the
+rolling reference, attaching the devprof shape-bucket / occupancy
+deltas, and deterministically naming the dominant moved stage (largest
+positive delta; ties break to the earliest stage in the taxonomy).
+``--row`` targets a specific row instead of the first failing one.
+
 Usage::
 
     python -m peritext_tpu.obs summary trace.json [more.json ...]
@@ -44,13 +54,14 @@ Usage::
     python -m peritext_tpu.obs serve hostA-serve.json hostB-serve.json
     python -m peritext_tpu.obs perf perf/reference_ledger.jsonl --gate
     python -m peritext_tpu.obs plan devprof.json --ledger perf/ledger.jsonl
+    python -m peritext_tpu.obs why perf/ledger.jsonl --row serve_sustained
 
 ``summary`` is the default command (``python -m peritext_tpu.obs t.json``
 works).  Exit codes: 0 ok (fleet: converged; serve: healthy; perf: no
-regression; plan: statics within tolerance), 1 no spans found / fleet has
-lag or divergence / serve has overload or shedding / perf ``--gate``
-regression / plan proposal beats the current statics beyond tolerance,
-2 unreadable input.
+regression; why: clean; plan: statics within tolerance), 1 no spans
+found / fleet has lag or divergence / serve has overload or shedding /
+perf ``--gate`` regression / why regression (attributed or not) / plan
+proposal beats the current statics beyond tolerance, 2 unreadable input.
 """
 
 from __future__ import annotations
@@ -289,6 +300,99 @@ def _perf_command(args) -> int:
     return 0
 
 
+def _why_command(args) -> int:
+    """Render the latency-plane regression attribution (see module doc)."""
+    from . import ledger as _ledger
+    from .latency import STAGES, attribute
+
+    try:
+        records = _ledger.load_ledger(args.ledger)
+    except (OSError, ValueError) as exc:
+        print(f"unreadable perf ledger {args.ledger}: {exc}", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"empty perf ledger {args.ledger}", file=sys.stderr)
+        return 2
+    try:
+        report = attribute(
+            records,
+            row=args.row,
+            window=args.window,
+            match=args.match,
+            tolerance=(args.tolerance / 100.0 if args.tolerance is not None
+                       else None),
+        )
+    except ValueError as exc:
+        print(f"why: {exc}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        cand = report["candidate"]
+        sha = (cand.get("sha") or "?")[:12]
+        print(
+            f"{len(records)} record(s) · candidate sha {sha} · "
+            f"{report['reference_records']} matching reference record(s)"
+        )
+        if report["verdict"] == "clean":
+            print("why: gate passes — nothing to attribute")
+            return 0
+        print(
+            f"row {report['row']} [{report['status']}]: "
+            f"{report['ref']} -> {report['value']} {report['unit']} "
+            f"(delta {report['delta']}"
+            + (f", {report['delta_pct']}%" if report.get("delta_pct")
+               is not None else "")
+            + ")"
+        )
+        cand_stages = report.get("candidate_stages_ms")
+        ref_stages = report.get("reference_stages_ms")
+        deltas = report.get("stage_deltas_ms")
+        if cand_stages and ref_stages and deltas is not None:
+            rows = [
+                {
+                    "stage": s,
+                    "ref_ms": ref_stages.get(s, "-"),
+                    "value_ms": cand_stages.get(s, "-"),
+                    "delta_ms": deltas.get(s, "-"),
+                }
+                for s in sorted(
+                    set(cand_stages) | set(ref_stages),
+                    key=lambda n: (STAGES.index(n) if n in STAGES
+                                   else len(STAGES), n),
+                )
+            ]
+            print(render_table(
+                rows, cols=["stage", "ref_ms", "value_ms", "delta_ms"],
+                left_cols=1,
+            ))
+        dp = report.get("devprof")
+        if dp:
+            d = dp["delta"]
+            print(
+                "devprof: distinct_shapes "
+                f"{d.get('distinct_shapes')} · dispatches "
+                f"{d.get('dispatches')} · padding_waste "
+                f"{d.get('padding_waste')}"
+            )
+        if report["verdict"] == "regression-attributed":
+            print(f"why: dominant moved stage is "
+                  f"'{report['dominant_stage']}'", file=sys.stderr)
+        elif report["verdict"] == "no-decomposition":
+            print(
+                "why: no latency decomposition on candidate or reference "
+                "rows — arm the plane and re-run the bench", file=sys.stderr,
+            )
+        else:
+            print(
+                "why: regression with no stage moving up — look outside "
+                "the latency plane", file=sys.stderr,
+            )
+    # a regression — whether or not attribution could name a stage — is
+    # exit 1, mirroring `perf --gate`; clean is 0
+    return 0 if report["verdict"] == "clean" else 1
+
+
 def _plan_command(args) -> int:
     """The closed-loop planner's operator surface (see module doc)."""
     from ..plan import PlanProposal, propose  # noqa: F401 - typed surface
@@ -365,7 +469,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # default command: `python -m peritext_tpu.obs trace.json` == summary
     if argv and argv[0] not in ("summary", "merge", "fleet", "serve", "perf",
-                                "plan", "-h", "--help"):
+                                "plan", "why", "-h", "--help"):
         argv.insert(0, "summary")
     parser = argparse.ArgumentParser(
         prog="python -m peritext_tpu.obs", description=__doc__,
@@ -411,6 +515,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         default="device",
                         help="how strictly reference records must match the "
                         "candidate's device fingerprint (default: device)")
+    p_why = sub.add_parser(
+        "why", help="latency-plane regression attribution: name the "
+        "dominant moved stage behind a perf-gate failure (exit 1 on "
+        "regression)",
+    )
+    p_why.add_argument("ledger", help="JSONL perf-ledger path")
+    p_why.add_argument("--row", default=None, metavar="NAME",
+                       help="attribute this row instead of the first "
+                       "failing one")
+    p_why.add_argument("--json", action="store_true",
+                       help="machine-readable attribution instead of the "
+                       "table")
+    p_why.add_argument("--window", type=int, default=None, metavar="N",
+                       help="rolling-reference window (prior records; "
+                       "default 5)")
+    p_why.add_argument("--match", choices=("device", "platform", "any"),
+                       default="device",
+                       help="how strictly reference records must match the "
+                       "candidate's device fingerprint (default: device)")
+    p_why.add_argument("--tolerance", type=float, default=None, metavar="PCT",
+                       help="override every row's tolerance band (percent)")
     p_plan = sub.add_parser(
         "plan", help="closed-loop planner proposal from a devprof snapshot "
         "(exit 1 when the proposal beats the current statics)",
@@ -431,6 +556,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.cmd == "perf":
         return _perf_command(args)
+
+    if args.cmd == "why":
+        return _why_command(args)
 
     if args.cmd == "plan":
         return _plan_command(args)

@@ -19,6 +19,9 @@ Endpoints:
 * ``/fleet.json``   — the attached :class:`~..serve.FleetFrontend` snapshot
   (heartbeat-lease table, router placement, per-host serve summaries,
   failover/migration tallies, fleet-wide verdict accounting)
+* ``/latency.json`` — the attached :class:`~.latency.LatencyPlane` snapshot
+  (per-stage watermark histograms, SLO burn rate, close causes,
+  time-to-visibility)
 """
 
 from __future__ import annotations
@@ -53,6 +56,7 @@ def prometheus_text(
     serve=None,
     fleet=None,
     plan=None,
+    latency=None,
 ) -> str:
     """Prometheus text exposition of the process telemetry.  Counter names
     sanitize ``.`` → ``_`` under a ``peritext_`` prefix; histograms emit the
@@ -78,7 +82,11 @@ def prometheus_text(
     membership, dispatch amortization, window occupancy); a planner
     verdict passed as ``plan`` (a :class:`~..plan.tuner.PlanProposal` or
     its ``to_json()`` dict) lands as ``peritext_plan_*`` gauges (modeled
-    scores, savings fraction, the proposed statics)."""
+    scores, savings fraction, the proposed statics); a
+    :class:`~.latency.LatencyPlane` lands as ``peritext_latency_*``
+    families — one histogram per stage watermark plus the end-to-end
+    total and time-to-visibility, SLO burn-rate gauges, and the
+    window-close cause counters."""
     counters = counters or GLOBAL_COUNTERS
     histograms = histograms if histograms is not None else GLOBAL_HISTOGRAMS
     lines = []
@@ -94,6 +102,7 @@ def prometheus_text(
         lines.append(f'{m}_bucket{{le="+Inf"}} {hist.count}')
         lines.append(f"{m}_sum {_fmt(hist.sum)}")
         lines.append(f"{m}_count {hist.count}")
+        lines.append(f"{m}_overflow {hist.overflow}")
     if sentinel is not None:
         m = "peritext_recompiles_total"
         lines.append(f"# TYPE {m} counter")
@@ -369,6 +378,43 @@ def prometheus_text(
             if isinstance(value, (int, float)):
                 lines.append(f"# TYPE {m} gauge")
                 lines.append(f"{m} {_fmt(value)}")
+    if latency is not None:
+        # the latency plane owns PRIVATE histograms (arming it for one
+        # bench arm must not pollute the process registry), so its
+        # families are emitted here from the plane itself
+        for name, hist in sorted(latency.hists.items()):
+            m = f"peritext_latency_{_NAME_RE.sub('_', name)}_seconds"
+            lines.append(f"# TYPE {m} histogram")
+            for bound, cum in hist.bucket_counts():
+                lines.append(f'{m}_bucket{{le="{bound:g}"}} {cum}')
+            lines.append(f'{m}_bucket{{le="+Inf"}} {hist.count}')
+            lines.append(f"{m}_sum {_fmt(hist.sum)}")
+            lines.append(f"{m}_count {hist.count}")
+            lines.append(f"{m}_overflow {hist.overflow}")
+        snap = latency.snapshot()
+        slo = snap["slo"]
+        for m, value in (
+            ("peritext_latency_enabled", int(snap["enabled"])),
+            ("peritext_latency_sample_every", snap["sample_every"]),
+            ("peritext_latency_windows", snap["windows"]),
+            ("peritext_latency_records", snap["records"]),
+            ("peritext_latency_pending_visibility",
+             snap["pending_visibility"]),
+            ("peritext_latency_never_read", snap["never_read"]),
+            ("peritext_latency_replica_fanout", snap["shards"]),
+            ("peritext_latency_slo_seconds", slo["slo_seconds"]),
+            ("peritext_latency_slo_target", slo["target"]),
+            ("peritext_latency_slo_violating_frac", slo["violating_frac"]),
+            ("peritext_latency_slo_burn_rate", slo["burn_rate"]),
+        ):
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {_fmt(value)}")
+        m = "peritext_latency_force_close_total"
+        lines.append(f"# TYPE {m} counter")
+        for cause, count in sorted(snap["force_close"].items()):
+            quoted = (cause.replace("\\", "\\\\").replace('"', '\\"')
+                      .replace("\n", "\\n"))
+            lines.append(f'{m}{{cause="{quoted}"}} {_fmt(count)}')
     if session is not None:
         health = session.health()
         for key in sorted(health):
@@ -430,12 +476,14 @@ class MetricsServer:
         serve=None,
         fleet=None,
         plan=None,
+        latency=None,
     ) -> None:
         def metrics() -> str:
             return prometheus_text(
                 counters=counters, histograms=histograms,
                 session=session, sentinel=sentinel, convergence=convergence,
                 devprof=devprof, serve=serve, fleet=fleet, plan=plan,
+                latency=latency,
             )
 
         def snapshot() -> str:
@@ -444,7 +492,7 @@ class MetricsServer:
                     counters=counters, session=session, sentinel=sentinel,
                     histograms=histograms, recorder=recorder,
                     convergence=convergence, devprof=devprof, serve=serve,
-                    fleet=fleet, plan=plan,
+                    fleet=fleet, plan=plan, latency=latency,
                 ),
                 default=str,
             )
@@ -484,6 +532,11 @@ class MetricsServer:
                     plan.to_json() if hasattr(plan, "to_json")
                     else dict(plan)
                 ),
+                "application/json",
+            )
+        if latency is not None:
+            routes["/latency.json"] = (
+                lambda: json.dumps(latency.snapshot()),
                 "application/json",
             )
         self._httpd = ThreadingHTTPServer((host, port), _Handler)

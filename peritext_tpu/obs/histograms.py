@@ -112,6 +112,14 @@ class Histogram:
     def p99(self) -> float:
         return self.percentile(0.99)
 
+    @property
+    def overflow(self) -> int:
+        """Observations past the last bound — a saturated top bucket reads
+        as "overflowed", never silently as the top bound.  Window-safe: the
+        rolling ring decrements this slot on eviction like any other."""
+        with self._lock:
+            return self._counts[-1]
+
     def bucket_counts(self) -> List[Tuple[float, int]]:
         """CUMULATIVE counts per upper bound (Prometheus ``le`` semantics);
         the +Inf bucket is ``count``."""
@@ -126,10 +134,12 @@ class Histogram:
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             count, total, mx = self.count, self.sum, self._max
+            over = self._counts[-1]
         return {
             "count": count,
             "sum": round(total, 6),
             "max": round(mx, 6),
+            "overflow": over,
             "p50": self.percentile(0.50),
             "p95": self.percentile(0.95),
             "p99": self.percentile(0.99),

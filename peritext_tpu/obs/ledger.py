@@ -114,6 +114,11 @@ def ledger_record(
             entry["failed"] = True
         if r.get("skipped"):
             entry["skipped"] = True
+        if isinstance(r.get("latency"), dict):
+            # the latency plane's per-stage decomposition rides along so
+            # `obs why` can diff a failing row's stages against its
+            # rolling reference (older records simply lack the key)
+            entry["latency"] = r["latency"]
         out_rows.append(entry)
     return {
         "schema": SCHEMA_VERSION,
@@ -204,7 +209,11 @@ def evaluate(
     its reference succeeded) / ``new`` (no reference — vacuous pass) /
     ``missing`` (a same-config reference row the candidate no longer
     carries — a renamed or dropped bench row must fail the gate loudly,
-    never silently weaken it to a vacuous pass)."""
+    never silently weaken it to a vacuous pass).  Each verdict carries the
+    reference median (``ref``), the SIGNED absolute delta (``delta``, in
+    the row's own unit) and percentage delta alongside the status, plus
+    the candidate row's ``latency`` decomposition when the ledger record
+    has one — so ``obs why`` and CI artifacts consume one schema."""
     if not records:
         raise ValueError("empty ledger: nothing to evaluate")
     candidate = records[-1]
@@ -245,9 +254,12 @@ def evaluate(
             "ref": round(_median(refs), 4) if refs else None,
             "refs": len(refs),
             "band_pct": round(band * 100, 1),
+            "delta": None,
             "delta_pct": None,
             "status": "new",
         }
+        if isinstance(row.get("latency"), dict):
+            verdict["latency"] = row["latency"]
         if refs:
             ref = _median(refs)
             value = row.get("value")
@@ -257,6 +269,7 @@ def evaluate(
             else:
                 direction = DIRECTION_BY_UNIT.get(unit, +1)
                 delta = (value - ref) / ref if ref else 0.0
+                verdict["delta"] = round(value - ref, 4)
                 verdict["delta_pct"] = round(delta * 100, 1)
                 shortfall = -delta * direction  # >0 = worse, whatever the unit
                 if shortfall > band:
@@ -292,6 +305,7 @@ def evaluate(
                     "band_pct": round(
                         (tolerance if tolerance is not None
                          else BAND_BY_UNIT.get(unit, DEFAULT_BAND)) * 100, 1),
+                    "delta": None,
                     "delta_pct": None,
                     "status": "missing",
                 })

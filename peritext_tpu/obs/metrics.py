@@ -62,6 +62,7 @@ def health_snapshot(
     fleet=None,
     plan=None,
     mesh=None,
+    latency=None,
 ) -> Dict[str, Any]:
     """One structured dict for a fleet health endpoint: every fault-domain
     counter (quarantines, corrupt frames, transport retries / behind peers,
@@ -90,7 +91,10 @@ def health_snapshot(
     surface the rest of the fleet scrapes; with a mesh-shard stats dict
     (a sharded session's ``_mesh_stats()`` / sharded store's
     ``shard_stats()``), the per-shard load/utilization and ICI page-move
-    tallies appear under ``mesh``.  Everything in the snapshot is
+    tallies appear under ``mesh``; with a
+    :class:`~.latency.LatencyPlane`, its stage-watermark decomposition
+    (per-stage histograms, SLO burn rate, close causes) appears under
+    ``latency``.  Everything in the snapshot is
     JSON-serializable (the exporter-schema golden test pins this)."""
     from .histograms import GLOBAL_HISTOGRAMS
 
@@ -131,4 +135,6 @@ def health_snapshot(
         )
     if mesh is not None:
         out["mesh"] = dict(mesh)
+    if latency is not None:
+        out["latency"] = latency.snapshot()
     return out

@@ -9,6 +9,13 @@ whole ring to a JSONL file on :meth:`fault` (quarantine, rollback,
 transport give-up; throttled) or an explicit :meth:`dump`.  Each line is
 one JSON record; a ``kind: "dump"`` header line carries the reason, so a
 post-mortem starts from ``python -m peritext_tpu.obs summary <dump>``.
+
+Fault dumps can carry INCIDENT CONTEXT beyond the ring: register a
+provider with :meth:`add_context_provider` and every fault-triggered dump
+appends its output as ``kind: "context"`` records.  The serve mux
+registers one mapping a quarantine/rollback fault's ``doc`` to that doc's
+recent admission-verdict tail, so a post-mortem sees the backpressure
+picture around the incident, not just the span ring.
 """
 
 from __future__ import annotations
@@ -20,7 +27,7 @@ import threading
 import time
 from collections import deque
 from pathlib import Path
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 #: process-wide dump numbering: several recorders sharing one dump_dir
 #: (e.g. a crash-restored supervisor reusing <ckpt>/flight) must never
@@ -56,6 +63,9 @@ class FlightRecorder:
         self.faults = 0
         self.dumps = 0
         self.last_dump_path: Optional[Path] = None
+        #: name -> fn(fault_fields) returning a dict, a list of dicts, or
+        #: None; outputs land in fault dumps as ``kind: "context"`` records
+        self._context_providers: Dict[str, Callable] = {}
 
     # -- recording -----------------------------------------------------------
 
@@ -71,9 +81,21 @@ class FlightRecorder:
         """Tracer-sink form: ``tracer.add_sink(recorder.record_span)``."""
         self.record("span", **span.to_json())
 
+    def add_context_provider(self, name: str, fn: Callable) -> None:
+        """Register ``fn(fault_fields) -> dict | list[dict] | None`` to be
+        consulted on every fault-triggered dump; its output is appended to
+        the dump as ``kind: "context"`` records labelled ``provider=name``.
+        Re-registering a name replaces the provider (a rebuilt mux swaps
+        its hook in place)."""
+        with self._lock:
+            self._context_providers[name] = fn
+
     def fault(self, reason: str, **fields) -> Dict:
         """Record a fault event and (when a ``dump_dir`` is configured)
-        dump the ring — the quarantine/rollback/transport-give-up hook."""
+        dump the ring — the quarantine/rollback/transport-give-up hook.
+        The fault's fields are offered to every context provider, so the
+        dump carries the incident's surroundings (e.g. the affected doc's
+        admission-verdict tail), not just the telemetry ring."""
         self.faults += 1
         rec = self.record("fault", reason=reason, **fields)
         if self.dump_dir is not None:
@@ -82,7 +104,7 @@ class FlightRecorder:
                     or now - self._last_auto_dump >= self.min_dump_interval):
                 self._last_auto_dump = now
                 try:
-                    self.dump(reason=reason)
+                    self.dump(reason=reason, context=dict(fields))
                 except OSError:
                     # graftlint: boundary(a full/readonly disk must not turn a contained fault into a crash; the ring stays queryable in memory)
                     pass
@@ -94,14 +116,44 @@ class FlightRecorder:
         with self._lock:
             return list(self._ring)
 
+    def _context_records(self, fields: Dict) -> List[Dict]:
+        """Run every context provider against one fault's fields; cap the
+        total so a runaway provider can't flood a dump."""
+        with self._lock:
+            providers = list(self._context_providers.items())
+        out: List[Dict] = []
+        for name, fn in providers:
+            try:
+                got = fn(fields)
+            except Exception:  # graftlint: boundary(a broken context provider must not lose the dump it decorates)
+                continue
+            if got is None:
+                continue
+            records = got if isinstance(got, list) else [got]
+            for rec in records:
+                if not isinstance(rec, dict):
+                    continue
+                # envelope keys WIN: a provider record carrying its own
+                # ``kind`` (e.g. an admission verdict) must not break the
+                # dump reader's kind=="context" filter
+                out.append({**rec, "kind": "context", "provider": name})
+                if len(out) >= 128:
+                    return out
+        return out
+
     def dump(self, path: Optional[str | Path] = None,
-             reason: Optional[str] = None) -> Path:
+             reason: Optional[str] = None,
+             context: Optional[Dict] = None) -> Path:
         """Write the ring to ``path`` (default: a fresh
         ``flight-<pid>-<n>-<reason>.jsonl`` under ``dump_dir``, where
         ``<n>`` is process-unique so recorders sharing the directory never
         overwrite each other's post-mortems) as JSONL; returns the path
-        written."""
+        written.  ``context`` (the triggering fault's fields) activates the
+        registered context providers, whose records are appended after the
+        ring."""
         entries = self.entries()
+        if context is not None:
+            entries = entries + self._context_records(context)
         if path is None:
             if self.dump_dir is None:
                 raise ValueError("no dump path given and no dump_dir configured")

@@ -86,6 +86,12 @@ class FrameStager:
         self._closed = False
         self.staged = 0
         self.errors = 0
+        #: optional obs-span hook: when set (a zero-arg callable returning
+        #: a context manager, e.g. ``lambda: tracer.span("staging.stage")``)
+        #: each job executes inside one — the caller-owned timing telemetry
+        #: the module contract promises, still clock-free here (spans
+        #: measure durations; this module never reads a wall clock)
+        self.span_factory: Optional[Callable] = None
 
     # -- submission ----------------------------------------------------------
 
@@ -130,7 +136,12 @@ class FrameStager:
                 return
             fn, args, handle = job
             try:
-                value = fn(*args)
+                factory = self.span_factory
+                if factory is not None:
+                    with factory():
+                        value = fn(*args)
+                else:
+                    value = fn(*args)
             except BaseException as exc:  # graftlint: boundary(staging worker forwards every failure to the committing waiter verbatim)
                 self.errors += 1
                 handle._reject(exc)

@@ -628,6 +628,13 @@ class StreamingMerge:
         self.recorder = None
         #: MergeStats of the most recent committed round batch
         self.last_round_stats: Optional[MergeStats] = None
+        #: per-drain span-duration accumulator for the serve tier's
+        #: latency plane: reset by drain(), filled by _emit_round_stats
+        #: with the drain's schedule/apply span sums.  Durations only —
+        #: this module never reads a wall clock (PTL006 merge scope);
+        #: the serve mux pairs these with ITS watermarks to split the
+        #: drain wall into dispatch vs commit stages.
+        self.last_drain_marks: Optional[Dict[str, float]] = None
         # cumulative padded-stream accounting behind health()'s
         # padding-efficiency readout
         self._pad_real_ops = 0
@@ -1197,6 +1204,12 @@ class StreamingMerge:
             extras={"rounds": len(batch), "scheduled_changes": scheduled},
         )
         self.last_round_stats = stats
+        if self.last_drain_marks is not None:
+            # span-derived stage durations for the serve tier's latency
+            # plane (clock-free: spans always measure)
+            self.last_drain_marks["schedule_seconds"] += schedule_s
+            self.last_drain_marks["apply_seconds"] += apply_s
+            self.last_drain_marks["rounds"] += len(batch)
         self._pad_real_ops += real
         self._pad_capacity += capacity
         GLOBAL_HISTOGRAMS.observe("streaming.round_seconds", schedule_s + apply_s)
@@ -2187,6 +2200,12 @@ class StreamingMerge:
         fused resolve+digest block program so the caller's next digest or
         sweep read is one readback.  Byte equality with the per-round
         ``step`` discipline is pinned by test on every path."""
+        # fresh per-drain accumulator: after the drain returns, the serve
+        # tier reads this drain's schedule/apply span sums (stage durations
+        # for the latency plane — durations, never clocks, in merge scope)
+        self.last_drain_marks = {
+            "schedule_seconds": 0.0, "apply_seconds": 0.0, "rounds": 0,
+        }
         if not self._fused_eligible():
             return self._drain_serial(max_rounds)
         rounds = 0
@@ -2242,11 +2261,17 @@ class StreamingMerge:
         return chained
 
     def _ensure_stager(self):
-        """The session's staging lane (lazy; respawned if closed)."""
+        """The session's staging lane (lazy; respawned if closed).  The
+        lane's jobs run under a ``staging.stage`` span so the stage wall
+        is measured on the worker thread (timing telemetry stays the
+        caller's, per the staging module's contract)."""
         from .staging import FrameStager
 
         if self._stager is None or self._stager._closed:
             self._stager = FrameStager()
+            self._stager.span_factory = (
+                lambda: self.tracer.span("staging.stage")
+            )
         return self._stager
 
     def _prefetch_digest(self) -> None:

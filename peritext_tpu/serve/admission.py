@@ -34,8 +34,9 @@ forever.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..obs import Counters, GLOBAL_COUNTERS
 
@@ -181,6 +182,12 @@ class AdmissionController:
         #: rolling drain-rate estimate (units applied per second) behind the
         #: delay hint; fed by :meth:`observe_drain`
         self._drain_rate: float = 0.0
+        #: per-session ring of recent verdicts — the incident context a
+        #: quarantine/rollback flight dump appends (see verdict_tail());
+        #: bounded per session AND in tracked sessions
+        self._tails: Dict[int, deque] = {}
+        self._tail_len = 32
+        self._tail_sessions = 1024
 
     # -- decision ------------------------------------------------------------
 
@@ -197,9 +204,11 @@ class AdmissionController:
             self.stats.submitted += 1
             depth = self._depth
             if depth + cost > self.max_depth:
-                return self._shed_locked(
+                v = self._shed_locked(
                     SHED_DEGRADED if degraded else SHED_QUEUE_FULL, depth
                 )
+                self._note_verdict_locked(session_id, v)
+                return v
             held = self._per_session.get(session_id, 0)
             if (
                 not degraded
@@ -210,7 +219,9 @@ class AdmissionController:
                 # mux converts SUSTAINED quota sheds into a fallback
                 # demotion (the degradation ladder), so this reason is a
                 # transition state, not a permanent write loss
-                return self._shed_locked(SHED_SESSION_QUOTA, depth)
+                v = self._shed_locked(SHED_SESSION_QUOTA, depth)
+                self._note_verdict_locked(session_id, v)
+                return v
             high = self.high_watermark * self.max_depth
             if depth + cost > high:
                 self._backpressure = True
@@ -223,14 +234,18 @@ class AdmissionController:
                     # sustained: the queue has not drained through a whole
                     # ladder of delays — escalate to a typed shed so the
                     # client knows this is overload, not a blip
-                    return self._shed_locked(SHED_OVERLOAD, depth)
+                    v = self._shed_locked(SHED_OVERLOAD, depth)
+                    self._note_verdict_locked(session_id, v)
+                    return v
                 self.stats.delayed += 1
                 self.counters.add("serve.delayed")
-                return Verdict(
+                v = Verdict(
                     kind=DELAY,
                     hint_seconds=self._delay_hint_locked(),
                     queue_depth=depth,
                 )
+                self._note_verdict_locked(session_id, v)
+                return v
             if not degraded:
                 # degraded-session admits bypass backpressure entirely, so
                 # they say nothing about whether delayed clients' work is
@@ -242,7 +257,9 @@ class AdmissionController:
             self._per_session[session_id] = held + cost
             self.stats.admitted += 1
             self.counters.add("serve.admitted")
-            return Verdict(kind=ADMIT, queue_depth=self._depth)
+            v = Verdict(kind=ADMIT, queue_depth=self._depth)
+            self._note_verdict_locked(session_id, v)
+            return v
 
     def shed_out_of_band(self, reason: str) -> Verdict:
         """Record a typed shed decided OUTSIDE the queue logic (unknown
@@ -276,6 +293,27 @@ class AdmissionController:
         self.counters.add("serve.shed")
         self.counters.add(f"serve.shed.{reason}")
         return Verdict(kind=SHED, reason=reason, queue_depth=depth)
+
+    def _note_verdict_locked(self, session_id: int, verdict: Verdict) -> None:
+        """Ring one verdict into the session's tail (post-mortem context;
+        see :meth:`verdict_tail`).  The submission index doubles as the
+        tail entry's sequence number."""
+        tail = self._tails.get(session_id)
+        if tail is None:
+            if len(self._tails) >= self._tail_sessions:
+                # evict the oldest-tracked session wholesale: tails exist
+                # for post-mortems on ACTIVE docs, not as a history of
+                # every session id ever offered
+                self._tails.pop(next(iter(self._tails)))
+            tail = self._tails[session_id] = deque(maxlen=self._tail_len)
+        tail.append({"seq": self.stats.submitted, **verdict.to_json()})
+
+    def verdict_tail(self, session_id: int) -> List[Dict]:
+        """The session's recent verdicts, oldest first — what a
+        quarantine/rollback flight dump appends as incident context (the
+        backpressure picture around the fault)."""
+        with self._lock:
+            return list(self._tails.get(session_id, ()))
 
     def _delay_hint_locked(self) -> float:
         """How long until a retry is likely to admit: the units above the

@@ -40,6 +40,7 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..obs import Counters, GLOBAL_COUNTERS
+from ..obs.latency import CLOSE_BACKPRESSURE, CLOSE_FLUSH, CLOSE_WINDOW
 from ..parallel.streaming import StreamingMerge
 from ..plan.fusion import FusionGroup, LanePlan, TenantSpec
 from .admission import AdmissionController, Verdict
@@ -211,6 +212,18 @@ class FusedMuxGroup:
             return 0
         if not (force or self.window_expired()):
             return 0
+        # the SHARED close cause (one window, one cause for every rider):
+        # a forced flush, else any member's backpressure, else the window
+        # elapsing — read before the drains release backpressure.  Only
+        # consulted when some member's latency plane is armed.
+        armed = any(self.muxes[n].latency_plane.enabled for n in self._order)
+        cause = CLOSE_WINDOW
+        if armed:
+            if force:
+                cause = CLOSE_FLUSH
+            elif any(m.admission.backpressure
+                     for m in self.muxes.values() if m._buffer):
+                cause = CLOSE_BACKPRESSURE
         per_lane: Dict[int, List[Tuple[str, list]]] = {}
         for name in self._order:
             m = self.muxes[name]
@@ -229,13 +242,22 @@ class FusedMuxGroup:
             try:
                 for name, batch in entries:
                     self.muxes[name]._ingest_batch(batch)
+                t_staged = self.clock() if armed else None
                 sess.drain()
             finally:
                 sess.fusion_rows = None
             t1 = self.clock()
             wall = max(0.0, t1 - t0)
             for name, batch in entries:
-                self.muxes[name]._settle_batch(batch, wall, t1)
+                # each rider's stage watermarks are the LANE's: the close
+                # is the lane round's open, staging/commit are shared —
+                # a rider pays the fused window it rode, exactly like the
+                # settle wall
+                self.muxes[name]._settle_batch(
+                    batch, wall, t1,
+                    close=t0 if armed else None,
+                    staged=t_staged, cause=cause,
+                )
                 applied += len(batch)
             self._docs_dispatched += sum(
                 self.group.slots[name].docs for name in active

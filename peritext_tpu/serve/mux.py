@@ -45,6 +45,12 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..core.types import Change, Patch
 from ..obs import Counters, GLOBAL_COUNTERS, GLOBAL_HISTOGRAMS, Histogram
+from ..obs.latency import (
+    CLOSE_BACKPRESSURE,
+    CLOSE_FLUSH,
+    CLOSE_WINDOW,
+    GLOBAL_LATENCY,
+)
 from ..parallel.codec import encode_frame
 from ..parallel.streaming import REASON_CAPACITY, StreamingMerge
 from .admission import (
@@ -210,8 +216,11 @@ class SessionMux:
         self._next_session = 0
         self._next_doc = 0
         #: the open round's buffered admitted frames:
-        #: (session_id, doc, frame_bytes, enqueue_clock)
-        self._buffer: List[Tuple[int, int, bytes, float]] = []
+        #: (session_id, doc, frame_bytes, enqueue_clock, submit_clock) —
+        #: submit_clock is read at submit() ENTRY (pre-verdict) so the
+        #: latency plane can price the admission stage; with the plane
+        #: disarmed it equals enqueue_clock (no extra clock read)
+        self._buffer: List[Tuple[int, int, bytes, float, float]] = []
         self._window_opened: Optional[float] = None
         self.rounds = 0
         self.applied = 0
@@ -220,6 +229,10 @@ class SessionMux:
         #: round) are appended here — the traffic generator's per-rung
         #: percentile source (the histograms keep the fleet-wide view)
         self.latency_sink: Optional[List[float]] = None
+        #: the stage-watermark latency plane this mux feeds (default: the
+        #: process-wide one, off until ``GLOBAL_LATENCY.enable()``); bench
+        #: arms swap in a private plane so their decompositions don't mix
+        self.latency_plane = GLOBAL_LATENCY
         #: when this mux rides a fused group, the group's
         #: ``fusion_snapshot`` callable — snapshot()'s ``fusion`` key
         #: reports the shared window's stats instead of the standalone
@@ -231,6 +244,15 @@ class SessionMux:
         #: clean rounds stops reporting unhealthy (the ``obs serve``
         #: health check reads recency, not the process-lifetime counter)
         self._shed_mark = 0
+        # wire the flight-recorder incident-context hook: a quarantine/
+        # rollback fault dump on the backing session appends the affected
+        # doc's admission-verdict tail (the backpressure picture around
+        # the incident)
+        recorder = getattr(session, "recorder", None)
+        if recorder is not None and hasattr(recorder, "add_context_provider"):
+            recorder.add_context_provider(
+                "admission-verdicts", self._fault_context
+            )
 
     # -- session lifecycle ----------------------------------------------------
 
@@ -286,6 +308,9 @@ class SessionMux:
             sess.submitted += 1
             sess.shed += 1
             return self.admission.shed_out_of_band(SHED_UNAUTHORIZED)
+        # pre-verdict watermark for the latency plane's admit stage; the
+        # disarmed path reads no extra clock (overhead budget)
+        t_sub = self.clock() if self.latency_plane.enabled else None
         sess.submitted += 1
         verdict = self.admission.offer(
             session_id, cost=1, degraded=sess.degraded
@@ -303,7 +328,10 @@ class SessionMux:
             else:
                 if self._window_opened is None:
                     self._window_opened = now
-                self._buffer.append((session_id, sess.doc_index, frame, now))
+                self._buffer.append((
+                    session_id, sess.doc_index, frame, now,
+                    t_sub if t_sub is not None else now,
+                ))
         elif verdict.kind == SHED:
             sess.shed += 1
             if verdict.reason == SHED_SESSION_QUOTA:
@@ -357,7 +385,7 @@ class SessionMux:
         assert self._window_opened is not None
         return (self.clock() - self._window_opened) >= self.window_seconds()
 
-    def _take_batch(self) -> List[Tuple[int, int, bytes, float]]:
+    def _take_batch(self) -> List[Tuple[int, int, bytes, float, float]]:
         """Close the open round: detach the buffered frames and reset the
         window.  The round-pump's first third, split out so a fused group
         can take EVERY member's batch before any lane drains."""
@@ -365,29 +393,46 @@ class SessionMux:
         self._window_opened = None
         return batch
 
-    def _ingest_batch(self, batch: Sequence[Tuple[int, int, bytes, float]],
+    def close_cause(self, force: bool) -> str:
+        """Why the open round is closing — the typed vocabulary the
+        latency plane's force-close counters report.  Read BEFORE the
+        drain (the drain itself releases backpressure)."""
+        if force:
+            return CLOSE_FLUSH
+        if self.admission.backpressure:
+            return CLOSE_BACKPRESSURE
+        return CLOSE_WINDOW
+
+    def _ingest_batch(self, batch: Sequence[Tuple[int, int, bytes, float, float]],
                       ) -> None:
         """Bulk-ingest a taken batch into the backing session (corrupt
         frames quarantine their doc — per-doc fault isolation, never an
         exception out of the serving loop).  No drain: the caller owns
         when the device program runs."""
         self.session.ingest_frames(
-            [(doc, frame) for _, doc, frame, _ in batch],
+            [(doc, frame) for _, doc, frame, _, _ in batch],
             on_corrupt="quarantine",
         )
 
-    def _settle_batch(self, batch: Sequence[Tuple[int, int, bytes, float]],
-                      wall: float, now: float) -> None:
+    def _settle_batch(self, batch: Sequence[Tuple[int, int, bytes, float, float]],
+                      wall: float, now: float,
+                      close: Optional[float] = None,
+                      staged: Optional[float] = None,
+                      cause: str = CLOSE_WINDOW) -> None:
         """Account a committed batch after its drain: release queue
         space, feed the window tuner + latency histograms, advance the
         round/apply tallies.  ``wall`` is the committed round's wall (on
         a fused group: the SHARED window's wall — every rider pays the
-        window it rode); ``now`` is the commit clock."""
+        window it rode); ``now`` is the commit clock.  ``close``/``staged``
+        are the latency plane's window-close and staged watermarks (passed
+        only while the plane is armed); the batch record anchors on the
+        FIRST buffered frame — the op that waited the whole window, the
+        worst case an SLO cares about."""
         self.rounds += 1
         self.applied += len(batch)
         self.tuner.observe(wall)
         self.admission.observe_drain(len(batch), wall)
-        for sid, _, _, enq in batch:
+        for sid, _, _, enq, _ in batch:
             self.admission.mark_applied(sid, 1)
             lat = max(0.0, now - enq)
             GLOBAL_HISTOGRAMS.observe("serve.apply_seconds", lat)
@@ -396,6 +441,18 @@ class SessionMux:
         GLOBAL_HISTOGRAMS.observe("serve.round_seconds", wall)
         self.counters.add("serve.rounds")
         self.counters.add("serve.applied_frames", len(batch))
+        plane = self.latency_plane
+        if (plane.enabled and batch
+                and close is not None and staged is not None):
+            _, _, _, enq0, sub0 = batch[0]
+            mesh = getattr(self.session, "mesh", None)
+            plane.observe_batch(
+                submit=sub0, admit=enq0, close=close, staged=staged,
+                commit=now,
+                marks=getattr(self.session, "last_drain_marks", None),
+                cause=cause, batch=len(batch),
+                shards=int(getattr(mesh, "size", 1) or 1),
+            )
         if not self.admission.backpressure:
             # the tier is keeping up again: sheds before this round are
             # history, not current health
@@ -411,12 +468,17 @@ class SessionMux:
         shared lane drain.)"""
         if not self._buffer or not (force or self.window_expired()):
             return 0
+        armed = self.latency_plane.enabled
+        cause = self.close_cause(force) if armed else CLOSE_WINDOW
         batch = self._take_batch()
         t0 = self.clock()
         self._ingest_batch(batch)
+        t_staged = self.clock() if armed else None
         self.session.drain()
         t1 = self.clock()
-        self._settle_batch(batch, max(0.0, t1 - t0), t1)
+        self._settle_batch(batch, max(0.0, t1 - t0), t1,
+                           close=t0 if armed else None,
+                           staged=t_staged, cause=cause)
         return len(batch)
 
     def flush(self) -> int:
@@ -438,24 +500,55 @@ class SessionMux:
         this client has PROVEN the pump→read pattern, so from the next
         pump on, every drain pre-dispatches the fused resolve+digest and
         the window's host work hides the round's resolution compute (a
-        mux nobody reads from never pays the per-drain resolve)."""
+        mux nobody reads from never pays the per-drain resolve).
+
+        This is also the latency plane's VISIBILITY watermark: the first
+        read after a commit is the moment a client could actually observe
+        the committed round, so it finalizes every pending stage record."""
         sess = self._require(session_id)
         self.session.prefetch_digest = True
-        return self.session.read_patches(sess.doc_index)
+        out = self.session.read_patches(sess.doc_index)
+        if self.latency_plane.enabled:
+            self.latency_plane.mark_visible(self.clock())
+        return out
 
     def read(self, session_id: int):
         """The session doc's resolved ``FormatSpan`` list.  Arms the fused
         digest prefetch like :meth:`patches` (the pump→read pattern is
-        proven)."""
+        proven) and marks the latency plane's visibility watermark the
+        same way."""
         sess = self._require(session_id)
         self.session.prefetch_digest = True
-        return self.session.read(sess.doc_index)
+        out = self.session.read(sess.doc_index)
+        if self.latency_plane.enabled:
+            self.latency_plane.mark_visible(self.clock())
+        return out
 
     def _require(self, session_id: int) -> ClientSession:
         sess = self._sessions.get(session_id)
         if sess is None:
             raise KeyError(f"unknown serve session {session_id}")
         return sess
+
+    def _fault_context(self, fields: Dict) -> Optional[List[Dict]]:
+        """Flight-recorder context provider: a quarantine/rollback fault
+        names its ``doc``; answer with the owning session(s)' recent
+        admission-verdict tail so the dump shows the backpressure picture
+        around the incident."""
+        doc = fields.get("doc")
+        if doc is None:
+            return None
+        out: List[Dict] = []
+        for sid, sess in self._sessions.items():
+            if sess.doc_index != doc:
+                continue
+            for rec in self.admission.verdict_tail(sid):
+                # the verdict's own ``kind`` rides as ``verdict``: the
+                # recorder's context envelope owns the ``kind`` key
+                body = {k: v for k, v in rec.items() if k != "kind"}
+                out.append({"doc": doc, "session": sid,
+                            "verdict": rec.get("kind"), **body})
+        return out or None
 
     # -- health ---------------------------------------------------------------
 

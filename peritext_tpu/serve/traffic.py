@@ -79,6 +79,11 @@ class OpenLoopResult:
     p99_apply_s: float = 0.0
     max_apply_s: float = 0.0
     wall_seconds: float = 0.0
+    #: the latency plane's per-stage decomposition for this rung (present
+    #: when the mux's plane was armed): the server-side stage means that
+    #: sit NEXT TO the client-observed percentiles above, so one rung's
+    #: JSON carries both sides of the sum-consistency story
+    latency: Optional[Dict] = None
 
     @property
     def clean(self) -> bool:
@@ -107,6 +112,7 @@ class OpenLoopResult:
             "p99_apply_ms": round(self.p99_apply_s * 1e3, 3),
             "max_apply_ms": round(self.max_apply_s * 1e3, 3),
             "wall_seconds": round(self.wall_seconds, 3),
+            **({"latency": self.latency} if self.latency is not None else {}),
         }
 
 
@@ -124,6 +130,7 @@ def run_open_loop(
     sleep: Callable[[float], None] = time.sleep,
     drain: bool = True,
     deadline_s: Optional[float] = None,
+    read_every: int = 0,
 ) -> OpenLoopResult:
     """Offer ``arrivals`` open-loop against ``mux`` (see module doc).
 
@@ -134,7 +141,11 @@ def run_open_loop(
     admitted frame's latency is measured.  ``deadline_s`` hard-bounds the
     wall clock (a saturated rung must not run away); past it, remaining
     arrivals still submit back-to-back (their verdicts ARE the evidence)
-    but no further sleeping happens."""
+    but no further sleeping happens.  ``read_every=N`` (0 = never, the
+    historical behavior) reads the first session's patch stream after
+    every Nth committed pump — the pump→read pattern that marks the
+    latency plane's VISIBILITY watermark, so an armed plane's
+    time-to-visibility histogram fills during the rung."""
     sched = list(arrivals)
     duration = sched[-1][0] if sched else 0.0
     latencies: List[float] = []
@@ -144,6 +155,8 @@ def run_open_loop(
         rate_per_s=(len(sched) / duration if duration else 0.0),
         duration_s=duration,
     )
+    read_sid = sched[0][1] if sched else None
+    pumps = 0
     start = clock()
     try:
         i = 0
@@ -164,7 +177,10 @@ def run_open_loop(
                         res.shed_reasons.get(verdict.reason, 0) + 1
                     )
                 i += 1
-            mux.pump()
+            if mux.pump() and read_every > 0 and read_sid is not None:
+                pumps += 1
+                if pumps % read_every == 0:
+                    mux.patches(read_sid)
             if i < len(sched) and not overtime:
                 nap = min(
                     max(0.0, sched[i][0] - (clock() - start)),
@@ -174,6 +190,10 @@ def run_open_loop(
                     sleep(nap)
         if drain:
             mux.flush()
+            if read_every > 0 and read_sid is not None:
+                # expose the tail flush too: the final commits' visibility
+                # must be measured, not left pending
+                mux.patches(read_sid)
     finally:
         mux.latency_sink = prev_sink
     res.wall_seconds = clock() - start
@@ -186,6 +206,9 @@ def run_open_loop(
     res.p95_apply_s = _pct(latencies, 0.95)
     res.p99_apply_s = _pct(latencies, 0.99)
     res.max_apply_s = latencies[-1] if latencies else 0.0
+    plane = getattr(mux, "latency_plane", None)
+    if plane is not None and plane.enabled and plane.records:
+        res.latency = plane.decomposition()
     return res
 
 

@@ -829,6 +829,13 @@ class ServeChaosReport:
     serve_digest_matches_reference: bool = False
     repaired_digest_matches_clean: bool = False
     final_digest: int = 0
+    #: latency-plane evidence: sampled stage records during the episode,
+    #: every one sum-consistent (nonnegative stages telescoping to the
+    #: commit total) with typed close causes — the plane's oracle rides
+    #: the SAME chaos episode the verdict oracles do
+    latency_records: int = 0
+    latency_sum_consistent: bool = False
+    latency_force_close: Dict[str, int] = None
 
     def to_json(self) -> Dict:
         return asdict(self)
@@ -912,6 +919,12 @@ def run_serve_chaos(
         ),
         host=names[0],
     )
+    # arm a PRIVATE latency plane: the chaos episode doubles as the
+    # plane's adversarial oracle (every sampled record must stay
+    # sum-consistent under overload + partition), without touching the
+    # process-global plane other tests may read
+    from ..obs.latency import CLOSE_CAUSES, LatencyPlane, check_sum_consistency
+    mux.latency_plane = LatencyPlane().enable()
     sids = []
     for d in range(num_docs):
         sid, verdict = mux.open_session(f"client{d}")
@@ -981,6 +994,30 @@ def run_serve_chaos(
         assert mux.applied > 0, (
             f"seed={seed}: the mux applied nothing mid-partition (wedged)"
         )
+        # latency-plane oracle: the overload episode must have sampled
+        # stage records, the latest one telescoping cleanly, every close
+        # cause drawn from the typed vocabulary — and a read marks the
+        # pending records visible so time-to-visibility fills too
+        mux.patches(sids[0])
+        plane = mux.latency_plane
+        assert plane.records > 0, (
+            f"seed={seed}: armed latency plane sampled no drain batches"
+        )
+        assert plane.last is not None and check_sum_consistency(plane.last), (
+            f"seed={seed}: latency record not sum-consistent under "
+            f"overload: {plane.last}"
+        )
+        assert set(plane.force_close) <= set(CLOSE_CAUSES), (
+            f"seed={seed}: untyped close cause {plane.force_close}"
+        )
+        assert plane.snapshot()["pending_visibility"] == 0, (
+            f"seed={seed}: patch read left records pending visibility"
+        )
+        report.latency_records = plane.records
+        report.latency_sum_consistent = True
+        report.latency_force_close = {
+            c: n for c, n in sorted(plane.force_close.items()) if n
+        }
         # partition truth: host0 really was behind its peers
         from ..obs.convergence import clock_delta_ops
 

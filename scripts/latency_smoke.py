@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""latency smoke: the time-to-visibility plane end to end on CPU.
+
+The CI contract (and ``make latency-smoke`` locally): drive a real serve
+session open-loop with the latency plane armed, assert the plane sampled
+sum-consistent stage records and marked visibility, write the artifacts
+(``latency.json``, ``latency.prom``, ``why-ledger.jsonl``, ``why.json``)
+for upload, check the ``obs why`` exit contract (0 clean / 1 regressed /
+2 unreadable), and pin the arming overhead: the armed arm's best-of-N
+wall must stay within the devprof-grade budget of the disabled arm's.
+Exit nonzero on any violation — an observability regression fails CI
+like a correctness one.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+#: arming overhead budget: relative bound plus a small absolute floor so
+#: a sub-millisecond smoke row can't fail on scheduler noise alone
+OVERHEAD_FRAC = 0.02
+OVERHEAD_FLOOR_S = 0.010
+
+
+def fail(msg: str) -> int:
+    print(f"latency-smoke FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--docs", type=int, default=4)
+    parser.add_argument("--ops-per-doc", type=int, default=40)
+    parser.add_argument("--seed", type=int, default=23)
+    parser.add_argument("--repeats", type=int, default=5,
+                        help="best-of-N walls for the overhead pin")
+    parser.add_argument("--out", default="latency-artifacts")
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from peritext_tpu.obs import prometheus_text
+    from peritext_tpu.obs.__main__ import main as obs_main
+    from peritext_tpu.obs.latency import (
+        LatencyPlane, STAGES, check_sum_consistency,
+    )
+    from peritext_tpu.parallel.codec import encode_frame
+    from peritext_tpu.parallel.streaming import StreamingMerge
+    from peritext_tpu.serve import SessionMux
+    from peritext_tpu.testing.fuzz import generate_workload
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    d, opd = args.docs, args.ops_per_doc
+
+    plans = []
+    for w in generate_workload(args.seed, num_docs=d, ops_per_doc=opd):
+        changes = [ch for log in w.values() for ch in log]
+        plans.append([encode_frame(changes[i:i + 6])
+                      for i in range(0, len(changes), 6)])
+
+    def build_mux():
+        session = StreamingMerge(
+            num_docs=d, actors=("doc1", "doc2", "doc3"),
+            slot_capacity=max(256, 4 * opd), mark_capacity=max(64, opd),
+            tomb_capacity=max(128, opd),
+            round_insert_capacity=128, round_delete_capacity=64,
+            round_mark_capacity=64, static_rounds=True,
+        )
+        mux = SessionMux(session, host="latency-smoke")
+        sids = []
+        for doc in range(d):
+            sid, verdict = mux.open_session(f"client{doc}")
+            assert verdict.admitted
+            sids.append(sid)
+        return mux, sids
+
+    def drive(mux, sids, read=True):
+        t0 = time.perf_counter()
+        for k in range(max(len(p) for p in plans)):
+            for doc, plan in enumerate(plans):
+                if k < len(plan):
+                    mux.submit(sids[doc], plan[k])
+            mux.flush()
+            if read:
+                mux.patches(sids[0])
+        return time.perf_counter() - t0
+
+    # -- the traced serve session -------------------------------------------
+    mux, sids = build_mux()
+    plane = LatencyPlane().enable()
+    mux.latency_plane = plane
+    drive(mux, sids)
+
+    snap = plane.snapshot()
+    (out / "latency.json").write_text(json.dumps(snap, indent=2))
+    prom = prometheus_text(latency=plane)
+    (out / "latency.prom").write_text(prom)
+
+    if snap["records"] == 0:
+        return fail("armed plane sampled no drain batches")
+    if snap["pending_visibility"] != 0:
+        return fail(f"{snap['pending_visibility']} records never marked "
+                    "visible despite per-window reads")
+    if snap["last"] is None or not check_sum_consistency(snap["last"]):
+        return fail(f"last record not sum-consistent: {snap['last']}")
+    for stage in STAGES:
+        if snap["stages"][stage]["count"] == 0:
+            return fail(f"stage {stage!r} histogram is empty")
+        if f"peritext_latency_{stage}_seconds_count" not in prom:
+            return fail(f"peritext_latency_{stage}_seconds family missing "
+                        "from the exposition")
+    dec = plane.decomposition()
+    if not dec["sum_consistent"]:
+        return fail(f"decomposition inconsistent: {dec}")
+
+    # -- obs why exit contract ----------------------------------------------
+    def ledger_rec(sha, value, stages_ms):
+        return {
+            "sha": sha, "config": "latency-smoke",
+            "device": {"platform": "cpu", "kind": "smoke"},
+            "rows": [{"row": "serve_sustained", "unit": "docs/s",
+                      "value": value,
+                      "latency": {"stages_ms": stages_ms,
+                                  "total_ms": dec["total_ms"]}}],
+        }
+
+    base = dict(dec["stages_ms"])
+    refs = [ledger_rec(f"ref{i}", 100.0, base) for i in range(5)]
+    clean_path = out / "why-ledger-clean.jsonl"
+    clean_path.write_text("".join(
+        json.dumps(r) + "\n" for r in refs + [ledger_rec("cand", 99.0, base)]
+    ))
+    regressed = dict(base)
+    regressed["window"] = (regressed.get("window") or 0.0) + 50.0
+    why_path = out / "why-ledger.jsonl"
+    why_path.write_text("".join(
+        json.dumps(r) + "\n"
+        for r in refs + [ledger_rec("cand", 40.0, regressed)]
+    ))
+
+    rc_clean = obs_main(["why", str(clean_path), "--tolerance", "10"])
+    if rc_clean != 0:
+        return fail(f"obs why exit {rc_clean} on a clean ledger (want 0)")
+    rc_bad = obs_main(["why", str(why_path), "--tolerance", "10", "--json"])
+    if rc_bad != 1:
+        return fail(f"obs why exit {rc_bad} on a regressed ledger (want 1)")
+    rc_unreadable = obs_main(["why", str(out / "missing.jsonl")])
+    if rc_unreadable != 2:
+        return fail(f"obs why exit {rc_unreadable} on unreadable input "
+                    "(want 2)")
+    from peritext_tpu.obs.latency import attribute
+    report = attribute(
+        [json.loads(l) for l in why_path.read_text().splitlines()],
+        tolerance=0.1,
+    )
+    (out / "why.json").write_text(json.dumps(report, indent=2))
+    if report["verdict"] != "regression-attributed" \
+            or report["dominant_stage"] != "window":
+        return fail(f"attribution named {report.get('dominant_stage')!r} "
+                    "for a synthetic window regression")
+
+    # -- arming overhead pin (best-of-N, identical replay) -------------------
+    def best_wall(armed):
+        best = float("inf")
+        for _ in range(max(1, args.repeats)):
+            m, s = build_mux()
+            if armed:
+                m.latency_plane = LatencyPlane().enable()
+            best = min(best, drive(m, s))
+        return best
+
+    best_wall(False)  # one throwaway pass: every XLA variant compiles warm
+    off = best_wall(False)
+    on = best_wall(True)
+    overhead = (on - off) / off if off else 0.0
+    budget = off * OVERHEAD_FRAC + OVERHEAD_FLOOR_S
+    print(f"latency-smoke: overhead best-of-{args.repeats}: "
+          f"off={off * 1e3:.2f}ms on={on * 1e3:.2f}ms "
+          f"({overhead * 100:+.2f}%, budget {OVERHEAD_FRAC * 100:.0f}% "
+          f"+ {OVERHEAD_FLOOR_S * 1e3:.0f}ms floor)")
+    if on - off > budget:
+        return fail(f"arming the plane cost {(on - off) * 1e3:.2f}ms over "
+                    f"the {budget * 1e3:.2f}ms budget")
+
+    print(f"latency-smoke OK: {snap['records']} records, "
+          f"force_close={ {k: v for k, v in snap['force_close'].items() if v} }, "
+          f"slo_burn={snap['slo']['burn_rate']}, artifacts in {out}/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,549 @@
+"""Time-to-visibility latency plane tests (round 20): stage-watermark
+records (telescoping sum consistency, sampling decimation, visibility
+finalization), the exporter golden shapes (``/latency.json``,
+``peritext_latency_*``, ``health_snapshot(latency=)``), the serve-tier
+integration across the padded/paged/ragged layouts, the zero-compile pin
+when arming the plane, and the ``obs why`` attribution engine's
+deterministic dominant-stage naming + CLI exit contract."""
+
+import json
+import urllib.request
+
+import pytest
+
+from peritext_tpu.obs import MetricsServer, health_snapshot, prometheus_text
+from peritext_tpu.obs.__main__ import main as obs_main
+from peritext_tpu.obs.latency import (
+    CLOSE_BACKPRESSURE,
+    CLOSE_CAUSES,
+    CLOSE_FLUSH,
+    CLOSE_WINDOW,
+    LatencyPlane,
+    SERVER_STAGES,
+    STAGES,
+    attribute,
+    check_sum_consistency,
+)
+from peritext_tpu.parallel.codec import encode_frame
+from peritext_tpu.parallel.streaming import StreamingMerge
+from peritext_tpu.serve import SessionMux, build_arrivals, run_open_loop
+from peritext_tpu.testing.fuzz import generate_workload
+
+ACTORS = ("doc1", "doc2", "doc3")
+
+#: the pinned ``/latency.json`` body shape (snapshot() keys)
+GOLDEN_LATENCY_KEYS = {
+    "enabled", "sample_every", "windows", "records", "pending_visibility",
+    "never_read", "shards", "force_close", "stages", "total",
+    "time_to_visibility", "slo", "last",
+}
+
+#: the pinned bench-row decomposition shape (decomposition() keys)
+GOLDEN_DECOMPOSITION_KEYS = {
+    "stages_ms", "total_ms", "time_to_visibility_ms", "records",
+    "never_read", "shards", "force_close", "slo_burn_rate",
+    "sum_consistent",
+}
+
+
+def serve_session(num_docs=2, ops_per_doc=30, layout="padded", **kw):
+    # static_rounds is the PADDED serving shape discipline; the paged and
+    # ragged layouts run adaptive rounds (and reject static_rounds).
+    # Resident shapes mirror the variants the rest of tier-1 already
+    # compiles (test_serve's padded mux sessions, test_store/test_ragged's
+    # paged/ragged _build sessions) so this module pre-warms the shared
+    # XLA cache instead of minting cold per-file program variants.
+    if layout == "padded":
+        kw.setdefault("static_rounds", True)
+        return StreamingMerge(
+            num_docs=num_docs, actors=ACTORS, layout=layout,
+            slot_capacity=max(256, 4 * ops_per_doc),
+            mark_capacity=max(64, ops_per_doc),
+            tomb_capacity=max(128, ops_per_doc),
+            round_insert_capacity=128, round_delete_capacity=64,
+            round_mark_capacity=64, **kw,
+        )
+    return StreamingMerge(
+        num_docs=num_docs, actors=ACTORS, layout=layout,
+        slot_capacity=256, mark_capacity=64, tomb_capacity=64, **kw,
+    )
+
+
+def doc_frames(seed=31, num_docs=2, ops_per_doc=30, chunk=6):
+    plans = []
+    for w in generate_workload(seed, num_docs=num_docs,
+                               ops_per_doc=ops_per_doc):
+        changes = [ch for log in w.values() for ch in log]
+        plans.append([
+            encode_frame(changes[i:i + chunk])
+            for i in range(0, len(changes), chunk)
+        ])
+    return plans
+
+
+def observe(plane, *, submit=0.0, admit=0.001, close=0.003, staged=0.004,
+            commit=0.010, **kw):
+    return plane.observe_batch(submit=submit, admit=admit, close=close,
+                               staged=staged, commit=commit, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the plane itself
+# ---------------------------------------------------------------------------
+
+
+class TestLatencyPlane:
+    def test_off_by_default_and_arming(self):
+        plane = LatencyPlane()
+        assert not plane.enabled
+        assert plane.enable() is plane and plane.enabled
+        plane.disable()
+        assert not plane.enabled
+        with LatencyPlane() as armed:
+            assert armed.enabled
+        assert not armed.enabled
+
+    def test_record_telescopes_to_total(self):
+        plane = LatencyPlane().enable()
+        rec = observe(plane, marks={"apply_seconds": 0.004, "rounds": 2})
+        assert rec is not None
+        assert set(rec["stages"]) == set(SERVER_STAGES)
+        assert all(v >= 0 for v in rec["stages"].values())
+        # the telescoping identity: server stages sum EXACTLY to total
+        assert rec["total"] == pytest.approx(
+            sum(rec["stages"].values()), abs=1e-12
+        )
+        assert rec["total"] == pytest.approx(0.010, abs=1e-9)
+        assert check_sum_consistency(rec)
+        assert rec["rounds"] == 2
+
+    def test_commit_split_honours_span_bound(self):
+        # apply_seconds longer than the staged→commit span cannot drive
+        # dispatch negative: commit is clamped to the span
+        plane = LatencyPlane().enable()
+        rec = observe(plane, marks={"apply_seconds": 99.0})
+        assert rec["stages"]["dispatch"] == 0.0
+        assert rec["stages"]["commit"] == pytest.approx(
+            rec["total"] - rec["stages"]["admit"] - rec["stages"]["window"]
+            - rec["stages"]["stage"], abs=1e-12,
+        )
+        assert check_sum_consistency(rec)
+
+    def test_sampling_decimates_but_counts_windows(self):
+        plane = LatencyPlane(sample_every=4).enable()
+        sampled = [observe(plane) is not None for _ in range(8)]
+        assert sampled == [True, False, False, False,
+                           True, False, False, False]
+        snap = plane.snapshot()
+        assert snap["windows"] == 8 and snap["records"] == 2
+
+    def test_mark_visible_finalizes_pending(self):
+        plane = LatencyPlane().enable()
+        rec = observe(plane, commit=0.010)
+        assert rec["visible"] is None
+        n = plane.mark_visible(0.015)
+        assert n == 1
+        assert rec["stages"]["visibility"] == pytest.approx(0.005)
+        # visibility sits ON TOP of the commit total
+        assert rec["time_to_visibility"] == pytest.approx(
+            rec["total"] + 0.005
+        )
+        assert check_sum_consistency(rec)
+        # repeat reads between commits are free
+        assert plane.mark_visible(0.016) == 0
+
+    def test_unread_backlog_bounded(self):
+        plane = LatencyPlane(pending_cap=4).enable()
+        for _ in range(7):
+            observe(plane)
+        snap = plane.snapshot()
+        assert snap["pending_visibility"] == 4
+        assert snap["never_read"] == 3
+
+    def test_force_close_causes_typed(self):
+        plane = LatencyPlane().enable()
+        observe(plane, cause=CLOSE_WINDOW)
+        observe(plane, cause=CLOSE_BACKPRESSURE)
+        observe(plane, cause=CLOSE_FLUSH)
+        assert plane.force_close == {c: 1 for c in CLOSE_CAUSES}
+
+    def test_slo_burn_rate(self):
+        plane = LatencyPlane(slo_seconds=0.005, slo_target=0.9).enable()
+        observe(plane, commit=0.010)  # violates the 5ms SLO
+        observe(plane, commit=0.002)  # holds it
+        slo = plane.slo()
+        assert slo["violations"] == 1 and slo["window"] == 2
+        assert slo["burn_rate"] == pytest.approx(0.5 / 0.1, abs=1e-6)
+
+    def test_decomposition_golden_shape(self):
+        plane = LatencyPlane().enable()
+        observe(plane)
+        plane.mark_visible(0.012)
+        dec = plane.decomposition()
+        assert set(dec) == GOLDEN_DECOMPOSITION_KEYS
+        assert dec["sum_consistent"] is True
+        assert set(dec["stages_ms"]) == set(STAGES)
+        assert all(v >= 0 for v in dec["stages_ms"].values())
+
+    def test_check_sum_consistency_rejects(self):
+        bad = {"stages": {"admit": -0.001, "window": 0.0, "stage": 0.0,
+                          "dispatch": 0.0, "commit": 0.0}, "total": -0.001}
+        assert not check_sum_consistency(bad)
+        leaky = {"stages": {s: 0.001 for s in SERVER_STAGES}, "total": 0.5}
+        assert not check_sum_consistency(leaky)
+        # the client-wall bound: server stages (past admission) cannot
+        # exceed what the client observed
+        plane = LatencyPlane().enable()
+        rec = observe(plane)
+        client = rec["total"] - rec["stages"]["admit"]
+        assert check_sum_consistency(rec, client_wall=client + 0.001)
+        assert not check_sum_consistency(rec, client_wall=client / 2)
+
+
+# ---------------------------------------------------------------------------
+# exporter golden shapes
+# ---------------------------------------------------------------------------
+
+
+class TestLatencyExporters:
+    def make_plane(self):
+        plane = LatencyPlane().enable()
+        observe(plane, cause=CLOSE_FLUSH)
+        plane.mark_visible(0.013)
+        return plane
+
+    def test_latency_json_route_golden_shape(self):
+        plane = self.make_plane()
+        server = MetricsServer(latency=plane)
+        host, port = server.start()
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/latency.json", timeout=5
+            ).read())
+        finally:
+            server.stop()
+        assert set(body) == GOLDEN_LATENCY_KEYS
+        assert body["enabled"] is True
+        assert body["records"] == 1 and body["pending_visibility"] == 0
+        assert set(body["stages"]) == set(STAGES)
+        for entry in body["stages"].values():
+            assert {"count", "sum", "max", "p50", "p95", "p99",
+                    "overflow"} == set(entry)
+        assert set(body["force_close"]) == set(CLOSE_CAUSES)
+
+    def test_prometheus_latency_families(self):
+        text = prometheus_text(latency=self.make_plane())
+        for name in (
+            "peritext_latency_admit_seconds_count 1",
+            "peritext_latency_commit_seconds_count 1",
+            "peritext_latency_visibility_seconds_count 1",
+            "peritext_latency_total_seconds_count 1",
+            "peritext_latency_time_to_visibility_seconds_count 1",
+            "peritext_latency_admit_seconds_overflow 0",
+            "peritext_latency_enabled 1",
+            "peritext_latency_records 1",
+            "peritext_latency_pending_visibility 0",
+            "peritext_latency_slo_burn_rate",
+            'peritext_latency_force_close_total{cause="flush"} 1',
+        ):
+            assert name in text, f"missing {name!r}"
+        # exposition discipline: every sample line is `name value`
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                assert len(line.split()) == 2, line
+
+    def test_health_snapshot_latency_opt_in(self):
+        snap = health_snapshot(latency=self.make_plane())
+        assert set(snap["latency"]) == GOLDEN_LATENCY_KEYS
+        json.dumps(snap)  # one JSON document, end to end
+        assert "latency" not in health_snapshot()  # strictly opt-in
+
+
+# ---------------------------------------------------------------------------
+# serve-tier integration
+# ---------------------------------------------------------------------------
+
+
+class TestServeIntegration:
+    def drive(self, layout, read_every=2):
+        # num_docs=8 on the non-padded layouts: the doc axis is a compiled
+        # shape dimension, and D=8 is the rung test_store/test_ragged's
+        # _build sessions already pay the paged/ragged compiles for
+        num_docs = 2 if layout == "padded" else 8
+        plans = doc_frames(seed=37 + len(layout), num_docs=num_docs)
+        mux = SessionMux(serve_session(num_docs=num_docs, layout=layout),
+                         host="hL")
+        mux.latency_plane = LatencyPlane().enable()
+        frames = {}
+        for doc, plan in enumerate(plans):
+            sid, verdict = mux.open_session(f"c{doc}")
+            assert verdict.admitted
+            frames[sid] = plan
+        res = run_open_loop(
+            mux, build_arrivals(frames, 400.0, 0.05),
+            deadline_s=10.0, read_every=read_every,
+        )
+        return mux, res
+
+    @pytest.mark.parametrize("layout", ["padded", "paged", "ragged"])
+    def test_sum_consistency_across_layouts(self, layout):
+        mux, res = self.drive(layout)
+        plane = mux.latency_plane
+        assert plane.records > 0, "armed plane sampled nothing"
+        rec = plane.last
+        assert all(v >= 0 for v in rec["stages"].values())
+        # stage sum ≤ the client-observed wall: the server's decomposition
+        # cannot claim more time than the slowest admitted frame saw
+        assert check_sum_consistency(rec, client_wall=res.max_apply_s)
+        assert res.latency is not None
+        assert res.latency["sum_consistent"] is True
+        assert "latency" in res.to_json()
+
+    def test_visibility_marked_by_reads(self):
+        mux, _ = self.drive("padded")
+        snap = mux.latency_plane.snapshot()
+        # the tail flush's read finalized everything pending
+        assert snap["pending_visibility"] == 0
+        assert snap["time_to_visibility"]["count"] > 0
+        last = snap["last"]
+        assert last["time_to_visibility"] >= last["total"]
+
+    def test_disabled_plane_records_nothing(self):
+        plans = doc_frames()
+        mux = SessionMux(serve_session(), host="h0")
+        sid, _ = mux.open_session("c0")
+        for f in plans[0][:4]:
+            mux.submit(sid, f)
+        mux.flush()
+        mux.patches(sid)
+        from peritext_tpu.obs.latency import GLOBAL_LATENCY
+        assert mux.latency_plane is GLOBAL_LATENCY
+        assert not mux.latency_plane.enabled
+
+    def test_arming_plane_compiles_nothing(self):
+        """The devprof-grade overhead pin: arming the plane on a repeat
+        workload must mint ZERO new XLA programs — watermarks are host
+        clock reads, never traced values."""
+        from peritext_tpu.obs import RecompileSentinel
+
+        plans = doc_frames(seed=41)
+
+        def drive(armed):
+            mux = SessionMux(serve_session(), host="hS")
+            if armed:
+                mux.latency_plane = LatencyPlane().enable()
+            sids = []
+            for doc, _ in enumerate(plans):
+                sid, _ = mux.open_session(f"c{doc}")
+                sids.append(sid)
+            for k in range(4):
+                for doc, plan in enumerate(plans):
+                    mux.submit(sids[doc], plan[k % len(plan)])
+                mux.flush()
+            return [mux.patches(s) for s in sids]
+
+        cold = drive(armed=False)
+        with RecompileSentinel() as sentinel:
+            sentinel.mark()
+            warm = drive(armed=True)
+            sentinel.assert_steady_state("arming the latency plane")
+        assert warm == cold
+
+    def test_admission_verdict_tail_and_fault_context(self, tmp_path):
+        """Satellite: quarantine/rollback dumps carry the affected doc's
+        admission-verdict tail via the recorder's context providers."""
+        from peritext_tpu.obs import FlightRecorder
+
+        plans = doc_frames()
+        mux = SessionMux(serve_session(), host="hF")
+        sid, _ = mux.open_session("c0")
+        for f in plans[0][:3]:
+            mux.submit(sid, f)
+        mux.flush()
+        tail = mux.admission.verdict_tail(sid)
+        assert len(tail) == 3
+        assert all(t["kind"] == "admit" and "seq" in t for t in tail)
+        ctx = mux._fault_context({"doc": 0})
+        assert ctx and all(c["session"] == sid for c in ctx)
+        assert all(c["verdict"] == "admit" for c in ctx)
+
+        rec = FlightRecorder(capacity=16, dump_dir=tmp_path)
+        rec.add_context_provider(
+            "admission-verdicts", mux._fault_context,
+        )
+        rec.fault("quarantine", doc=0)
+        path = rec.last_dump_path
+        assert path is not None
+        lines = [json.loads(l) for l in
+                 path.read_text().splitlines() if l.strip()]
+        ctx_lines = [l for l in lines if l.get("kind") == "context"]
+        assert len(ctx_lines) == 3
+        assert all(l["provider"] == "admission-verdicts" for l in ctx_lines)
+        assert all(l["doc"] == 0 and l["verdict"] == "admit"
+                   for l in ctx_lines)
+
+
+# ---------------------------------------------------------------------------
+# attribution: obs why
+# ---------------------------------------------------------------------------
+
+
+def ledger_rec(sha, value, stages_ms, row="serve_sustained",
+               unit="docs/s", devprof=None):
+    lat = {"stages_ms": dict(stages_ms),
+           "total_ms": round(sum(v for s, v in stages_ms.items()
+                                 if s != "visibility"), 4)}
+    rec = {
+        "sha": sha, "config": "c1",
+        "device": {"platform": "cpu", "kind": "cpu0"},
+        "rows": [{"row": row, "unit": unit, "value": value, "latency": lat}],
+    }
+    if devprof is not None:
+        rec["devprof"] = devprof
+    return rec
+
+
+BASE_STAGES = {"admit": 0.1, "window": 2.0, "stage": 0.2,
+               "dispatch": 0.5, "commit": 1.0, "visibility": 0.3}
+
+
+class TestAttribution:
+    def regressed_ledger(self, moved="window", by=7.0):
+        records = [ledger_rec(f"r{i}", 100.0, BASE_STAGES)
+                   for i in range(5)]
+        stages = dict(BASE_STAGES)
+        stages[moved] += by
+        records.append(ledger_rec("bad", 50.0, stages))
+        return records
+
+    def test_names_dominant_stage_deterministically(self):
+        out = attribute(self.regressed_ledger(), tolerance=0.1)
+        assert out["verdict"] == "regression-attributed"
+        assert out["dominant_stage"] == "window"
+        assert out["row"] == "serve_sustained"
+        assert out["delta"] == -50.0
+        assert out["stage_deltas_ms"]["window"] == pytest.approx(7.0)
+        # same inputs, same verdict — always
+        again = attribute(self.regressed_ledger(), tolerance=0.1)
+        assert again["dominant_stage"] == out["dominant_stage"]
+        assert again["stage_deltas_ms"] == out["stage_deltas_ms"]
+
+    def test_tie_breaks_to_earliest_stage(self):
+        records = [ledger_rec(f"r{i}", 100.0, BASE_STAGES)
+                   for i in range(5)]
+        stages = dict(BASE_STAGES)
+        stages["stage"] += 3.0
+        stages["commit"] += 3.0  # identical delta, later in the taxonomy
+        records.append(ledger_rec("bad", 50.0, stages))
+        out = attribute(records, tolerance=0.1)
+        assert out["dominant_stage"] == "stage"
+
+    def test_clean_gate_attributes_nothing(self):
+        records = [ledger_rec(f"r{i}", 100.0, BASE_STAGES)
+                   for i in range(6)]
+        out = attribute(records, tolerance=0.1)
+        assert out["verdict"] == "clean" and out["row"] is None
+
+    def test_regression_without_decomposition(self):
+        records = [ledger_rec(f"r{i}", 100.0, BASE_STAGES)
+                   for i in range(5)]
+        records.append({
+            "sha": "bad", "config": "c1",
+            "device": {"platform": "cpu", "kind": "cpu0"},
+            "rows": [{"row": "serve_sustained", "unit": "docs/s",
+                      "value": 50.0}],
+        })
+        out = attribute(records, tolerance=0.1)
+        assert out["verdict"] == "no-decomposition"
+        assert out["dominant_stage"] is None
+
+    def test_unmoved_stages_is_unattributed(self):
+        records = [ledger_rec(f"r{i}", 100.0, BASE_STAGES)
+                   for i in range(5)]
+        records.append(ledger_rec("bad", 50.0, BASE_STAGES))
+        out = attribute(records, tolerance=0.1)
+        assert out["verdict"] == "regression-unattributed"
+        assert out["dominant_stage"] is None
+
+    def test_devprof_shape_deltas_attached(self):
+        def dp(shapes, dispatches, waste):
+            return {"sites": {"apply": {"distinct_shapes": shapes,
+                                        "dispatches": dispatches}},
+                    "occupancy_totals": {"padding_waste": waste}}
+        records = [ledger_rec(f"r{i}", 100.0, BASE_STAGES,
+                              devprof=dp(3, 40, 0.1)) for i in range(5)]
+        stages = dict(BASE_STAGES)
+        stages["stage"] += 4.0
+        records.append(ledger_rec("bad", 50.0, stages,
+                                  devprof=dp(5, 70, 0.4)))
+        out = attribute(records, tolerance=0.1)
+        assert out["devprof"]["delta"] == {
+            "distinct_shapes": 2, "dispatches": 30,
+            "padding_waste": pytest.approx(0.3),
+        }
+
+    def test_explicit_row_selection(self):
+        out = attribute(self.regressed_ledger(), row="serve_sustained",
+                        tolerance=0.1)
+        assert out["row"] == "serve_sustained"
+        with pytest.raises(ValueError):
+            attribute(self.regressed_ledger(), row="nonexistent")
+
+
+class TestWhyCommand:
+    def write_ledger(self, tmp_path, records):
+        p = tmp_path / "ledger.jsonl"
+        p.write_text("".join(json.dumps(r) + "\n" for r in records))
+        return str(p)
+
+    def test_exit_contract(self, tmp_path, capsys):
+        bad = TestAttribution().regressed_ledger()
+        clean = [ledger_rec(f"r{i}", 100.0, BASE_STAGES) for i in range(6)]
+        assert obs_main(["why", self.write_ledger(tmp_path, bad),
+                         "--tolerance", "10"]) == 1
+        out = capsys.readouterr()
+        assert "dominant moved stage is 'window'" in out.err
+        assert obs_main(["why", self.write_ledger(tmp_path, clean),
+                         "--tolerance", "10"]) == 0
+        assert obs_main(["why", str(tmp_path / "missing.jsonl")]) == 2
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert obs_main(["why", str(empty)]) == 2
+
+    def test_json_body(self, tmp_path, capsys):
+        bad = TestAttribution().regressed_ledger()
+        rc = obs_main(["why", self.write_ledger(tmp_path, bad),
+                       "--tolerance", "10", "--json"])
+        body = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert body["verdict"] == "regression-attributed"
+        assert body["dominant_stage"] == "window"
+        assert body["candidate_stages_ms"]["window"] == pytest.approx(9.0)
+        assert body["reference_stages_ms"]["window"] == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# satellite: perf verdicts carry the signed delta
+# ---------------------------------------------------------------------------
+
+
+class TestPerfDelta:
+    def test_verdicts_include_reference_and_signed_delta(self):
+        from peritext_tpu.obs import ledger as _ledger
+
+        records = [ledger_rec(f"r{i}", 100.0, BASE_STAGES)
+                   for i in range(5)]
+        records.append(ledger_rec("bad", 60.0, BASE_STAGES))
+        report = _ledger.evaluate(records)
+        v = report["rows"][0]
+        assert v["ref"] == pytest.approx(100.0)
+        assert v["delta"] == pytest.approx(-40.0)
+
+    def test_perf_json_carries_delta(self, tmp_path, capsys):
+        records = [ledger_rec(f"r{i}", 100.0, BASE_STAGES)
+                   for i in range(3)]
+        p = tmp_path / "ledger.jsonl"
+        p.write_text("".join(json.dumps(r) + "\n" for r in records))
+        assert obs_main(["perf", str(p), "--json"]) == 0
+        body = json.loads(capsys.readouterr().out)
+        assert all("delta" in row and "ref" in row for row in body["rows"])

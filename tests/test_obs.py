@@ -299,7 +299,7 @@ class TestHealthSnapshotShape:
             "capacity", "size", "faults", "dumps", "last_dump",
         }
         assert set(snap["session"]["round_latency"]) == {
-            "count", "sum", "max", "p50", "p95", "p99",
+            "count", "sum", "max", "p50", "p95", "p99", "overflow",
         }
         # every histogram entry shares the percentile schema
         for entry in snap["histograms"].values():
