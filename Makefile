@@ -10,7 +10,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 	clean obs fleet perf-gate serve-smoke bench-serve paged-smoke bench-longdoc \
 	fused-smoke fleet-serve-smoke bench-fleet-serve bench-markheavy \
 	ragged-smoke plan-smoke bench-serve-fused mesh-smoke bench-mesh \
-	latency-smoke
+	latency-smoke incident-smoke
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -62,6 +62,15 @@ serve-smoke:
 # overhead pin (artifacts land in /tmp/pt-latency)
 latency-smoke:
 	$(CPU_ENV) $(PY) scripts/latency_smoke.py --out /tmp/pt-latency
+
+# fleet incident-plane smoke (mirrors the CI incident-smoke job): the
+# host-kill chaos episode must open EXACTLY a host-death incident and
+# resolve it post-heal with time-to-detection reported, the per-host
+# flight dumps merge into one cross-host timeline, the `obs incidents`
+# / `obs status` / `obs flight` exit contracts hold, and feeding the
+# plane compiles ZERO XLA programs (artifacts land in /tmp/pt-incident)
+incident-smoke:
+	$(CPU_ENV) $(PY) scripts/incident_smoke.py --out /tmp/pt-incident
 
 # sustained open-loop serving ladder: docs/s at the p99 apply-latency SLO
 bench-serve:

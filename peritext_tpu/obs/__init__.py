@@ -37,6 +37,15 @@ every streaming-perf PR is judged by.  Four cooperating pieces:
   ``python -m peritext_tpu.obs why`` attribution engine that names the
   dominant moved stage when the perf gate fails.  Off by default;
   ``GLOBAL_LATENCY.enable()`` arms the serve-tier hooks.
+* :mod:`.incidents` — the fleet incident plane: a deterministic,
+  round-counted :class:`IncidentMonitor` that folds every plane above into
+  typed incidents (host-death, divergence, quarantine-storm, shed-storm,
+  slo-burn, recompile-storm, migration-failure, perf-regression) with a
+  two-watermark open→ack→resolve lifecycle, (host, doc, trace)-window
+  causal correlation ordered by the ``latency.attribute`` tie-break, and a
+  frontier-sentinel summary so two frontends agree on the incident view;
+  plus :func:`merge_flight_dumps`, the cross-host black-box timeline
+  (``python -m peritext_tpu.obs incidents`` / ``status`` / ``flight``).
 * :mod:`.exporters` — Prometheus text exposition and JSON snapshot
   endpoints (:class:`MetricsServer`, mounted by ``ReplicaServer``:
   ``/metrics`` with ``peritext_convergence_*`` gauges, ``/health.json``,
@@ -66,6 +75,12 @@ from .histograms import (
     HistogramRegistry,
     LATENCY_BUCKETS_S,
     SIZE_BUCKETS,
+)
+from .incidents import (
+    Incident,
+    IncidentMonitor,
+    TAXONOMY,
+    merge_flight_dumps,
 )
 from .latency import (
     GLOBAL_LATENCY,
@@ -103,6 +118,8 @@ __all__ = [
     "GLOBAL_TRACER",
     "Histogram",
     "HistogramRegistry",
+    "Incident",
+    "IncidentMonitor",
     "LATENCY_BUCKETS_S",
     "LatencyPlane",
     "MergeStats",
@@ -112,6 +129,7 @@ __all__ = [
     "SIZE_BUCKETS",
     "STAGES",
     "Span",
+    "TAXONOMY",
     "TraceContext",
     "Tracer",
     "ambient_parent",
@@ -119,6 +137,7 @@ __all__ = [
     "check_sum_consistency",
     "current_span",
     "health_snapshot",
+    "merge_flight_dumps",
     "merge_traces",
     "note_jit_dispatch",
     "occupancy_key",

@@ -45,6 +45,26 @@ deltas, and deterministically naming the dominant moved stage (largest
 positive delta; ties break to the earliest stage in the taxonomy).
 ``--row`` targets a specific row instead of the first failing one.
 
+The ``incidents`` command reads ``/incidents.json`` scrapes (or
+``/health.json`` bodies carrying an ``incidents`` key) and renders the
+correlated incident table: typed kind, lifecycle status, scope
+(hosts/docs), open/resolve rounds, and each incident's root-cause
+candidate ordering — exiting 1 while any incident is open, so the
+command doubles as a fleet incident check.
+
+The ``status`` command is the one-look roll-up: given a live
+MetricsServer base URL (``http://host:port``) or a snapshot directory
+(``health.json`` / ``convergence.json`` / ``serve.json`` /
+``fleet.json`` / ``latency.json`` / ``incidents.json``), it renders one
+table over every plane present and exits with the COMPOSITE of the
+per-plane CLI contracts (the worst plane wins).
+
+The ``flight`` command reads a directory of flight-recorder dumps
+(``flight-<host>-<pid>-<n>-<reason>.jsonl``) and renders the merged
+cross-host black-box timeline (:func:`peritext_tpu.obs.incidents.
+merge_flight_dumps`): every record host-attributed from its dump's
+filename, ordered by timestamp, with the per-trace causal groupings.
+
 Usage::
 
     python -m peritext_tpu.obs summary trace.json [more.json ...]
@@ -55,13 +75,19 @@ Usage::
     python -m peritext_tpu.obs perf perf/reference_ledger.jsonl --gate
     python -m peritext_tpu.obs plan devprof.json --ledger perf/ledger.jsonl
     python -m peritext_tpu.obs why perf/ledger.jsonl --row serve_sustained
+    python -m peritext_tpu.obs incidents hostA-incidents.json hostB.json
+    python -m peritext_tpu.obs status http://127.0.0.1:9100
+    python -m peritext_tpu.obs status snapshot-dir/
+    python -m peritext_tpu.obs flight dump-dir/
 
 ``summary`` is the default command (``python -m peritext_tpu.obs t.json``
 works).  Exit codes: 0 ok (fleet: converged; serve: healthy; perf: no
-regression; why: clean; plan: statics within tolerance), 1 no spans
+regression; why: clean; plan: statics within tolerance; incidents: none
+open; status: every plane clean), 1 no spans
 found / fleet has lag or divergence / serve has overload or shedding /
 perf ``--gate`` regression / why regression (attributed or not) / plan
-proposal beats the current statics beyond tolerance, 2 unreadable input.
+proposal beats the current statics beyond tolerance / open incidents /
+any plane in the status roll-up unhealthy, 2 unreadable input.
 """
 
 from __future__ import annotations
@@ -241,6 +267,276 @@ def serve_rows(snapshots: Sequence[Dict]) -> List[Dict]:
     rows.sort(key=lambda r: (r["overloaded"] != "YES", -r["recent_sheds"],
                              r["host"]))
     return rows
+
+
+# -- incident view (/incidents.json scrapes) ---------------------------------
+
+
+def load_incidents(path: str | Path) -> Dict:
+    """One monitor's incident snapshot from an ``/incidents.json`` scrape
+    or a ``/health.json`` body whose ``incidents`` key carries it."""
+    doc = json.loads(Path(path).read_text())
+    if (isinstance(doc, dict) and isinstance(doc.get("incidents"), dict)):
+        doc = doc["incidents"]  # health.json composition
+    if (not isinstance(doc, dict) or "by_kind" not in doc
+            or not isinstance(doc.get("incidents"), list)):
+        raise ValueError(f"{path}: not an incidents snapshot")
+    return doc
+
+
+def incident_rows(snapshots: Sequence[Dict]) -> List[Dict]:
+    """Flatten monitor snapshots into per-incident rows, open first."""
+    rows = []
+    for snap in snapshots:
+        monitor = snap.get("host", "?")
+        for inc in snap.get("incidents", []):
+            cands = inc.get("candidates", [])
+            root = cands[0] if cands else {}
+            rows.append({
+                "monitor": monitor,
+                "id": inc.get("id", "?"),
+                "kind": inc.get("kind", "?"),
+                "status": inc.get("status", "?"),
+                "hosts": ",".join(inc.get("hosts", [])),
+                "docs": ",".join(inc.get("docs", [])),
+                "opened": inc.get("opened_round"),
+                "resolved": (inc.get("resolved_round")
+                             if inc.get("resolved_round") is not None
+                             else "-"),
+                "signals": inc.get("signals", 0),
+                "root_value": root.get("value", 0),
+                "candidates": ",".join(
+                    f"{c.get('kind')}@{c.get('host')}" for c in cands
+                ),
+            })
+    rows.sort(key=lambda r: (r["status"] == "resolved", r["monitor"],
+                             r["id"]))
+    return rows
+
+
+def _incidents_command(args) -> int:
+    """Render the correlated incident table (see module doc)."""
+    snapshots = []
+    for p in args.paths:
+        try:
+            snapshots.append(load_incidents(p))
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            print(f"unreadable incidents snapshot {p}: {exc}",
+                  file=sys.stderr)
+            return 2
+    rows = incident_rows(snapshots)
+    open_count = sum(s.get("open", 0) for s in snapshots)
+    resolved = sum(s.get("resolved", 0) for s in snapshots)
+    digests = sorted({s.get("digest") for s in snapshots})
+    if args.json:
+        print(json.dumps({
+            "monitors": len(snapshots), "open": open_count,
+            "resolved": resolved, "digests": digests, "rows": rows,
+        }, indent=2))
+    else:
+        agree = ("" if len(snapshots) < 2 else
+                 " · views AGREE" if len(digests) == 1
+                 else " · views DISAGREE")
+        print(f"{len(snapshots)} monitor(s) · {open_count} open · "
+              f"{resolved} resolved{agree}")
+        if rows:
+            print(render_table(
+                rows,
+                cols=["monitor", "id", "kind", "status", "hosts",
+                      "opened", "resolved", "signals", "candidates"],
+                left_cols=5,
+            ))
+        else:
+            print("no incidents recorded")
+    # an open incident is exit 1: the command doubles as a fleet
+    # incident check (CI / cron), mirroring serve/fleet
+    return 1 if open_count else 0
+
+
+# -- status roll-up (live MetricsServer or snapshot dir) ---------------------
+
+#: plane -> (route/filename stem, evaluator).  Evaluators return
+#: (exit_code, summary_string) from the plane's already-parsed JSON body,
+#: with the SAME health predicates the per-plane commands apply.
+def _eval_health(doc: Dict) -> tuple:
+    counters = doc.get("counters", {})
+    rollbacks = int(counters.get("supervisor.rollbacks", 0))
+    quarantines = sum(
+        v for k, v in counters.items()
+        if k.startswith("streaming.quarantines")
+    )
+    return 0, (f"{len(counters)} counters · rollbacks {rollbacks} · "
+               f"quarantines {int(quarantines)}")
+
+
+def _eval_convergence(doc: Dict) -> tuple:
+    lag = int(doc.get("total_lag_ops", 0))
+    div = int(doc.get("divergence_incidents", 0))
+    code = 1 if (lag or div) else 0
+    return code, (f"{len(doc.get('peers', {}))} peer(s) · lag {lag} ops · "
+                  f"{div} divergence")
+
+
+def _eval_serve(doc: Dict) -> tuple:
+    q = doc.get("queue", {})
+    recent = int(doc.get("recent_sheds",
+                         q.get("verdicts", {}).get("shed", 0)))
+    overloaded = bool(doc.get("overloaded") or q.get("backpressure"))
+    code = 1 if (overloaded or recent) else 0
+    return code, (f"{doc.get('sessions', 0)} session(s) · "
+                  f"depth {q.get('depth', 0)}/{q.get('max_depth', 0)} · "
+                  f"recent sheds {recent}"
+                  + (" · OVERLOADED" if overloaded else ""))
+
+
+def _eval_fleet(doc: Dict) -> tuple:
+    leases = doc.get("leases", {}).get("leases", {})
+    dead = sum(1 for r in leases.values() if r.get("verdict") == "dead")
+    failed = len(doc.get("failed_docs", []))
+    code = 1 if (dead or failed) else 0
+    return code, (f"{len(doc.get('hosts', {}))} host(s) · {dead} dead · "
+                  f"{len(doc.get('serving', {}))} docs · "
+                  f"{failed} failed · "
+                  f"{doc.get('failovers', 0)} failover(s)")
+
+
+def _eval_latency(doc: Dict) -> tuple:
+    slo = doc.get("slo", {})
+    burn = float(slo.get("burn_rate", 0.0) or 0.0)
+    code = 1 if burn > 1.0 else 0
+    return code, (f"windows {doc.get('windows', 0)} · "
+                  f"burn rate {burn} · "
+                  f"violating {slo.get('violating_frac', 0)}")
+
+
+def _eval_incidents(doc: Dict) -> tuple:
+    open_count = int(doc.get("open", 0))
+    code = 1 if open_count else 0
+    kinds = ",".join(
+        k for k, v in doc.get("by_kind", {}).items() if v
+    )
+    return code, (f"{open_count} open · {doc.get('resolved', 0)} resolved"
+                  + (f" · {kinds}" if kinds else ""))
+
+
+_STATUS_PLANES = (
+    ("health", _eval_health),
+    ("convergence", _eval_convergence),
+    ("serve", _eval_serve),
+    ("fleet", _eval_fleet),
+    ("latency", _eval_latency),
+    ("incidents", _eval_incidents),
+)
+
+
+def _status_source(src: str, plane: str):
+    """One plane's JSON body from a MetricsServer base URL or snapshot
+    dir.  Returns the parsed body, None when the plane is absent (no
+    route / no file), or raises for a present-but-unreadable source."""
+    if src.startswith(("http://", "https://")):
+        import urllib.error
+        import urllib.request
+
+        url = f"{src.rstrip('/')}/{plane}.json"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None  # plane not mounted on this server
+            raise
+    path = Path(src) / f"{plane}.json"
+    if not path.exists():
+        return None
+    return json.loads(path.read_text())
+
+
+def _status_command(args) -> int:
+    """The one-look fleet roll-up (see module doc)."""
+    rows = []
+    codes = []
+    for plane, evaluator in _STATUS_PLANES:
+        try:
+            doc = _status_source(args.src, plane)
+        except Exception as exc:  # noqa: BLE001 - every failure renders as a row
+            rows.append({"plane": plane, "status": "UNREADABLE",
+                         "exit": 2, "summary": str(exc)})
+            codes.append(2)
+            continue
+        if doc is None:
+            continue
+        if plane == "health" and isinstance(doc.get("incidents"), dict):
+            # a health body composes the other planes; prefer dedicated
+            # sources but don't double-render what health already carries
+            pass
+        code, summary = evaluator(doc)
+        rows.append({
+            "plane": plane,
+            "status": "ok" if code == 0 else "ATTENTION",
+            "exit": code,
+            "summary": summary,
+        })
+        codes.append(code)
+    if not rows:
+        print(f"status: no plane snapshots found at {args.src} "
+              "(expected <plane>.json files or MetricsServer routes)",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({"src": args.src, "exit": max(codes),
+                          "planes": rows}, indent=2))
+    else:
+        print(f"{args.src} · {len(rows)} plane(s) · "
+              f"{sum(1 for c in codes if c)} need attention")
+        print(render_table(rows, cols=["plane", "status", "exit", "summary"],
+                           left_cols=2))
+    # composite contract: the worst per-plane exit code wins
+    return max(codes)
+
+
+def _flight_command(args) -> int:
+    """Render the merged cross-host black-box timeline (see module doc)."""
+    from .incidents import merge_flight_dumps
+
+    root = Path(args.dir)
+    if not root.is_dir():
+        print(f"flight: {args.dir} is not a directory", file=sys.stderr)
+        return 2
+    dumps = sorted(root.glob("flight-*.jsonl"))
+    if not dumps:
+        print(f"flight: no flight-*.jsonl dumps under {args.dir}",
+              file=sys.stderr)
+        return 2
+    merged = merge_flight_dumps(dumps)
+    if args.json:
+        print(json.dumps(merged, indent=2, default=str))
+        return 0
+    base = (float(merged["timeline"][0].get("ts", 0.0) or 0.0)
+            if merged["timeline"] else 0.0)
+    print(f"{len(merged['dumps'])} dump(s) · "
+          f"{len(merged['hosts'])} host(s) · {merged['records']} record(s) · "
+          f"{len(merged['traces'])} trace(s)"
+          + (f" · {merged['skipped']} skipped" if merged["skipped"] else ""))
+    rows = []
+    for rec in merged["timeline"][-args.tail:]:
+        label = (rec.get("name") or rec.get("reason")
+                 or rec.get("provider") or "")
+        rows.append({
+            "t_ms": round((float(rec.get("ts", 0.0) or 0.0) - base) * 1e3, 3),
+            "host": rec.get("host", "?"),
+            "kind": rec.get("kind", "?"),
+            "what": label,
+            "trace": (str(rec.get("trace_id"))[-8:]
+                      if rec.get("trace_id") else ""),
+        })
+    if rows:
+        print(render_table(rows, cols=["t_ms", "host", "kind", "what",
+                                       "trace"], left_cols=0))
+    for trace, recs in sorted(merged["traces"].items()):
+        hosts = sorted({r["host"] for r in recs})
+        print(f"  trace …{trace[-8:]}: {len(recs)} record(s) across "
+              f"{','.join(hosts)}")
+    return 0
 
 
 def _perf_command(args) -> int:
@@ -469,7 +765,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # default command: `python -m peritext_tpu.obs trace.json` == summary
     if argv and argv[0] not in ("summary", "merge", "fleet", "serve", "perf",
-                                "plan", "why", "-h", "--help"):
+                                "plan", "why", "incidents", "status",
+                                "flight", "-h", "--help"):
         argv.insert(0, "summary")
     parser = argparse.ArgumentParser(
         prog="python -m peritext_tpu.obs", description=__doc__,
@@ -549,6 +846,34 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p_plan.add_argument("--tolerance", type=float, default=None, metavar="PCT",
                         help="savings band (percent) below which the current "
                         "statics stand (default 10)")
+    p_inc = sub.add_parser(
+        "incidents", help="correlated incident table from incidents.json "
+        "scrapes (exit 1 on open incidents)",
+    )
+    p_inc.add_argument("paths", nargs="+")
+    p_inc.add_argument("--json", action="store_true",
+                       help="machine-readable rows instead of the table")
+    p_status = sub.add_parser(
+        "status", help="one-look roll-up across every plane from a live "
+        "MetricsServer URL or a snapshot directory (exit = worst plane)",
+    )
+    p_status.add_argument("src", help="http(s)://host:port base URL or a "
+                          "directory of <plane>.json snapshots")
+    p_status.add_argument("--json", action="store_true",
+                          help="machine-readable plane rows instead of the "
+                          "table")
+    p_flight = sub.add_parser(
+        "flight", help="merged cross-host black-box timeline from a "
+        "directory of flight-recorder dumps",
+    )
+    p_flight.add_argument("dir", help="directory holding flight-*.jsonl "
+                          "dumps")
+    p_flight.add_argument("--json", action="store_true",
+                          help="machine-readable merged timeline instead of "
+                          "the table")
+    p_flight.add_argument("--tail", type=int, default=40, metavar="N",
+                          help="show the last N timeline records "
+                          "(default 40)")
     args = parser.parse_args(argv)
     if args.cmd is None:
         parser.print_help()
@@ -562,6 +887,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.cmd == "plan":
         return _plan_command(args)
+
+    if args.cmd == "incidents":
+        return _incidents_command(args)
+
+    if args.cmd == "status":
+        return _status_command(args)
+
+    if args.cmd == "flight":
+        return _flight_command(args)
 
     if args.cmd == "serve":
         snapshots = []

@@ -22,6 +22,9 @@ Endpoints:
 * ``/latency.json`` — the attached :class:`~.latency.LatencyPlane` snapshot
   (per-stage watermark histograms, SLO burn rate, close causes,
   time-to-visibility)
+* ``/incidents.json`` — the attached
+  :class:`~.incidents.IncidentMonitor` snapshot (typed incident list,
+  lifecycle tallies, cross-host agreement view)
 """
 
 from __future__ import annotations
@@ -46,6 +49,43 @@ def _fmt(value: float) -> str:
     return repr(round(float(value), 9)) if value % 1 else str(int(value))
 
 
+def _quote_label(value: str) -> str:
+    """Full exposition-format label escaping: backslash, quote, newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+#: computed once per process: the sha shells out to git and the fingerprint
+#: may touch the jax backend — neither belongs on the per-scrape path
+_BUILD_INFO: Optional[Dict[str, str]] = None
+
+
+def build_info() -> Dict[str, str]:
+    """One identity record for this process — the SAME spellings the perf
+    ledger stamps into its rows (:func:`~.ledger.git_sha` /
+    :func:`~.ledger.device_fingerprint`), plus the wire caps, so a scraped
+    fleet and a ledger row can be joined on identity without translation."""
+    global _BUILD_INFO
+    if _BUILD_INFO is None:
+        from ..parallel.codec import WIRE_CAPS
+        from .ledger import device_fingerprint, git_sha
+
+        try:
+            import jax
+            jax_version = getattr(jax, "__version__", "unknown")
+        except Exception:  # graftlint: boundary(the identity gauge must render even where jax is absent)
+            jax_version = "none"
+        fp = device_fingerprint()
+        _BUILD_INFO = {
+            "sha": git_sha() or "unknown",
+            "wire_caps": str(WIRE_CAPS),
+            "jax": str(jax_version),
+            "device": f"{fp.get('platform')}-{fp.get('kind')}"
+                      f"-{fp.get('cpus')}",
+        }
+    return _BUILD_INFO
+
+
 def prometheus_text(
     counters: Optional[Counters] = None,
     histograms: Optional[HistogramRegistry] = None,
@@ -57,6 +97,7 @@ def prometheus_text(
     fleet=None,
     plan=None,
     latency=None,
+    incidents=None,
 ) -> str:
     """Prometheus text exposition of the process telemetry.  Counter names
     sanitize ``.`` → ``_`` under a ``peritext_`` prefix; histograms emit the
@@ -86,10 +127,28 @@ def prometheus_text(
     :class:`~.latency.LatencyPlane` lands as ``peritext_latency_*``
     families — one histogram per stage watermark plus the end-to-end
     total and time-to-visibility, SLO burn-rate gauges, and the
-    window-close cause counters."""
+    window-close cause counters; an
+    :class:`~.incidents.IncidentMonitor` lands as ``peritext_incident_*``
+    gauges — lifecycle tallies, per-kind open counts over the FULL
+    taxonomy (absent kinds at 0, so alert rules never reference a series
+    that has yet to exist), the incident-view digest, and per-peer
+    agreement flags.  Every exposition also carries ONE
+    ``peritext_build_info`` info-style gauge (value 1, identity as
+    labels: git sha, wire caps, jax version, device fingerprint) — the
+    same spellings the perf ledger stamps, so fleet scrapes and ledger
+    rows join on identity."""
     counters = counters or GLOBAL_COUNTERS
     histograms = histograms if histograms is not None else GLOBAL_HISTOGRAMS
     lines = []
+    info = build_info()
+    m = "peritext_build_info"
+    lines.append(f"# TYPE {m} gauge")
+    lines.append(
+        f'{m}{{sha="{_quote_label(info["sha"])}"'
+        f',wire_caps="{_quote_label(info["wire_caps"])}"'
+        f',jax="{_quote_label(info["jax"])}"'
+        f',device="{_quote_label(info["device"])}"}} 1'
+    )
     for name, value in sorted(counters.snapshot().items()):
         m = _metric_name(name)
         lines.append(f"# TYPE {m} counter")
@@ -415,6 +474,31 @@ def prometheus_text(
             quoted = (cause.replace("\\", "\\\\").replace('"', '\\"')
                       .replace("\n", "\\n"))
             lines.append(f'{m}{{cause="{quoted}"}} {_fmt(count)}')
+    if incidents is not None:
+        snap = incidents.snapshot()
+        for m, value in (
+            ("peritext_incident_rounds", snap["rounds"]),
+            ("peritext_incident_open", snap["open"]),
+            ("peritext_incident_acked", snap["acked"]),
+            ("peritext_incident_resolved", snap["resolved"]),
+            ("peritext_incident_total", snap["total"]),
+            ("peritext_incident_digest", snap["digest"]),
+        ):
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {_fmt(value)}")
+        # by-kind family, own name (same no-double-count rationale as
+        # peritext_serve_shed_reason_total); the FULL taxonomy is emitted
+        # so dashboards can alert on kinds that have never fired
+        m = "peritext_incident_open_by_kind"
+        lines.append(f"# TYPE {m} gauge")
+        for kind, count in snap["by_kind"].items():
+            lines.append(f'{m}{{kind="{_quote_label(kind)}"}} {_fmt(count)}')
+        m = "peritext_incident_peer_agreement"
+        lines.append(f"# TYPE {m} gauge")
+        for peer, view in snap["peers"].items():
+            lines.append(
+                f'{m}{{peer="{_quote_label(peer)}"}} {int(view["agree"])}'
+            )
     if session is not None:
         health = session.health()
         for key in sorted(health):
@@ -477,13 +561,14 @@ class MetricsServer:
         fleet=None,
         plan=None,
         latency=None,
+        incidents=None,
     ) -> None:
         def metrics() -> str:
             return prometheus_text(
                 counters=counters, histograms=histograms,
                 session=session, sentinel=sentinel, convergence=convergence,
                 devprof=devprof, serve=serve, fleet=fleet, plan=plan,
-                latency=latency,
+                latency=latency, incidents=incidents,
             )
 
         def snapshot() -> str:
@@ -493,6 +578,7 @@ class MetricsServer:
                     histograms=histograms, recorder=recorder,
                     convergence=convergence, devprof=devprof, serve=serve,
                     fleet=fleet, plan=plan, latency=latency,
+                    incidents=incidents,
                 ),
                 default=str,
             )
@@ -537,6 +623,11 @@ class MetricsServer:
         if latency is not None:
             routes["/latency.json"] = (
                 lambda: json.dumps(latency.snapshot()),
+                "application/json",
+            )
+        if incidents is not None:
+            routes["/incidents.json"] = (
+                lambda: json.dumps(incidents.snapshot()),
                 "application/json",
             )
         self._httpd = ThreadingHTTPServer((host, port), _Handler)

@@ -47,7 +47,8 @@ GLOBAL_COUNTERS = Counters()
 
 #: counter/histogram namespaces that make up the fault-domain health surface
 _HEALTH_PREFIXES = ("streaming.", "transport.", "supervisor.", "merge.",
-                    "jit.", "convergence.", "serve.", "fleet.", "plan.")
+                    "jit.", "convergence.", "serve.", "fleet.", "plan.",
+                    "incident.")
 
 
 def health_snapshot(
@@ -63,6 +64,7 @@ def health_snapshot(
     plan=None,
     mesh=None,
     latency=None,
+    incidents=None,
 ) -> Dict[str, Any]:
     """One structured dict for a fleet health endpoint: every fault-domain
     counter (quarantines, corrupt frames, transport retries / behind peers,
@@ -94,7 +96,10 @@ def health_snapshot(
     tallies appear under ``mesh``; with a
     :class:`~.latency.LatencyPlane`, its stage-watermark decomposition
     (per-stage histograms, SLO burn rate, close causes) appears under
-    ``latency``.  Everything in the snapshot is
+    ``latency``; with an
+    :class:`~.incidents.IncidentMonitor`, its correlated incident view
+    (typed incident list, lifecycle tallies, per-peer agreement) appears
+    under ``incidents``.  Everything in the snapshot is
     JSON-serializable (the exporter-schema golden test pins this)."""
     from .histograms import GLOBAL_HISTOGRAMS
 
@@ -137,4 +142,6 @@ def health_snapshot(
         out["mesh"] = dict(mesh)
     if latency is not None:
         out["latency"] = latency.snapshot()
+    if incidents is not None:
+        out["incidents"] = incidents.snapshot()
     return out

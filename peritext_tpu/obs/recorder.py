@@ -49,10 +49,16 @@ class FlightRecorder:
     def __init__(self, capacity: int = 1024,
                  dump_dir: Optional[str | Path] = None,
                  fsync: bool = False,
-                 min_dump_interval: float = 1.0) -> None:
+                 min_dump_interval: float = 1.0,
+                 host: Optional[str] = None) -> None:
         if capacity <= 0:
             raise ValueError(f"capacity must be positive, got {capacity}")
         self.capacity = capacity
+        #: host label minted into dump filenames
+        #: (``flight-<host>-<pid>-<n>-<reason>.jsonl``) so a cross-host
+        #: merge (:func:`~.incidents.merge_flight_dumps`) attributes every
+        #: record WITHOUT parsing dump bodies
+        self.host = host
         self.dump_dir = Path(dump_dir) if dump_dir is not None else None
         self.fsync = bool(fsync)
         self.min_dump_interval = float(min_dump_interval)
@@ -145,7 +151,7 @@ class FlightRecorder:
              reason: Optional[str] = None,
              context: Optional[Dict] = None) -> Path:
         """Write the ring to ``path`` (default: a fresh
-        ``flight-<pid>-<n>-<reason>.jsonl`` under ``dump_dir``, where
+        ``flight-<host>-<pid>-<n>-<reason>.jsonl`` under ``dump_dir``, where
         ``<n>`` is process-unique so recorders sharing the directory never
         overwrite each other's post-mortems) as JSONL; returns the path
         written.  ``context`` (the triggering fault's fields) activates the
@@ -159,8 +165,9 @@ class FlightRecorder:
                 raise ValueError("no dump path given and no dump_dir configured")
             self.dump_dir.mkdir(parents=True, exist_ok=True)
             tag = (reason or "manual").replace("/", "_").replace(" ", "_")
+            host = (self.host or "local").replace("/", "_").replace(" ", "_")
             path = self.dump_dir / (
-                f"flight-{os.getpid()}-{next(_DUMP_IDS):06d}-{tag}.jsonl"
+                f"flight-{host}-{os.getpid()}-{next(_DUMP_IDS):06d}-{tag}.jsonl"
             )
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)

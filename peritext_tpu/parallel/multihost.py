@@ -198,16 +198,28 @@ _META_SPAN = "\x00span"
 #: validation accepts-and-ignores them like every other sentinel.
 _META_DIGEST = "\x00digest"
 _META_PORT = "\x00port"
+#: incident observability (round 5 of the wire): the sender's compact
+#: incident summary (``IncidentMonitor.wire_summary`` — open count packed
+#: above a 32-bit digest of the observation-derived incident view), so two
+#: frontends can tell whether they AGREE on what is broken before the
+#: ROADMAP's death-verdict gossip acts on it.  An int, so old peers'
+#: {str: int} frontier validation accepts-and-ignores it like every other
+#: sentinel.
+_META_INCIDENTS = "\x00incidents"
 _META_KEYS = {_META_CAPS: "caps", _META_TRACE: "trace", _META_SPAN: "span",
-              _META_DIGEST: "digest", _META_PORT: "port"}
+              _META_DIGEST: "digest", _META_PORT: "port",
+              _META_INCIDENTS: "incidents"}
 
 
-def _frontier_meta(tracer, span, digest=None, port=None) -> dict:
+def _frontier_meta(tracer, span, digest=None, port=None,
+                   incidents=None) -> dict:
     """The metadata this endpoint attaches to an outbound frontier: always
     its wire caps; the current span's trace context when tracing is live,
     so the peer's handler span can join OUR trace; the store digest at the
-    advertised frontier (divergence probe); and, for endpoints that serve a
-    replica socket, the listening port (peer attribution)."""
+    advertised frontier (divergence probe); for endpoints that serve a
+    replica socket, the listening port (peer attribution); and, when an
+    incident monitor is armed, its packed incident summary (fleet incident
+    agreement)."""
     meta = {_META_CAPS: WIRE_CAPS}
     if span is not None and tracer is not None and tracer.active():
         meta[_META_TRACE] = int(span.trace_id)
@@ -216,6 +228,8 @@ def _frontier_meta(tracer, span, digest=None, port=None) -> dict:
         meta[_META_DIGEST] = int(digest)
     if port is not None:
         meta[_META_PORT] = int(port)
+    if incidents is not None:
+        meta[_META_INCIDENTS] = int(incidents)
     return meta
 
 
@@ -374,6 +388,7 @@ class ReplicaServer:
         serve=None,
         on_ship: Optional[Callable[[str, List[bytes], int], int]] = None,
         fleet=None,
+        incidents=None,
     ) -> None:
         """``on_changes`` receives each batch of newly-merged decoded
         changes; ``on_frame`` receives the RAW inbound frame bytes whenever
@@ -409,6 +424,11 @@ class ReplicaServer:
         loudly — this endpoint does not accept migrations."""
         from ..obs import ConvergenceMonitor
 
+        #: optional :class:`~..obs.incidents.IncidentMonitor`: when armed,
+        #: outbound frontiers carry its packed summary (the
+        #: ``"\x00incidents"`` sentinel) and inbound ones feed
+        #: ``observe_peer_summary`` — the fleet incident-agreement view
+        self.incidents = incidents
         self.store = store
         self.on_changes = on_changes
         self.on_frame = on_frame
@@ -504,7 +524,7 @@ class ReplicaServer:
             on_changes=self.on_changes, timeout=timeout, lock=self._lock,
             on_frame=self.on_frame, retry=retry, tracer=self.tracer,
             monitor=self.monitor, advertise_port=self.address[1],
-            peer_name=peer_name,
+            peer_name=peer_name, incidents=self.incidents,
         )
 
     def try_sync_with(
@@ -519,6 +539,7 @@ class ReplicaServer:
             on_frame=self.on_frame, retry=retry, tracer=self.tracer,
             recorder=self.recorder, monitor=self.monitor,
             advertise_port=self.address[1], peer_name=peer_name,
+            incidents=self.incidents,
         )
 
     def _handle_ship(self, conn: socket.socket, body: bytes) -> None:
@@ -598,6 +619,11 @@ class ReplicaServer:
                             local_digest=my_digest,
                             peer_digest=meta.get("digest"),
                         )
+                    if (peer_name is not None and self.incidents is not None
+                            and "incidents" in meta):
+                        self.incidents.observe_peer_summary(
+                            peer_name, meta["incidents"]
+                        )
                     # chunked: a large backlog splits into multiple frames so
                     # no single frame approaches the peer's decode dep budget
                     _send_changes(
@@ -608,6 +634,9 @@ class ReplicaServer:
                         conn, my_clock, meta=_frontier_meta(
                             self.tracer, sp, digest=my_digest,
                             port=self.address[1],
+                            incidents=(self.incidents.wire_summary()
+                                       if self.incidents is not None
+                                       else None),
                         )
                     )
                     # the frame-level ctx is redundant HERE: this handler
@@ -657,6 +686,7 @@ def _sync_once(
     monitor=None,
     advertise_port: Optional[int] = None,
     peer_name: Optional[str] = None,
+    incidents=None,
 ) -> Tuple[List[Change], int, List[bytes], Optional[TraceContext]]:
     """One attempt of the bidirectional exchange (see :func:`sync_with`).
     The store mutates only AFTER the socket closes cleanly, so a failed
@@ -684,9 +714,15 @@ def _sync_once(
             # our replica port when we serve one (peer attribution)
             _send_frontier(sock, my_clock, meta=_frontier_meta(
                 tracer, sp, digest=my_digest, port=advertise_port,
+                incidents=(incidents.wire_summary()
+                           if incidents is not None else None),
             ))
             inbound, frames, in_ctx = _recv_changes(sock, want_frames=want_frames)
             peer_clock, meta = _parse_frontier(_expect(sock, MSG_FRONTIER))
+            if incidents is not None and "incidents" in meta:
+                incidents.observe_peer_summary(
+                    peer_name or f"{host}:{port}", meta["incidents"]
+                )
             if monitor is not None:
                 # telemetry only, observed against the PRE-merge snapshot:
                 # both frontiers are pre-exchange positions, so the
@@ -728,6 +764,7 @@ def sync_with(
     monitor=None,
     advertise_port: Optional[int] = None,
     peer_name: Optional[str] = None,
+    incidents=None,
 ) -> Tuple[int, int]:
     """One full bidirectional anti-entropy round against a peer.
 
@@ -761,7 +798,7 @@ def sync_with(
             fresh, pushed, frames, in_ctx = _sync_once(
                 store, host, port, deadline, lock, on_frame is not None,
                 tracer, monitor=monitor, advertise_port=advertise_port,
-                peer_name=peer_name,
+                peer_name=peer_name, incidents=incidents,
             )
         except _RETRYABLE as exc:
             last = exc
@@ -808,6 +845,7 @@ def try_sync_with(
     monitor=None,
     advertise_port: Optional[int] = None,
     peer_name: Optional[str] = None,
+    incidents=None,
 ) -> SyncOutcome:
     """Anti-entropy round that NEVER raises on transport failure: a peer
     that stays unreachable through the retry budget yields a ``behind``
@@ -845,7 +883,7 @@ def try_sync_with(
             store, host, port, on_changes=_fenced(on_changes),
             lock=lock, on_frame=_fenced(on_frame), retry=policy,
             tracer=tracer, monitor=monitor, advertise_port=advertise_port,
-            peer_name=peer_name,
+            peer_name=peer_name, incidents=incidents,
         )
     except _CallbackFailed as exc:
         raise exc.__cause__

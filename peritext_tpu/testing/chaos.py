@@ -779,6 +779,32 @@ def run_divergence_injection(seed: int, dump_dir=None) -> Dict:
             for r in records
         ), "flight dump lacks the divergence fault record"
         evidence["dump"] = str(dump)
+
+    # -- incident-plane oracle: EXACTLY a divergence incident ---------------
+    # delta-triggered on the convergence monitor's incident count, so the
+    # heal (no further divergent probes) is quiet rounds, nothing else
+    from ..obs import IncidentMonitor
+
+    imon = IncidentMonitor(host="injector", clear_after=2)
+    fault_round = imon.rounds
+    imon.observe_convergence(monitor)
+    imon.advance_round()
+    assert imon.incident_kinds() == ["divergence"], (
+        f"seed={seed}: divergence injection opened {imon.incident_kinds()},"
+        " expected exactly ['divergence']"
+    )
+    assert len(imon.open_incidents()) == 1
+    ttd = imon.time_to_detection("divergence", fault_round)
+    assert ttd == 1, f"seed={seed}: detection took {ttd} monitor rounds"
+    for _ in range(imon.clear_after):
+        imon.observe_convergence(monitor)
+        imon.advance_round()
+    assert not imon.open_incidents(), (
+        f"seed={seed}: divergence incident never resolved post-heal"
+    )
+    evidence["incident_kinds"] = imon.incident_kinds()
+    evidence["incident_resolved"] = True
+    evidence["incident_detection_rounds"] = ttd
     return evidence
 
 
@@ -836,6 +862,10 @@ class ServeChaosReport:
     latency_records: int = 0
     latency_sum_consistent: bool = False
     latency_force_close: Dict[str, int] = None
+    #: incident-plane oracle: the episode must open EXACTLY these kinds
+    incident_kinds: List[str] = None
+    incident_resolved: bool = False
+    incident_detection_rounds: int = -1
 
     def to_json(self) -> Dict:
         return asdict(self)
@@ -948,7 +978,14 @@ def run_serve_chaos(
         scheds[0].round()  # every outbound dial fails
 
         # the overload burst: offer far more than the queue holds, pumping
-        # only occasionally (an ingest spike outrunning device rounds)
+        # only occasionally (an ingest spike outrunning device rounds).
+        # The incident-plane oracle samples the mux at each pump boundary
+        # — BEFORE the flush that lets the tier catch up and clear its
+        # recent-shed mark — the cadence a real scrape-fed monitor has
+        from ..obs import IncidentMonitor
+
+        imon = IncidentMonitor(host=names[0], clear_after=2)
+        shed_fault_round = imon.rounds
         offered_target = int(overload_factor * max_depth) * 2
         offered = 0
         d = 0
@@ -968,9 +1005,17 @@ def run_serve_chaos(
             offered += 1
             d += 1
             if offered % (max_depth * 2) == 0:
+                imon.observe_serve(mux)
+                imon.advance_round()
                 # an occasional pump mid-overload: the device keeps
                 # retiring rounds while the partition holds
                 mux.flush()
+        # incident-plane oracle, detection half: the mid-overload samples
+        # must have opened EXACTLY a shed-storm incident
+        assert imon.incident_kinds() == ["shed-storm"], (
+            f"seed={seed}: overload opened {imon.incident_kinds()}, "
+            "expected exactly ['shed-storm']"
+        )
         mux.flush()
         stats = mux.admission.stats
         report.offered = stats.submitted
@@ -1087,6 +1132,24 @@ def run_serve_chaos(
         report.repaired_digest_matches_clean = True
         report.final_digest = final
         assert mux.session.pending_count() == 0
+
+        # incident-plane oracle, heal half: redelivery committed clean
+        # rounds, so recent_sheds cleared — quiet rounds must resolve the
+        # shed-storm and nothing else may have opened
+        for _ in range(imon.clear_after + 1):
+            imon.observe_serve(mux)
+            imon.advance_round()
+        assert imon.incident_kinds() == ["shed-storm"], (
+            f"seed={seed}: heal phase opened {imon.incident_kinds()}"
+        )
+        assert not imon.open_incidents(), (
+            f"seed={seed}: shed-storm incident never resolved post-heal"
+        )
+        report.incident_kinds = imon.incident_kinds()
+        report.incident_resolved = True
+        report.incident_detection_rounds = imon.time_to_detection(
+            "shed-storm", shed_fault_round
+        )
     finally:
         for gate in gates.values():
             gate.close()
@@ -1338,10 +1401,21 @@ def run_fused_drain_kill(seed: int, checkpoint_root=None) -> Dict:
         guarded = GuardedSession(
             factory, checkpoint_root, deadline=120.0, checkpoint_every=1000,
         )
+        # incident-plane oracle: a private monitor fed guarded.health()
+        # sees the rollback delta as EXACTLY a quarantine-storm incident;
+        # the clean pre-kill drain is its zero baseline
+        from ..obs import IncidentMonitor
+
+        imon = IncidentMonitor(host="fused-chaos", clear_after=2)
         for d, (a, _) in enumerate(frames):
             guarded.ingest_frame(d, a)
         pre_rounds = guarded.drain()
         assert pre_rounds > 0, "first half must commit"
+        imon.observe_supervisor(guarded)
+        imon.advance_round()
+        assert not imon.incident_kinds(), (
+            f"seed={seed}: clean drain opened {imon.incident_kinds()}"
+        )
         guarded.checkpoint()  # the pre-fuse boundary rollback must land on
 
         for d, (_, b) in enumerate(frames):
@@ -1372,12 +1446,33 @@ def run_fused_drain_kill(seed: int, checkpoint_root=None) -> Dict:
             f"mid-fuse kill recovery diverged: {digest:#x} != {clean_digest:#x}"
         )
         assert guarded.read_all() == clean.read_all()
+
+        # incident-plane oracle: the rollback edge opens EXACTLY a
+        # quarantine-storm; recovery already replayed the journal, so
+        # quiet observations resolve it
+        kill_mon_round = imon.rounds
+        imon.observe_supervisor(guarded)
+        imon.advance_round()
+        assert imon.incident_kinds() == ["quarantine-storm"], (
+            f"seed={seed}: mid-fuse kill opened {imon.incident_kinds()}, "
+            "expected exactly ['quarantine-storm']"
+        )
+        ttd = imon.time_to_detection("quarantine-storm", kill_mon_round)
+        for _ in range(imon.clear_after):
+            imon.observe_supervisor(guarded)
+            imon.advance_round()
+        assert not imon.open_incidents(), (
+            f"seed={seed}: quarantine-storm never resolved post-recovery"
+        )
         return {
             "seed": seed,
             "rollbacks": guarded.rollbacks,
             "batches_before_kill": calls["n"] - 1,
             "pre_fuse_rounds": pre_rounds,
             "digest": digest,
+            "incident_kinds": imon.incident_kinds(),
+            "incident_resolved": True,
+            "incident_detection_rounds": ttd,
         }
     finally:
         if tmp is not None:
@@ -1433,6 +1528,11 @@ class HostKillReport:
     flight_dumps: int = 0
     traffic_seconds: float = 0.0
     applied_frames: int = 0
+    #: incident-plane oracle: the episode must open EXACTLY these kinds
+    incident_kinds: List[str] = None
+    incident_resolved: bool = False
+    #: monitor rounds from the kill to the host-death incident opening
+    incident_detection_rounds: int = -1
 
     def to_json(self) -> Dict:
         return asdict(self)
@@ -1472,7 +1572,7 @@ def run_host_kill_failover(
       ``dump_dir``).
 
     Raises on any violation; returns the evidence report."""
-    from ..obs import FlightRecorder
+    from ..obs import FlightRecorder, IncidentMonitor
     from ..serve import (
         AdmissionController, FleetFrontend, SHED_REASONS, SessionMux,
     )
@@ -1484,9 +1584,19 @@ def run_host_kill_failover(
 
     recorder = (
         FlightRecorder(capacity=256, dump_dir=Path(dump_dir),
-                       min_dump_interval=0.0)
+                       min_dump_interval=0.0, host="frontend")
         if dump_dir is not None else None
     )
+    # the incident-plane oracle: a PRIVATE monitor fed the fleet snapshot
+    # once per frontend round must open EXACTLY a host-death incident and
+    # resolve it once failover re-homes every doc — nothing else
+    imon = IncidentMonitor(host="frontend", clear_after=2,
+                           recorder=recorder)
+    kill_mon_round = 0
+
+    def monitor_round():
+        imon.observe_fleet(fe)
+        imon.advance_round()
 
     def make_mux():
         return SessionMux(
@@ -1555,6 +1665,7 @@ def run_host_kill_failover(
                                      for dk in victim_docs}
                     fe.hosts[victim].kill()
                     kill_round = fe.rounds
+                    kill_mon_round = imon.rounds
                     killed = True
                     # the very next submission to a victim doc must answer
                     # TYPED (delay: the lease has not expired yet)
@@ -1562,6 +1673,7 @@ def run_host_kill_failover(
                                       plans[victim_docs[0]][0])
                     assert probe.kind in ("delay", "shed"), probe
             fe.round()
+            monitor_round()
             if killed and not any(pending.values()):
                 break
             if fe.rounds > 200:
@@ -1569,6 +1681,7 @@ def run_host_kill_failover(
         # drive the lease to the dead verdict + failover
         while victim not in fe.ledger.dead_hosts():
             fe.round()
+            monitor_round()
             assert fe.rounds - kill_round <= 2 * lease_rounds + 2, (
                 "lease never expired"
             )
@@ -1617,6 +1730,7 @@ def run_host_kill_failover(
                     if verdict.kind != "admit":
                         dirty = True
             fe.round()
+            monitor_round()
             if not dirty:
                 break
         else:
@@ -1678,6 +1792,27 @@ def run_host_kill_failover(
                 f"failover timeline incomplete: {sorted(reasons)}"
             )
             report.flight_dumps = len(dumps)
+
+        # -- incident-plane oracle ------------------------------------------
+        # the episode opens EXACTLY a host-death incident; post-heal (docs
+        # re-homed, redelivery done) quiet rounds must resolve it
+        for _ in range(imon.clear_after + 1):
+            monitor_round()
+        assert imon.incident_kinds() == ["host-death"], (
+            f"seed={seed}: host-kill opened {imon.incident_kinds()}, "
+            "expected exactly ['host-death']"
+        )
+        assert not imon.open_incidents(), (
+            f"seed={seed}: host-death incident never resolved post-heal: "
+            f"{[i.to_json() for i in imon.open_incidents()]}"
+        )
+        ttd = imon.time_to_detection("host-death", kill_mon_round)
+        assert ttd is not None and ttd <= 2 * lease_rounds + 2, (
+            f"seed={seed}: host-death detection took {ttd} monitor rounds"
+        )
+        report.incident_kinds = imon.incident_kinds()
+        report.incident_resolved = True
+        report.incident_detection_rounds = ttd
     finally:
         fe.stop()
     return report

@@ -413,6 +413,11 @@ class TestGuardedSession:
         # already committed inside the killed drain
         assert report["batches_before_kill"] >= 1
         assert report["pre_fuse_rounds"] > 0
+        # incident-plane oracle: EXACTLY a quarantine-storm, resolved
+        # post-recovery, detected within a round of the rollback
+        assert report["incident_kinds"] == ["quarantine-storm"]
+        assert report["incident_resolved"]
+        assert report["incident_detection_rounds"] == 1
 
     def test_persistent_failure_degrades_to_scalar_replay(self, tmp_path, monkeypatch):
         workloads = generate_workload(seed=29, num_docs=2, ops_per_doc=OPS)
@@ -639,6 +644,10 @@ class TestChaosHarness:
         assert report.fleet_converged
         assert report.serve_digest_matches_reference
         assert report.repaired_digest_matches_clean
+        # incident-plane oracle: EXACTLY a shed-storm, resolved post-heal
+        assert report.incident_kinds == ["shed-storm"]
+        assert report.incident_resolved
+        assert report.incident_detection_rounds >= 1
 
     def test_reconnect_storm_drains_while_serving(self):
         """ROADMAP scenario item: a peer back from a long offline window
@@ -680,6 +689,11 @@ class TestChaosHarness:
         )
         assert report.delayed + report.shed > 0
         assert report.flight_dumps >= 1
+        # incident-plane oracle: EXACTLY a host-death, resolved once
+        # failover re-homed the victim's docs, detected within the lease
+        assert report.incident_kinds == ["host-death"]
+        assert report.incident_resolved
+        assert 1 <= report.incident_detection_rounds <= report.detection_rounds + 1
 
     def test_markheavy_chaos_smoke(self):
         """ROADMAP scenario diversity: the mark-heavy editorial-pass

@@ -154,6 +154,11 @@ class TestDivergenceProbe:
         evidence = run_divergence_injection(3, dump_dir=tmp_path)
         assert evidence["counter_incremented"]
         assert evidence["dump"] is not None
+        # incident-plane oracle: EXACTLY a divergence incident, resolved
+        # once the monitor stops observing new divergent probes
+        assert evidence["incident_kinds"] == ["divergence"]
+        assert evidence["incident_resolved"]
+        assert evidence["incident_detection_rounds"] == 1
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,527 @@
+"""The fleet incident plane: typed lifecycle, causal correlation, wire
+determinism, the merged black-box timeline, and the CLI/exporter surfaces.
+
+Everything here is round-counted and wall-clock-free by construction, so
+the pins are exact: two monitors fed the same observations must be
+byte-identical, a flapping signal must never mint a second incident, and
+arming the plane must compile nothing.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from peritext_tpu.obs import (
+    IncidentMonitor, MetricsServer, TAXONOMY, health_snapshot,
+    merge_flight_dumps,
+)
+from peritext_tpu.obs.__main__ import main as obs_main
+from peritext_tpu.obs.exporters import build_info, prometheus_text
+from peritext_tpu.obs.incidents import Incident
+from peritext_tpu.obs.recorder import FlightRecorder
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: open -> ack -> resolve with two-watermark hysteresis
+# ---------------------------------------------------------------------------
+
+
+class TestLifecycle:
+    def test_open_resolve_and_time_to_detection(self):
+        m = IncidentMonitor(host="h", open_after=1, clear_after=2)
+        fault_round = m.rounds
+        m.raise_signal("shed-storm", host="h0", value=4)
+        opened = m.advance_round()
+        assert [i.kind for i in opened] == ["shed-storm"]
+        assert m.open_incidents()[0].status == "open"
+        m.advance_round()  # quiet 1
+        assert m.open_incidents(), "one quiet round must not resolve yet"
+        m.advance_round()  # quiet 2 == clear_after
+        assert not m.open_incidents()
+        assert m.time_to_detection("shed-storm", fault_round) == 1
+        assert m.incident_kinds() == ["shed-storm"]
+
+    def test_open_after_high_watermark(self):
+        m = IncidentMonitor(host="h", open_after=3, clear_after=2)
+        for n in range(2):
+            m.raise_signal("slo-burn", value=2.0)
+            assert m.advance_round() == [], f"round {n} is below the streak"
+        m.raise_signal("slo-burn", value=2.0)
+        assert [i.kind for i in m.advance_round()] == ["slo-burn"]
+        # a break in the streak resets it
+        m2 = IncidentMonitor(host="h", open_after=2, clear_after=1)
+        m2.raise_signal("slo-burn", value=2.0)
+        m2.advance_round()
+        m2.advance_round()  # gap
+        m2.raise_signal("slo-burn", value=2.0)
+        assert m2.advance_round() == [], "the gap must reset the streak"
+
+    def test_flap_suppression_re_arms_open_incident(self):
+        # the low watermark counts ANY re-fire of an open incident's keys
+        # (even sub-threshold flaps) as activity: a flapping signal must
+        # re-arm the ONE open incident, never resolve-then-remint
+        m = IncidentMonitor(host="h", open_after=2, clear_after=2)
+        for _ in range(2):
+            m.raise_signal("shed-storm", host="h0")
+            m.advance_round()
+        assert len(m.open_incidents()) == 1
+        for _ in range(6):  # flap: fire every other round, below open_after
+            m.raise_signal("shed-storm", host="h0")
+            m.advance_round()
+            m.advance_round()
+        assert len(m.incidents()) == 1, "flapping minted a second incident"
+        assert len(m.open_incidents()) == 1
+        m.advance_round()
+        m.advance_round()
+        assert not m.open_incidents(), "true quiet must still resolve"
+
+    def test_ack_is_open_only_and_resolve_is_terminal(self):
+        m = IncidentMonitor(host="h", clear_after=1)
+        m.raise_signal("divergence", host="p")
+        inc = m.advance_round()[0]
+        inc.ack(m.rounds)
+        assert inc.status == "ack"
+        m.advance_round()
+        assert inc.status == "resolved"
+        inc.ack(m.rounds)
+        assert inc.status == "resolved", "ack must not reopen resolved"
+
+    def test_unknown_kind_rejected(self):
+        m = IncidentMonitor()
+        with pytest.raises(ValueError):
+            m.raise_signal("made-up-kind")
+
+
+# ---------------------------------------------------------------------------
+# causal correlation + root-cause ordering
+# ---------------------------------------------------------------------------
+
+
+class TestCorrelation:
+    def test_shared_host_window_collapses_to_one_incident(self):
+        m = IncidentMonitor(host="h", clear_after=8, correlation_window=4)
+        m.raise_signal("shed-storm", host="h0", doc="d1", value=5)
+        m.advance_round()
+        m.raise_signal("slo-burn", host="h0", value=9)
+        m.advance_round()
+        assert len(m.incidents()) == 1, "same-host signals must correlate"
+        inc = m.incidents()[0]
+        # largest delta wins the root-cause slot regardless of taxonomy
+        assert inc.kind == "slo-burn"
+        kinds = [c.kind for c in inc.candidates()]
+        assert kinds == ["slo-burn", "shed-storm"]
+
+    def test_tie_breaks_to_earliest_taxonomy_entry(self):
+        m = IncidentMonitor(host="h", clear_after=8)
+        m.raise_signal("slo-burn", host="h0", value=5)
+        m.raise_signal("shed-storm", host="h0", value=5)
+        m.advance_round()
+        inc = m.incidents()[0]
+        # equal magnitudes: the earlier TAXONOMY entry is the root cause
+        assert TAXONOMY.index("shed-storm") < TAXONOMY.index("slo-burn")
+        assert inc.kind == "shed-storm"
+
+    def test_outside_window_opens_a_fresh_incident(self):
+        m = IncidentMonitor(host="h", clear_after=1, correlation_window=2)
+        m.raise_signal("shed-storm", host="h0")
+        m.advance_round()
+        for _ in range(4):  # resolve + age past the window
+            m.advance_round()
+        m.raise_signal("slo-burn", host="h0")
+        m.advance_round()
+        assert len(m.incidents()) == 2
+
+    def test_disjoint_hosts_do_not_correlate(self):
+        m = IncidentMonitor(host="h", clear_after=8)
+        m.raise_signal("shed-storm", host="h0")
+        m.advance_round()
+        m.raise_signal("slo-burn", host="h1")
+        m.advance_round()
+        assert len(m.incidents()) == 2
+
+    def test_shared_trace_correlates_across_hosts(self):
+        m = IncidentMonitor(host="h", clear_after=8)
+        m.raise_signal("shed-storm", host="h0", trace="t1")
+        m.advance_round()
+        m.raise_signal("slo-burn", host="h1", trace="t1")
+        m.advance_round()
+        assert len(m.incidents()) == 1
+        assert m.incidents()[0].hosts == ["h0", "h1"]
+
+
+# ---------------------------------------------------------------------------
+# determinism: two monitors, one truth
+# ---------------------------------------------------------------------------
+
+
+def _feed(m: IncidentMonitor, quiet: int = 3) -> None:
+    m.observe_leases({"leases": {"h1": {"verdict": "dead", "missed": 3}}})
+    m.observe_serve({"host": "h0", "recent_sheds": 7, "overloaded": True})
+    m.advance_round()
+    m.observe_latency({"slo": {"burn_rate": 2.5, "breaches": 4}})
+    m.advance_round()
+    m.observe_sentinel({"total": 9})
+    m.observe_supervisor({"rollbacks": 2, "quarantined": {"3": {}}})
+    m.advance_round()
+    for _ in range(quiet):
+        m.advance_round()
+
+
+class TestDeterminism:
+    def test_two_monitors_byte_identical(self):
+        a, b = IncidentMonitor(host="h"), IncidentMonitor(host="h")
+        _feed(a)
+        _feed(b)
+        assert a.incidents_json() == b.incidents_json()
+        assert a.digest() == b.digest()
+        assert a.wire_summary() == b.wire_summary()
+
+    def test_ack_is_local_and_digest_normalizes_it(self):
+        a, b = IncidentMonitor(host="h"), IncidentMonitor(host="h")
+        _feed(a, quiet=0)
+        _feed(b, quiet=0)
+        open_a = a.open_incidents()
+        assert open_a, "the feed must leave something open to ack"
+        open_a[0].ack(a.rounds)
+        assert a.digest() == b.digest(), "an operator ack must not fork views"
+
+    def test_wire_summary_roundtrip_and_peer_agreement(self):
+        # the SAME host label: observation-derived digests only agree when
+        # the monitors were fed identical signals (host rides the signals)
+        a, b = IncidentMonitor(host="h"), IncidentMonitor(host="h")
+        _feed(a)
+        _feed(b)
+        parsed = b.parse_wire_summary(a.wire_summary())
+        assert parsed["open"] == len(a.open_incidents())
+        assert parsed["digest"] == a.digest() & 0xFFFFFFFF
+        b.observe_peer_summary("a", a.wire_summary())
+        snap = b.snapshot()
+        assert snap["peers"]["a"]["agree"] is True
+
+    def test_summary_rides_the_frontier_nul_sentinel(self):
+        from peritext_tpu.parallel.multihost import (
+            _frontier_meta, _parse_frontier,
+        )
+
+        m = IncidentMonitor(host="h")
+        _feed(m)
+        meta = _frontier_meta(None, None, incidents=m.wire_summary())
+        body = json.dumps({"actor": 3, **meta}).encode("utf-8")
+        clock, parsed = _parse_frontier(body)
+        assert clock == {"actor": 3}, "sentinels must never pollute the clock"
+        assert parsed["incidents"] == m.wire_summary()
+
+
+# ---------------------------------------------------------------------------
+# feeds
+# ---------------------------------------------------------------------------
+
+
+class TestFeeds:
+    def test_fleet_feed_resolves_post_heal_not_post_reset(self):
+        m = IncidentMonitor(host="h", clear_after=2)
+        dead = {
+            "leases": {"leases": {"h1": {"verdict": "dead", "missed": 2}}},
+            "serving": {"d0": "h1"},
+            "failed_docs": [],
+        }
+        m.observe_fleet(dead)
+        m.advance_round()
+        assert [i.kind for i in m.open_incidents()] == ["host-death"]
+        healed = {  # lease still latched dead, docs re-homed by failover
+            "leases": {"leases": {"h1": {"verdict": "dead", "missed": 2}}},
+            "serving": {"d0": "h2"},
+            "failed_docs": [],
+        }
+        for _ in range(3):
+            m.observe_fleet(healed)
+            m.advance_round()
+        assert not m.open_incidents(), "failover completing IS the heal"
+
+    def test_fleet_feed_migration_failure(self):
+        m = IncidentMonitor(host="h", clear_after=1)
+        m.observe_fleet({"leases": {"leases": {}}, "serving": {},
+                         "failed_docs": [], "migration_rollbacks": 2})
+        m.advance_round()
+        assert m.incident_kinds() == ["migration-failure"]
+
+    def test_sentinel_feed_needs_a_storm_not_a_compile(self):
+        m = IncidentMonitor(host="h", compile_storm_threshold=3)
+        m.observe_sentinel({"total": 2})
+        assert m.advance_round() == []
+        m.observe_sentinel({"total": 7})  # +5 in one observation window
+        assert [i.kind for i in m.advance_round()] == ["recompile-storm"]
+
+    def test_convergence_feed_is_delta_triggered(self):
+        m = IncidentMonitor(host="h", clear_after=1)
+        snap = {"divergence_incidents": 1, "divergent_peers": ["p1"]}
+        m.observe_convergence(snap)
+        m.advance_round()
+        assert m.incident_kinds() == ["divergence"]
+        for _ in range(2):  # the latched flag must not re-raise
+            m.observe_convergence(snap)
+            m.advance_round()
+        assert not m.open_incidents()
+
+    def test_perf_feed_magnitude_is_worst_regression(self):
+        m = IncidentMonitor(host="h")
+        m.observe_perf({"regressed": True, "rows": [
+            {"name": "a", "status": "regressed", "delta_pct": -4.0},
+            {"name": "b", "status": "regressed", "delta_pct": 11.5},
+            {"name": "c", "status": "ok", "delta_pct": 0.1},
+        ]})
+        inc = m.advance_round()[0]
+        assert inc.kind == "perf-regression"
+        assert inc.candidates()[0].value == 11.5
+
+    def test_arming_compiles_nothing(self):
+        from peritext_tpu.obs.sentinel import RecompileSentinel
+
+        with RecompileSentinel() as sentinel:
+            before = sentinel.total
+            m = IncidentMonitor(host="h")
+            _feed(m)
+            m.snapshot()
+            m.incidents_json()
+            assert sentinel.total == before, (
+                "arming/feeding the incident plane dispatched XLA compiles"
+            )
+
+
+# ---------------------------------------------------------------------------
+# surfaces: /incidents.json, gauges, health_snapshot, build info
+# ---------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    def test_incidents_json_golden_shape(self):
+        m = IncidentMonitor(host="h")
+        _feed(m)
+        snap = m.snapshot()
+        for key in ("host", "rounds", "open", "acked", "resolved", "total",
+                    "by_kind", "digest", "open_after", "clear_after",
+                    "correlation_window", "peers", "incidents"):
+            assert key in snap, f"/incidents.json lost its {key!r} key"
+        assert set(snap["by_kind"]) == set(TAXONOMY)
+        inc = snap["incidents"][0]
+        for key in ("id", "kind", "status", "hosts", "docs", "opened_round",
+                    "resolved_round", "signals", "candidates"):
+            assert key in inc
+        json.dumps(snap)  # the body must be JSON-serializable as-is
+
+    def test_prometheus_incident_gauges(self):
+        m = IncidentMonitor(host="h")
+        _feed(m)
+        text = prometheus_text(incidents=m)
+        assert "peritext_incident_open " in text
+        assert "peritext_incident_resolved " in text
+        assert "peritext_incident_total " in text
+        assert "peritext_incident_digest " in text
+        # the by-kind family covers the FULL taxonomy (zeros included) so
+        # alert rules never reference a gauge that vanishes when quiet
+        for kind in TAXONOMY:
+            assert f'peritext_incident_open_by_kind{{kind="{kind}"}}' in text
+        for line in text.splitlines():
+            assert line.startswith("#") or len(line.split()) == 2
+
+    def test_build_info_gauge_in_every_exposition(self):
+        text = prometheus_text()
+        assert "peritext_build_info{" in text
+        info = build_info()
+        for key in ("sha", "wire_caps", "jax", "device"):
+            assert key in info
+
+    def test_health_snapshot_carries_incidents(self):
+        m = IncidentMonitor(host="h")
+        _feed(m)
+        snap = health_snapshot(incidents=m)
+        assert snap["incidents"]["total"] == m.snapshot()["total"]
+
+    def test_metrics_server_incidents_route(self):
+        m = IncidentMonitor(host="h")
+        _feed(m)
+        server = MetricsServer(incidents=m)
+        host, port = server.start()
+        try:
+            url = f"http://{host}:{port}/incidents.json"
+            with urllib.request.urlopen(url) as resp:
+                body = json.loads(resp.read())
+            assert body["host"] == "h" and body["total"] >= 1
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics"
+            ) as resp:
+                text = resp.read().decode()
+            assert "peritext_incident_open " in text
+            assert "peritext_build_info{" in text
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# the merged black-box timeline
+# ---------------------------------------------------------------------------
+
+
+class TestMergeFlightDumps:
+    def _dump(self, tmp_path, host, records, reason="boom"):
+        rec = FlightRecorder(dump_dir=tmp_path, host=host,
+                             min_dump_interval=0.0)
+        for kind, fields in records:
+            rec.record(kind, **fields)
+        return rec.dump(reason=reason)
+
+    def test_host_attribution_and_trace_grouping(self, tmp_path):
+        self._dump(tmp_path, "hostA",
+                   [("span", {"name": "commit", "trace_id": "t9"})])
+        self._dump(tmp_path, "hostB",
+                   [("fault", {"reason": "rollback", "trace_id": "t9"})])
+        merged = merge_flight_dumps(tmp_path.glob("flight-*.jsonl"))
+        assert merged["hosts"] == ["hostA", "hostB"]
+        assert merged["records"] == 2
+        hosts_in_trace = {r["host"] for r in merged["traces"]["t9"]}
+        assert hosts_in_trace == {"hostA", "hostB"}
+
+    def test_overlapping_dumps_deduplicate_by_seq(self, tmp_path):
+        rec = FlightRecorder(dump_dir=tmp_path, host="hostA",
+                             min_dump_interval=0.0)
+        rec.record("span", name="a")
+        rec.dump(reason="first")
+        rec.record("span", name="b")
+        rec.dump(reason="second")  # carries the whole ring again
+        merged = merge_flight_dumps(tmp_path.glob("flight-*.jsonl"))
+        assert merged["records"] == 2, "ring overlap must dedup, not double"
+
+    def test_legacy_hostless_filenames_still_merge(self, tmp_path):
+        path = tmp_path / "flight-123-000001-crash.jsonl"
+        path.write_text(
+            json.dumps({"kind": "dump", "reason": "crash", "records": 1})
+            + "\n" + json.dumps({"seq": 1, "ts": 1.0, "kind": "fault"})
+            + "\n"
+        )
+        merged = merge_flight_dumps([path])
+        assert merged["hosts"] == ["?"]
+        assert merged["records"] == 1
+
+    def test_unreadable_lines_counted_not_fatal(self, tmp_path):
+        path = tmp_path / "flight-hostA-1-000001-x.jsonl"
+        path.write_text("not json\n" + json.dumps(
+            {"seq": 1, "ts": 1.0, "kind": "span"}) + "\n")
+        merged = merge_flight_dumps([path])
+        assert merged["skipped"] == 1 and merged["records"] == 1
+
+    def test_incident_open_triggers_dump(self, tmp_path):
+        rec = FlightRecorder(dump_dir=tmp_path, host="h0",
+                             min_dump_interval=0.0)
+        m = IncidentMonitor(host="h0", recorder=rec)
+        m.raise_signal("shed-storm", host="h0", value=2)
+        m.advance_round()
+        dumps = list(tmp_path.glob("flight-h0-*-incident-shed-storm.jsonl"))
+        assert dumps, "an incident open must dump the black box"
+        m.raise_signal("shed-storm", host="h0", value=2)
+        m.advance_round()
+        assert len(list(tmp_path.glob("flight-*.jsonl"))) == len(dumps), (
+            "re-fires of an open incident must not dump again"
+        )
+
+
+# ---------------------------------------------------------------------------
+# the CLI: incidents / status / flight exit contracts
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def _snap_file(self, tmp_path, name="incidents.json", feed=True):
+        m = IncidentMonitor(host="h")
+        if feed:
+            _feed(m)
+        else:
+            m.advance_round()
+        path = tmp_path / name
+        path.write_text(json.dumps(m.snapshot()))
+        return path, m
+
+    def test_incidents_exit_codes(self, tmp_path, capsys):
+        path, m = self._snap_file(tmp_path)
+        expect = 1 if m.open_incidents() else 0
+        assert obs_main(["incidents", str(path)]) == expect
+        out = capsys.readouterr().out
+        assert "monitor(s)" in out
+        clean, _ = self._snap_file(tmp_path, "clean.json", feed=False)
+        assert obs_main(["incidents", str(clean)]) == 0
+        assert obs_main(["incidents", str(tmp_path / "missing.json")]) == 2
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        assert obs_main(["incidents", str(bad)]) == 2
+
+    def test_incidents_reads_health_bodies(self, tmp_path):
+        m = IncidentMonitor(host="h")
+        _feed(m)
+        path = tmp_path / "health.json"
+        path.write_text(json.dumps(health_snapshot(incidents=m)))
+        expect = 1 if m.open_incidents() else 0
+        assert obs_main(["incidents", str(path)]) == expect
+
+    def test_status_composite_over_snapshot_dir(self, tmp_path, capsys):
+        m = IncidentMonitor(host="h")
+        _feed(m)
+        (tmp_path / "incidents.json").write_text(json.dumps(m.snapshot()))
+        (tmp_path / "serve.json").write_text(json.dumps({
+            "sessions": 1, "overloaded": False, "recent_sheds": 0,
+            "queue": {"depth": 0, "max_depth": 8, "backpressure": False,
+                      "verdicts": {"shed": 0}},
+        }))
+        code = obs_main(["status", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert code == (1 if m.open_incidents() else 0)
+        assert "serve" in out and "incidents" in out
+        empty = tmp_path / "nothing"
+        assert obs_main(["status", str(empty)]) == 2
+
+    def test_status_against_live_metrics_server(self, tmp_path, capsys):
+        m = IncidentMonitor(host="h")
+        m.advance_round()  # clean monitor -> clean plane
+        server = MetricsServer(incidents=m)
+        host, port = server.start()
+        try:
+            code = obs_main(["status", f"http://{host}:{port}"])
+        finally:
+            server.stop()
+        out = capsys.readouterr().out
+        assert code == 0, out
+        assert "incidents" in out and "health" in out
+
+    def test_flight_merged_timeline(self, tmp_path, capsys):
+        rec = FlightRecorder(dump_dir=tmp_path, host="hostA",
+                             min_dump_interval=0.0)
+        rec.record("span", name="commit", trace_id="t1")
+        rec.dump(reason="probe")
+        assert obs_main(["flight", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "hostA" in out and "commit" in out
+        assert obs_main(["flight", str(tmp_path / "nope")]) == 2
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert obs_main(["flight", str(empty)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# incident primitives
+# ---------------------------------------------------------------------------
+
+
+class TestIncidentPrimitives:
+    def test_candidate_ordering_rest_sorted_by_magnitude(self):
+        inc = Incident("INC-0001", 1)
+        inc.attach("slo-burn", "h0", None, None, 3.0, {}, 1)
+        inc.attach("shed-storm", "h0", None, None, 9.0, {}, 1)
+        inc.attach("recompile-storm", "h1", None, None, 5.0, {}, 2)
+        kinds = [c.kind for c in inc.candidates()]
+        assert kinds[0] == "shed-storm"  # largest delta
+        assert kinds[1:] == ["recompile-storm", "slo-burn"]
+
+    def test_to_json_is_stable(self):
+        inc = Incident("INC-0001", 1)
+        inc.attach("divergence", "p", "d", "t", 2.0, {"x": 1}, 1)
+        assert json.loads(json.dumps(inc.to_json())) == inc.to_json()
