@@ -10,7 +10,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 	clean obs fleet perf-gate serve-smoke bench-serve paged-smoke bench-longdoc \
 	fused-smoke fleet-serve-smoke bench-fleet-serve bench-markheavy \
 	ragged-smoke plan-smoke bench-serve-fused mesh-smoke bench-mesh \
-	latency-smoke incident-smoke
+	latency-smoke incident-smoke history-smoke
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -71,6 +71,15 @@ latency-smoke:
 # plane compiles ZERO XLA programs (artifacts land in /tmp/pt-incident)
 incident-smoke:
 	$(CPU_ENV) $(PY) scripts/incident_smoke.py --out /tmp/pt-incident
+
+# fleet history-plane smoke (mirrors the CI history-smoke job): an armed
+# serve session retains frames, rolls JSONL segments over, and replays
+# them byte-identically with ZERO XLA compiles; the serve-overload chaos
+# episode scores as an anomaly no later than its incident opens; the
+# `obs history` exit contract (0/1/2) holds; and the history-weighted
+# `obs plan` replay is deterministic (artifacts land in /tmp/pt-history)
+history-smoke:
+	$(CPU_ENV) $(PY) scripts/history_smoke.py --out /tmp/pt-history
 
 # sustained open-loop serving ladder: docs/s at the p99 apply-latency SLO
 bench-serve:

@@ -1382,10 +1382,15 @@ def run_serve(args) -> dict:
     # row's decomposition carries the full admit→visibility story, and
     # the sum-consistency oracle is asserted IN-ROW.
     from peritext_tpu.obs.latency import LatencyPlane
+    from peritext_tpu.obs.timeseries import TimeSeriesPlane
     from peritext_tpu.serve import build_arrivals, run_open_loop
 
     tmux, tframes = mux_factory()
     tmux.latency_plane = LatencyPlane().enable()
+    # the history plane rides the same traced rung: one retained frame
+    # per settled batch, so the row carries the trend view's raw feed
+    hist = TimeSeriesPlane(sample_every=1, min_frames=4)
+    tmux.history_plane = hist.enable()
     trace_rate = max(base, value / 2.0) if value else base
     traced = run_open_loop(
         tmux, build_arrivals(tframes, trace_rate, duration),
@@ -1398,6 +1403,9 @@ def run_serve(args) -> dict:
     assert lat["sum_consistent"], f"latency decomposition inconsistent: {lat}"
     assert all(v >= 0 for v in lat["stages_ms"].values()), (
         f"negative stage duration: {lat['stages_ms']}"
+    )
+    assert hist.frames_sampled > 0, (
+        "armed history plane retained no frames in the traced rung"
     )
 
     return {
@@ -1416,6 +1424,12 @@ def run_serve(args) -> dict:
         # every offered rate sustained: the true ceiling is above the sweep
         "ladder_exhausted": broke is None,
         "latency": lat,
+        "history": {
+            "frames_sampled": hist.frames_sampled,
+            "frames_retained": sum(hist.snapshot()["tier_frames"]),
+            "rounds": hist.rounds,
+            "anomalies_total": hist.anomalies_total,
+        },
         "traced_rate_per_s": round(trace_rate, 1),
         "rungs": [r.to_json() for r in rungs],
         "window": (best.result.window_seconds if best is not None else None),
@@ -1569,8 +1583,14 @@ def run_serve_fused(args) -> dict:
     # decomposition spans the whole tenant fleet, and the patch-equality
     # reads below double as the visibility watermark
     from peritext_tpu.obs.latency import LatencyPlane
+    from peritext_tpu.obs.timeseries import TimeSeriesPlane
 
     plane = LatencyPlane().enable()
+    # ...and ONE history plane: pump() feeds it an occupancy row per lane
+    # per committed window — the raw material `propose(history=...)`
+    # weights the cost model by (the closed planner loop)
+    hist = TimeSeriesPlane(sample_every=1, min_frames=4)
+    group.history = hist.enable()
     for n in names:
         group.muxes[n].latency_plane = plane
     fused_dispatches, fused_wall = drive_group(group, gsids)
@@ -1596,6 +1616,10 @@ def run_serve_fused(args) -> dict:
     assert all(v >= 0 for v in lat["stages_ms"].values()), (
         f"negative stage duration: {lat['stages_ms']}"
     )
+    occ_rows = hist.occupancy_rows()
+    assert occ_rows, (
+        "armed history plane recorded no fused occupancy rows"
+    )
     return {
         "metric": "serve_multitenant_dispatch_amortization",
         "value": round(amortization, 2),
@@ -1617,6 +1641,8 @@ def run_serve_fused(args) -> dict:
         ),
         "byte_equal": True,
         "latency": lat,
+        "history_occupancy_rows": len(occ_rows),
+        "history_occupancy": hist.snapshot()["occupancy"]["distribution"],
         "docs_per_dispatch": fusion["docs_per_dispatch"],
         "window_occupancy": fusion["window_occupancy"],
         "platform": jax.devices()[0].platform,

@@ -36,8 +36,15 @@ class LintConfig:
     #: the cost model (plan/model.py, plan/tuner.py) is observability —
     #: wall-clock reads are legal — but plan/fusion.py assembles the
     #: cross-tenant fusion groups that decide device dispatch order, so it
-    #: must stay deterministic like the merge kernels it feeds
-    merge_scope_files: frozenset = frozenset({"plan/fusion.py"})
+    #: must stay deterministic like the merge kernels it feeds.  obs/ has
+    #: the same split: every other obs module reads clocks freely (that's
+    #: the design rule — clock reads live THERE), but obs/timeseries.py is
+    #: the round-counted history plane whose retention/anomaly scoring
+    #: must replay byte-identically, so it joins the merge scope and its
+    #: sampling overhead is fed in as data via note_overhead()
+    merge_scope_files: frozenset = frozenset(
+        {"plan/fusion.py", "obs/timeseries.py"}
+    )
     #: functions that route a raw length into the padded-shape tables;
     #: shapes wrapped in one of these never recompile (streaming.py's
     #: ``_width_bucket`` is the canonical instance)

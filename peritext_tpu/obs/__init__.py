@@ -46,6 +46,17 @@ every streaming-perf PR is judged by.  Four cooperating pieces:
   frontier-sentinel summary so two frontends agree on the incident view;
   plus :func:`merge_flight_dumps`, the cross-host black-box timeline
   (``python -m peritext_tpu.obs incidents`` / ``status`` / ``flight``).
+* :mod:`.timeseries` — the fleet history plane: a deterministic,
+  round-counted :class:`TimeSeriesPlane` that periodically samples every
+  plane above into min/max/last frames retained across downsampling
+  tiers (recent full-rate, older merged N:1 so spikes survive), persists
+  append-only JSONL segments that replay byte-identically, scores a
+  rolling-median + MAD anomaly per gauge key (findings feed the incident
+  monitor as its ninth signal source), and records the fused serving
+  tier's per-window occupancy rows — the ``propose(history=...)``
+  feedback loop (``peritext_history_*``, ``/timeseries.json``,
+  ``python -m peritext_tpu.obs history`` / ``top``).  Off by default;
+  ``GLOBAL_HISTORY.enable()`` arms the serve-tier hooks.
 * :mod:`.exporters` — Prometheus text exposition and JSON snapshot
   endpoints (:class:`MetricsServer`, mounted by ``ReplicaServer``:
   ``/metrics`` with ``peritext_convergence_*`` gauges, ``/health.json``,
@@ -102,6 +113,12 @@ from .spans import (
     merge_traces,
 )
 from .stats import MergeStats
+from .timeseries import (
+    GLOBAL_HISTORY,
+    TimeSeriesPlane,
+    anomaly_kind,
+    replay_segments,
+)
 from .exporters import MetricsServer, prometheus_text
 
 __all__ = [
@@ -114,6 +131,7 @@ __all__ = [
     "GLOBAL_COUNTERS",
     "GLOBAL_DEVPROF",
     "GLOBAL_HISTOGRAMS",
+    "GLOBAL_HISTORY",
     "GLOBAL_LATENCY",
     "GLOBAL_TRACER",
     "Histogram",
@@ -130,9 +148,11 @@ __all__ = [
     "STAGES",
     "Span",
     "TAXONOMY",
+    "TimeSeriesPlane",
     "TraceContext",
     "Tracer",
     "ambient_parent",
+    "anomaly_kind",
     "attribute",
     "check_sum_consistency",
     "current_span",
@@ -143,4 +163,5 @@ __all__ = [
     "occupancy_key",
     "profile_trace",
     "prometheus_text",
+    "replay_segments",
 ]

@@ -55,9 +55,31 @@ command doubles as a fleet incident check.
 The ``status`` command is the one-look roll-up: given a live
 MetricsServer base URL (``http://host:port``) or a snapshot directory
 (``health.json`` / ``convergence.json`` / ``serve.json`` /
-``fleet.json`` / ``latency.json`` / ``incidents.json``), it renders one
+``fleet.json`` / ``latency.json`` / ``incidents.json`` /
+``devprof.json`` / ``plan.json`` / ``timeseries.json`` /
+``trace.json``), it renders one
 table over every plane present and exits with the COMPOSITE of the
-per-plane CLI contracts (the worst plane wins).
+per-plane CLI contracts (the worst plane wins).  Every JSON endpoint
+the MetricsServer can mount has a row here — the surface-mount audit
+test pins that equivalence.
+
+The ``history`` command reads the history plane (a ``/timeseries.json``
+scrape, a snapshot directory holding ``timeseries.json`` or
+``history.json``, a ``health.json`` body carrying a ``history`` key, or
+a direct file path) and renders the retained trend: by default a
+per-gauge-key table (points, first → last, delta, min/max envelope)
+sorted so the biggest movers lead; ``--key`` renders one gauge's
+``[round, value]`` points instead (``--rate`` adds the per-round
+derivative, ``--window N`` limits to the trailing N frames).  Exit 1
+while any anomaly finding is active — the command doubles as a fleet
+drift check.
+
+The ``top`` command is the single-refresh fleet dashboard: the
+``status`` roll-up table composed with the history plane's biggest
+recent movers and its active anomaly findings — one look at what is
+unhealthy NOW next to what has been drifting.  Exits like ``status``
+(the worst plane wins; an active anomaly surfaces through the
+``timeseries`` plane row).
 
 The ``flight`` command reads a directory of flight-recorder dumps
 (``flight-<host>-<pid>-<n>-<reason>.jsonl``) and renders the merged
@@ -78,16 +100,21 @@ Usage::
     python -m peritext_tpu.obs incidents hostA-incidents.json hostB.json
     python -m peritext_tpu.obs status http://127.0.0.1:9100
     python -m peritext_tpu.obs status snapshot-dir/
+    python -m peritext_tpu.obs history http://127.0.0.1:9100
+    python -m peritext_tpu.obs history snapshot-dir/ --key serve.queue.depth
+    python -m peritext_tpu.obs top http://127.0.0.1:9100
     python -m peritext_tpu.obs flight dump-dir/
 
 ``summary`` is the default command (``python -m peritext_tpu.obs t.json``
 works).  Exit codes: 0 ok (fleet: converged; serve: healthy; perf: no
 regression; why: clean; plan: statics within tolerance; incidents: none
-open; status: every plane clean), 1 no spans
+open; status/top: every plane clean; history: no active anomaly), 1 no
+spans
 found / fleet has lag or divergence / serve has overload or shedding /
 perf ``--gate`` regression / why regression (attributed or not) / plan
 proposal beats the current statics beyond tolerance / open incidents /
-any plane in the status roll-up unhealthy, 2 unreadable input.
+any plane in the status or top roll-up unhealthy / an active history
+anomaly, 2 unreadable input.
 """
 
 from __future__ import annotations
@@ -419,6 +446,56 @@ def _eval_incidents(doc: Dict) -> tuple:
                   + (f" · {kinds}" if kinds else ""))
 
 
+def _eval_devprof(doc: Dict) -> tuple:
+    sites = doc.get("sites", {}) or {}
+    dispatches = sum(int(r.get("dispatches", 0)) for r in sites.values())
+    tot = doc.get("occupancy_totals", {}) or {}
+    # informational: the profiler reports cost, it has no health verdict
+    return 0, (f"{len(sites)} jit site(s) · dispatches {dispatches} · "
+               f"padding_waste {tot.get('padding_waste', 0)}")
+
+
+def _eval_plan(doc: Dict) -> tuple:
+    modeled = doc.get("modeled", {}) or {}
+    cur = modeled.get("current_score") or 0
+    new = modeled.get("proposed_score")
+    tol = modeled.get("tolerance", 0.1)
+    # the `plan` command's own contract: stale statics are exit 1
+    stale = bool(cur) and new is not None and (cur - new) / cur > tol
+    hist = modeled.get("history") or {}
+    return (1 if stale else 0), (
+        f"score {cur} -> {new} · "
+        f"savings {modeled.get('savings_frac', 0)}"
+        + (f" · history rows {hist.get('rows')}" if hist else "")
+        + (" · STALE STATICS" if stale else "")
+    )
+
+
+def _eval_timeseries(doc: Dict) -> tuple:
+    anomaly = doc.get("anomaly", {}) or {}
+    active = anomaly.get("active") or []
+    kinds = ",".join(sorted({a.get("kind", "?") for a in active}))
+    return (1 if active else 0), (
+        f"rounds {doc.get('rounds', 0)} · "
+        f"frames {doc.get('frames_retained', 0)} · "
+        f"segments {doc.get('segments', 0)} · "
+        f"{len(active)} active anomaly(ies)"
+        + (f" · {kinds}" if kinds else "")
+    )
+
+
+def _eval_trace(doc) -> tuple:
+    events = doc.get("traceEvents", []) if isinstance(doc, dict) else (doc or [])
+    spans = sum(1 for e in events
+                if isinstance(e, dict) and e.get("ph") == "X")
+    # informational: a trace dump is evidence, not a verdict
+    return 0, f"{len(events)} event(s) · {spans} span(s)"
+
+
+#: every JSON endpoint MetricsServer can mount has a row here — the
+#: surface-mount audit test (tests/test_obs_surface.py) pins route stems
+#: == status plane stems, so adding an endpoint without a status row (or
+#: vice versa) fails loudly
 _STATUS_PLANES = (
     ("health", _eval_health),
     ("convergence", _eval_convergence),
@@ -426,6 +503,10 @@ _STATUS_PLANES = (
     ("fleet", _eval_fleet),
     ("latency", _eval_latency),
     ("incidents", _eval_incidents),
+    ("devprof", _eval_devprof),
+    ("plan", _eval_plan),
+    ("timeseries", _eval_timeseries),
+    ("trace", _eval_trace),
 )
 
 
@@ -451,13 +532,15 @@ def _status_source(src: str, plane: str):
     return json.loads(path.read_text())
 
 
-def _status_command(args) -> int:
-    """The one-look fleet roll-up (see module doc)."""
+def _status_rows(src: str) -> tuple:
+    """Evaluate every mounted plane at ``src`` — shared by ``status``
+    and ``top``.  Returns ``(rows, codes)``; absent planes are skipped,
+    present-but-unreadable ones render as exit-2 rows."""
     rows = []
     codes = []
     for plane, evaluator in _STATUS_PLANES:
         try:
-            doc = _status_source(args.src, plane)
+            doc = _status_source(src, plane)
         except Exception as exc:  # noqa: BLE001 - every failure renders as a row
             rows.append({"plane": plane, "status": "UNREADABLE",
                          "exit": 2, "summary": str(exc)})
@@ -465,10 +548,6 @@ def _status_command(args) -> int:
             continue
         if doc is None:
             continue
-        if plane == "health" and isinstance(doc.get("incidents"), dict):
-            # a health body composes the other planes; prefer dedicated
-            # sources but don't double-render what health already carries
-            pass
         code, summary = evaluator(doc)
         rows.append({
             "plane": plane,
@@ -477,6 +556,12 @@ def _status_command(args) -> int:
             "summary": summary,
         })
         codes.append(code)
+    return rows, codes
+
+
+def _status_command(args) -> int:
+    """The one-look fleet roll-up (see module doc)."""
+    rows, codes = _status_rows(args.src)
     if not rows:
         print(f"status: no plane snapshots found at {args.src} "
               "(expected <plane>.json files or MetricsServer routes)",
@@ -491,6 +576,187 @@ def _status_command(args) -> int:
         print(render_table(rows, cols=["plane", "status", "exit", "summary"],
                            left_cols=2))
     # composite contract: the worst per-plane exit code wins
+    return max(codes)
+
+
+# -- history view (/timeseries.json scrapes) ---------------------------------
+
+
+def _load_history(src: str) -> Dict:
+    """The history plane's snapshot from a MetricsServer base URL, a
+    snapshot directory (``timeseries.json`` or ``history.json``), a
+    ``health.json`` body carrying a ``history`` key, or a direct file."""
+    if src.startswith(("http://", "https://")):
+        doc = _status_source(src, "timeseries")
+        if doc is None:
+            raise ValueError("no /timeseries.json route mounted")
+    else:
+        p = Path(src)
+        if p.is_file():
+            doc = json.loads(p.read_text())
+        else:
+            doc = None
+            for stem in ("timeseries", "history", "health"):
+                f = p / f"{stem}.json"
+                if f.exists():
+                    doc = json.loads(f.read_text())
+                    break
+            if doc is None:
+                raise ValueError(
+                    f"no timeseries.json/history.json under {src}")
+    if (isinstance(doc, dict) and "tiers" not in doc
+            and isinstance(doc.get("history"), dict)):
+        doc = doc["history"]  # health.json composition
+    if not isinstance(doc, dict) or "tiers" not in doc:
+        raise ValueError(f"{src}: not a history-plane snapshot")
+    return doc
+
+
+def _history_command(args) -> int:
+    """Render the history plane's trend view (see module doc)."""
+    from .timeseries import (
+        chronological_frames,
+        key_summary,
+        series_points,
+        series_rate,
+        snapshot_keys,
+    )
+
+    try:
+        snap = _load_history(args.src)
+    except Exception as exc:  # noqa: BLE001 - every failure is one typed exit
+        print(f"unreadable history snapshot {args.src}: {exc}",
+              file=sys.stderr)
+        return 2
+    anomaly = snap.get("anomaly", {}) or {}
+    active = anomaly.get("active") or []
+    frames = chronological_frames(snap)
+    header = (
+        f"{snap.get('host', '?')} · rounds {snap.get('rounds', 0)} · "
+        f"{snap.get('frames_retained', len(frames))} frame(s) across "
+        f"{len(snap.get('tiers') or [])} tier(s) · "
+        f"{snap.get('segments', 0)} segment(s) · "
+        f"{len(active)} active anomaly(ies)"
+    )
+    if args.key:
+        points = series_points(snap, args.key, window=args.window)
+        if not points:
+            print(f"history: no points for key '{args.key}' "
+                  f"({len(snapshot_keys(snap))} keys retained)",
+                  file=sys.stderr)
+            return 2
+        summary = key_summary(snap, args.key, window=args.window)
+        if args.json:
+            body = {"key": args.key, "points": points, "summary": summary,
+                    "anomalies": active}
+            if args.rate:
+                body["rate"] = series_rate(points)
+            print(json.dumps(body, indent=2))
+        else:
+            print(header)
+            rates = {r: v for r, v in series_rate(points)}
+            rows = []
+            for r, v in points:
+                row = {"round": int(r), "value": v}
+                if args.rate:
+                    row["rate"] = rates.get(r, "-")
+                rows.append(row)
+            cols = ["round", "value"] + (["rate"] if args.rate else [])
+            print(render_table(rows, cols=cols, left_cols=0))
+            print(
+                f"{args.key}: min {summary['min']} · max {summary['max']} · "
+                f"p50 {summary['p50']} · p95 {summary['p95']} · "
+                f"delta {summary['delta']}"
+            )
+    else:
+        summaries = [
+            key_summary(snap, key, window=args.window)
+            for key in snapshot_keys(snap)
+        ]
+        summaries = [s for s in summaries if s.get("points")]
+        # the moving gauges lead; ties break on the key itself
+        summaries.sort(key=lambda s: (-abs(s.get("delta") or 0.0), s["key"]))
+        if args.json:
+            print(json.dumps({"src": args.src, "summaries": summaries,
+                              "anomalies": active}, indent=2))
+        else:
+            print(header)
+            rows = [
+                {"key": s["key"], "points": s["points"], "first": s["first"],
+                 "last": s["last"], "delta": s["delta"], "min": s["min"],
+                 "max": s["max"]}
+                for s in summaries
+            ]
+            if rows:
+                print(render_table(
+                    rows, cols=["key", "points", "first", "last", "delta",
+                                "min", "max"], left_cols=1))
+            else:
+                print("no gauge frames retained yet")
+    if active and not args.json:
+        for a in active:
+            print(
+                f"anomaly: {a.get('key')} [{a.get('kind')}] z={a.get('z')} "
+                f"value {a.get('value')} vs median {a.get('median')} "
+                f"@ round {a.get('round')}", file=sys.stderr,
+            )
+    # an active anomaly is exit 1: the command doubles as a fleet drift
+    # check (CI / cron), mirroring serve/fleet/incidents
+    return 1 if active else 0
+
+
+def _top_command(args) -> int:
+    """The single-refresh fleet dashboard (see module doc)."""
+    from .timeseries import key_summary, snapshot_keys
+
+    rows, codes = _status_rows(args.src)
+    if not rows:
+        print(f"top: no plane snapshots found at {args.src} "
+              "(expected <plane>.json files or MetricsServer routes)",
+              file=sys.stderr)
+        return 2
+    try:
+        snap = _load_history(args.src)
+    except Exception:  # noqa: BLE001 - the dashboard degrades to status-only
+        snap = None
+    movers: List[Dict] = []
+    active: List[Dict] = []
+    if snap is not None:
+        anomaly = snap.get("anomaly", {}) or {}
+        active = anomaly.get("active") or []
+        summaries = [key_summary(snap, k, window=args.window)
+                     for k in snapshot_keys(snap)]
+        movers = [s for s in summaries if s.get("points") and s.get("delta")]
+        movers.sort(key=lambda s: (-abs(s.get("delta") or 0.0), s["key"]))
+        movers = movers[:args.top]
+    if args.json:
+        print(json.dumps({
+            "src": args.src, "exit": max(codes), "planes": rows,
+            "movers": movers, "anomalies": active,
+        }, indent=2))
+        return max(codes)
+    print(f"{args.src} · {len(rows)} plane(s) · "
+          f"{sum(1 for c in codes if c)} need attention · "
+          f"{len(active)} active anomaly(ies)")
+    print(render_table(rows, cols=["plane", "status", "exit", "summary"],
+                       left_cols=2))
+    if movers:
+        window = args.window if args.window else "all"
+        print(f"top {len(movers)} mover(s) over the trailing "
+              f"{window} frame(s):")
+        print(render_table(
+            [{"key": s["key"], "first": s["first"], "last": s["last"],
+              "delta": s["delta"]} for s in movers],
+            cols=["key", "first", "last", "delta"], left_cols=1))
+    elif snap is not None:
+        print("history: no gauge movement recorded")
+    else:
+        print("history: plane not mounted (arm GLOBAL_HISTORY to trend)")
+    for a in active:
+        print(f"anomaly: {a.get('key')} [{a.get('kind')}] z={a.get('z')} "
+              f"@ round {a.get('round')}", file=sys.stderr)
+    # status semantics: the worst plane wins (an active anomaly already
+    # surfaces as the timeseries plane's exit-1 row)
     return max(codes)
 
 
@@ -710,10 +976,24 @@ def _plan_command(args) -> int:
             print(f"unreadable perf ledger {args.ledger}: {exc}",
                   file=sys.stderr)
             return 2
+    history = None
+    if getattr(args, "history", None):
+        # a timeseries.json snapshot, a health.json carrying `history`,
+        # or a plain JSON list of occupancy rows/floats — anything
+        # plan.history_values normalizes
+        try:
+            history = json.loads(Path(args.history).read_text())
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"unreadable occupancy history {args.history}: {exc}",
+                  file=sys.stderr)
+            return 2
+        if (isinstance(history, dict) and "occupancy_rows" not in history
+                and isinstance(history.get("history"), dict)):
+            history = history["history"]
     tolerance = (args.tolerance / 100.0 if args.tolerance is not None
                  else None)
     kwargs = {} if tolerance is None else {"tolerance": tolerance}
-    proposal = propose(snapshot, ledger_records, **kwargs)
+    proposal = propose(snapshot, ledger_records, history=history, **kwargs)
     stale = proposal.beats_current(
         tolerance if tolerance is not None else
         proposal.modeled.get("tolerance", 0.1)
@@ -748,6 +1028,17 @@ def _plan_command(args) -> int:
             f"{modeled['dispatches_current']} -> "
             f"{modeled['dispatches_proposed']}"
         )
+        hist = modeled.get("history")
+        if hist:
+            occ = hist.get("occupancy") or {}
+            print(
+                f"history: {hist['rows']} occupancy row(s) · "
+                f"p90 {occ.get('p90')} · sparse_frac "
+                f"{occ.get('sparse_frac')} · dispatch weight "
+                f"x{hist['dispatch_weight_factor']} · "
+                "history-weighted terms: "
+                + ", ".join(hist["weighted_terms"])
+            )
         if stale:
             print(
                 "plan: proposal beats current statics beyond tolerance — "
@@ -766,7 +1057,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # default command: `python -m peritext_tpu.obs trace.json` == summary
     if argv and argv[0] not in ("summary", "merge", "fleet", "serve", "perf",
                                 "plan", "why", "incidents", "status",
-                                "flight", "-h", "--help"):
+                                "history", "top", "flight", "-h", "--help"):
         argv.insert(0, "summary")
     parser = argparse.ArgumentParser(
         prog="python -m peritext_tpu.obs", description=__doc__,
@@ -841,6 +1132,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p_plan.add_argument("--ledger", default=None, metavar="PATH",
                         help="perf-ledger JSONL for the admission-window "
                         "term (optional)")
+    p_plan.add_argument("--history", default=None, metavar="PATH",
+                        help="history-plane snapshot (timeseries.json / "
+                        "health.json) or occupancy-row JSON: weight the "
+                        "cost model by the observed occupancy distribution")
     p_plan.add_argument("--json", action="store_true",
                         help="machine-readable proposal instead of the table")
     p_plan.add_argument("--tolerance", type=float, default=None, metavar="PCT",
@@ -862,6 +1157,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p_status.add_argument("--json", action="store_true",
                           help="machine-readable plane rows instead of the "
                           "table")
+    p_hist = sub.add_parser(
+        "history", help="history-plane trend table from a timeseries.json "
+        "scrape / snapshot dir / URL (exit 1 on active anomaly)",
+    )
+    p_hist.add_argument("src", help="MetricsServer base URL, snapshot "
+                        "directory, or timeseries.json file")
+    p_hist.add_argument("--key", default=None, metavar="GAUGE",
+                        help="render one gauge's [round, value] points "
+                        "instead of the per-key trend table")
+    p_hist.add_argument("--window", type=int, default=None, metavar="N",
+                        help="trailing frames to summarize (default: all "
+                        "retained)")
+    p_hist.add_argument("--rate", action="store_true",
+                        help="with --key: add the per-round derivative "
+                        "column")
+    p_hist.add_argument("--json", action="store_true",
+                        help="machine-readable body instead of the table")
+    p_top = sub.add_parser(
+        "top", help="single-refresh fleet dashboard: plane status roll-up "
+        "+ the history plane's biggest movers (exit = worst plane)",
+    )
+    p_top.add_argument("src", help="http(s)://host:port base URL or a "
+                       "directory of <plane>.json snapshots")
+    p_top.add_argument("--window", type=int, default=16, metavar="N",
+                       help="trailing frames for the movers table "
+                       "(default 16)")
+    p_top.add_argument("--top", type=int, default=10, metavar="N",
+                       help="movers to show (default 10)")
+    p_top.add_argument("--json", action="store_true",
+                       help="machine-readable dashboard instead of tables")
     p_flight = sub.add_parser(
         "flight", help="merged cross-host black-box timeline from a "
         "directory of flight-recorder dumps",
@@ -893,6 +1218,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.cmd == "status":
         return _status_command(args)
+
+    if args.cmd == "history":
+        return _history_command(args)
+
+    if args.cmd == "top":
+        return _top_command(args)
 
     if args.cmd == "flight":
         return _flight_command(args)

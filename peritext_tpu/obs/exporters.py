@@ -25,6 +25,14 @@ Endpoints:
 * ``/incidents.json`` — the attached
   :class:`~.incidents.IncidentMonitor` snapshot (typed incident list,
   lifecycle tallies, cross-host agreement view)
+* ``/timeseries.json`` — the attached
+  :class:`~.timeseries.TimeSeriesPlane` snapshot (retention tiers,
+  anomaly findings, occupancy rows); supports windowed query params
+  (``?key=...&window=N&rate=1`` — :func:`~.timeseries.query_snapshot`)
+
+A raising plane snapshot answers 500 with a TYPED JSON body
+(``{"error": ..., "plane": ...}``) — one sick plane must not turn a
+fleet scrape into an HTML traceback page.
 """
 
 from __future__ import annotations
@@ -34,9 +42,11 @@ import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs
 
 from .histograms import GLOBAL_HISTOGRAMS, HistogramRegistry
 from .metrics import Counters, GLOBAL_COUNTERS, health_snapshot
+from .timeseries import query_snapshot
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -98,6 +108,7 @@ def prometheus_text(
     plan=None,
     latency=None,
     incidents=None,
+    history=None,
 ) -> str:
     """Prometheus text exposition of the process telemetry.  Counter names
     sanitize ``.`` → ``_`` under a ``peritext_`` prefix; histograms emit the
@@ -132,7 +143,12 @@ def prometheus_text(
     gauges — lifecycle tallies, per-kind open counts over the FULL
     taxonomy (absent kinds at 0, so alert rules never reference a series
     that has yet to exist), the incident-view digest, and per-peer
-    agreement flags.  Every exposition also carries ONE
+    agreement flags; a :class:`~.timeseries.TimeSeriesPlane` (live or
+    snapshot dict) lands as ``peritext_history_*`` gauges — frames
+    sampled/retained, per-tier frame counts, persisted segments, active
+    + cumulative anomalies (with the by-key breakdown as its own
+    labelled family), recorded occupancy rows, and the caller-reported
+    sampling overhead.  Every exposition also carries ONE
     ``peritext_build_info`` info-style gauge (value 1, identity as
     labels: git sha, wire caps, jax version, device fingerprint) — the
     same spellings the perf ledger stamps, so fleet scrapes and ledger
@@ -499,6 +515,42 @@ def prometheus_text(
             lines.append(
                 f'{m}{{peer="{_quote_label(peer)}"}} {int(view["agree"])}'
             )
+    if history is not None:
+        snap = (history.snapshot() if hasattr(history, "snapshot")
+                else dict(history))
+        anomaly = snap.get("anomaly") or {}
+        occ = snap.get("occupancy") or {}
+        for m, value in (
+            ("peritext_history_enabled", int(bool(snap.get("enabled")))),
+            ("peritext_history_rounds", snap.get("rounds", 0)),
+            ("peritext_history_sample_every", snap.get("sample_every", 1)),
+            ("peritext_history_frames_sampled",
+             snap.get("frames_sampled", 0)),
+            ("peritext_history_frames_retained",
+             snap.get("frames_retained", 0)),
+            ("peritext_history_segments", snap.get("segments", 0)),
+            ("peritext_history_anomalies_active",
+             len(anomaly.get("active") or ())),
+            ("peritext_history_anomalies_total", anomaly.get("total", 0)),
+            ("peritext_history_occupancy_rows", occ.get("rows", 0)),
+            ("peritext_history_sample_overhead_seconds",
+             snap.get("overhead_seconds", 0.0)),
+        ):
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {_fmt(value)}")
+        m = "peritext_history_tier_frames"
+        lines.append(f"# TYPE {m} gauge")
+        for tier, count in enumerate(snap.get("tier_frames") or ()):
+            lines.append(f'{m}{{tier="{tier}"}} {_fmt(count)}')
+        # by-key anomaly family, its OWN name (same no-double-count
+        # rationale as peritext_serve_shed_reason_total)
+        m = "peritext_history_anomaly_by_key"
+        lines.append(f"# TYPE {m} counter")
+        counts = anomaly.get("counts") or {}
+        for key in sorted(counts):
+            lines.append(
+                f'{m}{{key="{_quote_label(key)}"}} {_fmt(counts[key])}'
+            )
     if session is not None:
         health = session.health()
         for key in sorted(health):
@@ -522,15 +574,34 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
         routes: Dict[str, Tuple[Callable[[], str], str]] = self.server._routes  # type: ignore[attr-defined]
-        entry = routes.get(self.path.split("?", 1)[0])
+        path, _, query = self.path.partition("?")
+        entry = routes.get(path)
         if entry is None:
             self.send_error(404)
             return
         fn, content_type = entry
         try:
-            body = fn().encode("utf-8")
+            if getattr(fn, "accepts_query", False):
+                # last value wins per key, keys visited sorted — a scrape
+                # with duplicate params must parse deterministically
+                params = {k: v[-1]
+                          for k, v in sorted(parse_qs(query).items())}
+                body = fn(params).encode("utf-8")
+            else:
+                body = fn().encode("utf-8")
         except Exception as exc:  # graftlint: boundary(an exporter endpoint answers 500, never kills the serving thread)
-            self.send_error(500, explain=str(exc))
+            # typed JSON error body: which plane broke + why — a sick
+            # plane must not turn a fleet scrape into a traceback page
+            stem = path.rsplit("/", 1)[-1]
+            if stem.endswith(".json"):
+                stem = stem[:-5]
+            err = json.dumps({"error": str(exc), "plane": stem or "metrics"})
+            body = err.encode("utf-8")
+            self.send_response(500)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
             return
         self.send_response(200)
         self.send_header("Content-Type", content_type)
@@ -562,13 +633,14 @@ class MetricsServer:
         plan=None,
         latency=None,
         incidents=None,
+        history=None,
     ) -> None:
         def metrics() -> str:
             return prometheus_text(
                 counters=counters, histograms=histograms,
                 session=session, sentinel=sentinel, convergence=convergence,
                 devprof=devprof, serve=serve, fleet=fleet, plan=plan,
-                latency=latency, incidents=incidents,
+                latency=latency, incidents=incidents, history=history,
             )
 
         def snapshot() -> str:
@@ -578,7 +650,7 @@ class MetricsServer:
                     histograms=histograms, recorder=recorder,
                     convergence=convergence, devprof=devprof, serve=serve,
                     fleet=fleet, plan=plan, latency=latency,
-                    incidents=incidents,
+                    incidents=incidents, history=history,
                 ),
                 default=str,
             )
@@ -630,6 +702,16 @@ class MetricsServer:
                 lambda: json.dumps(incidents.snapshot()),
                 "application/json",
             )
+        if history is not None:
+            def timeseries(params: Optional[Dict[str, str]] = None) -> str:
+                return json.dumps(
+                    query_snapshot(history.snapshot(), params or {}),
+                    default=str,
+                )
+
+            # opt into the handler's query-string dispatch
+            timeseries.accepts_query = True  # type: ignore[attr-defined]
+            routes["/timeseries.json"] = (timeseries, "application/json")
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd._routes = routes  # type: ignore[attr-defined]

@@ -5,7 +5,8 @@ The repo's planes each answer one narrow question — the heartbeat ledger
 says which host is dead, the convergence monitor says which peer diverged,
 the admission queue says what it shed, the latency plane says how much SLO
 budget burned, the recompile sentinel says what compiled, the supervisor
-says what it rolled back, the perf ledger says what regressed.  An operator
+says what it rolled back, the perf ledger says what regressed, and the
+history plane says which gauge drifted from its own past.  An operator
 staring at a sick fleet needs the *correlated* answer: what broke, where,
 and what was the first cause.  :class:`IncidentMonitor` is that answer as a
 deterministic fold over the planes' own snapshots.
@@ -481,6 +482,27 @@ class IncidentMonitor:
                     worst = max(worst, abs(float(pct)))
         self.raise_signal("perf-regression", host=self.host,
                           value=worst or 1.0, rows=sorted(names))
+
+    def observe_timeseries(self, plane) -> None:
+        """TimeSeriesPlane feed (the ninth signal source): every anomaly
+        active as of the plane's latest frame raises a signal on the
+        EXISTING kind its gauge-key prefix maps to (``anomaly_kind`` —
+        ``serve.*`` -> shed-storm, ``fleet.*`` -> host-death, ...), never
+        a new latch.  The signal's magnitude is the robust z-score, so a
+        correlated incident's root-cause ordering ranks the anomaly
+        against the primary plane's own evidence."""
+        snap = _snap(plane)
+        anomaly = snap.get("anomaly") or {}
+        for finding in anomaly.get("active") or ():
+            kind = str(finding.get("kind") or "perf-regression")
+            if kind not in _TAXONOMY_INDEX:
+                kind = "perf-regression"
+            self.raise_signal(
+                kind, host=str(snap.get("host", self.host)),
+                value=float(finding.get("z", 1.0) or 1.0),
+                anomaly=True, anomaly_key=str(finding.get("key")),
+                anomaly_round=int(finding.get("round", 0) or 0),
+            )
 
     # -- lifecycle fold ------------------------------------------------------
 

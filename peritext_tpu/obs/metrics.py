@@ -65,6 +65,7 @@ def health_snapshot(
     mesh=None,
     latency=None,
     incidents=None,
+    history=None,
 ) -> Dict[str, Any]:
     """One structured dict for a fleet health endpoint: every fault-domain
     counter (quarantines, corrupt frames, transport retries / behind peers,
@@ -99,7 +100,10 @@ def health_snapshot(
     ``latency``; with an
     :class:`~.incidents.IncidentMonitor`, its correlated incident view
     (typed incident list, lifecycle tallies, per-peer agreement) appears
-    under ``incidents``.  Everything in the snapshot is
+    under ``incidents``; with a
+    :class:`~.timeseries.TimeSeriesPlane`, its retention-tier frames,
+    anomaly findings, and recorded occupancy rows appear under
+    ``history``.  Everything in the snapshot is
     JSON-serializable (the exporter-schema golden test pins this)."""
     from .histograms import GLOBAL_HISTOGRAMS
 
@@ -144,4 +148,9 @@ def health_snapshot(
         out["latency"] = latency.snapshot()
     if incidents is not None:
         out["incidents"] = incidents.snapshot()
+    if history is not None:
+        out["history"] = (
+            history.snapshot() if hasattr(history, "snapshot")
+            else dict(history)
+        )
     return out

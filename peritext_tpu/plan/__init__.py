@@ -20,7 +20,7 @@ Two halves of one idea (ROADMAP "Device-as-OS serving"):
 
 from .fusion import FusionGroup, LanePlan, LaneSlot, TenantSpec
 from .model import CostModel, load_devprof
-from .tuner import PlanProposal, propose
+from .tuner import PlanProposal, history_values, propose
 
 __all__ = [
     "CostModel",
@@ -29,6 +29,7 @@ __all__ = [
     "LaneSlot",
     "PlanProposal",
     "TenantSpec",
+    "history_values",
     "load_devprof",
     "propose",
 ]

@@ -19,9 +19,10 @@ observability scope); nothing here reads a clock or touches a device.
 from __future__ import annotations
 
 import json
+import math
 import re
 from pathlib import Path
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Sequence
 
 #: the bucket-occupancy key spelling (obs/devprof.occupancy_key)
 OCC_KEY_RE = re.compile(
@@ -74,6 +75,15 @@ def _pow2_at_least(n: int, floor: int = 1) -> int:
     return cap
 
 
+def _percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Ceil-rank percentile over an ascending list (the history plane's
+    convention, restated here so the plan tier stays import-free of obs)."""
+    if not sorted_vals:
+        return 0.0
+    idx = max(0, math.ceil(q * len(sorted_vals)) - 1)
+    return float(sorted_vals[min(idx, len(sorted_vals) - 1)])
+
+
 class CostModel:
     """Deterministic scoring of serving configurations against one
     devprof snapshot.
@@ -100,8 +110,16 @@ class CostModel:
     #: "fewest variants always wins"
     DISPATCH_WEIGHT = 1e6
 
-    def __init__(self, snapshot: Dict[str, Any]) -> None:
+    def __init__(self, snapshot: Dict[str, Any],
+                 occupancy_history: Optional[Sequence[float]] = None) -> None:
         self.snapshot = load_devprof(snapshot)
+        #: observed per-window occupancy rows from the history plane's
+        #: closed loop (FusedMuxGroup -> TimeSeriesPlane.record_occupancy
+        #: -> propose(history=...)); empty means "snapshot point estimate
+        #: only" and every term behaves exactly as before
+        self.occupancy_history = sorted(
+            float(v) for v in (occupancy_history or ())
+        )
         occ = self.snapshot.get("occupancy") or {}
         self.rows = []
         for key in sorted(occ):
@@ -197,10 +215,45 @@ class CostModel:
         return ops / docs if docs else 0.0
 
     def utilization(self) -> float:
-        """Real ops / padded capacity over the whole capture."""
+        """The utilization estimate the width-shrink gate spends headroom
+        against.  With occupancy history: the p90 of the observed
+        per-window distribution — a width must survive the BUSY tail of
+        real windows, not the quiet mean a single snapshot happened to
+        catch.  Without history: real ops / padded capacity over the
+        capture (the original point estimate)."""
+        if self.occupancy_history:
+            return _percentile(self.occupancy_history, 0.90)
         if not self.total_padded:
             return 1.0
         return self.total_real_ops / self.total_padded
+
+    def occupancy_distribution(self) -> Dict[str, Any]:
+        """The observed occupancy distribution the history-weighted terms
+        cite: count, mean, p10/p50/p90, and the sparse-window fraction
+        (occupancy < 0.5 — windows that under-amortize the dispatch
+        floor)."""
+        vals = self.occupancy_history
+        if not vals:
+            return {"count": 0}
+        sparse = sum(1 for v in vals if v < 0.5)
+        return {
+            "count": len(vals),
+            "mean": round(sum(vals) / len(vals), 6),
+            "p10": _percentile(vals, 0.10),
+            "p50": _percentile(vals, 0.50),
+            "p90": _percentile(vals, 0.90),
+            "sparse_frac": round(sparse / len(vals), 6),
+        }
+
+    def dispatch_weight_factor(self) -> float:
+        """History weighting of the dispatch term: sparse windows ship
+        the same ~11 ms dispatch floor for less useful work, so the floor
+        counts ``1 + sparse_frac`` times when the observed distribution
+        says most windows ran thin.  1.0 without history."""
+        if not self.occupancy_history:
+            return 1.0
+        sparse = sum(1 for v in self.occupancy_history if v < 0.5)
+        return 1.0 + sparse / len(self.occupancy_history)
 
     # -- candidate terms ---------------------------------------------------
 
@@ -275,4 +328,5 @@ class CostModel:
     def score(self, config: Dict[str, Any]) -> float:
         return (self.padded_flops(config)
                 + self.RECOMPILE_WEIGHT * self.recompiles(config)
-                + self.DISPATCH_WEIGHT * self.dispatches(config))
+                + (self.DISPATCH_WEIGHT * self.dispatch_weight_factor()
+                   * self.dispatches(config)))

@@ -8,7 +8,15 @@ observed per-doc insert estimate; page size walks one pow-2 step either
 way of the observed; fused depth walks the {1, 2, 4, 8} ladder), every
 candidate is scored by :class:`~.model.CostModel` and filtered by the
 executable-bytes budget, and ties break on the candidate tuple itself —
-same snapshot (and ledger), same :class:`PlanProposal`, always.  The
+same snapshot (and ledger, and history), same :class:`PlanProposal`,
+always.  ``history=`` closes the ROADMAP's occupancy feedback loop: pass
+the occupancy rows the fused serving tier recorded into the history
+plane (a live :class:`~..obs.timeseries.TimeSeriesPlane`, its snapshot
+dict, its ``occupancy_rows`` list, or plain floats) and the model's
+utilization gate and dispatch term are weighted by the observed
+per-window occupancy DISTRIBUTION instead of the devprof point estimate
+— ``modeled["history"]["weighted_terms"]`` names exactly which terms
+moved, and ``obs plan`` prints them.  The
 proposal is ADVICE with a paper trail, not an actuation: the validation
 loop (scripts/plan_smoke.py, the CI plan-smoke job) replays a proposal
 through a bench row and gates it against the perf ledger before anyone
@@ -132,17 +140,43 @@ def _window_from_ledger(ledger_records: Optional[Sequence[Dict]]) -> float:
     return float(min(WINDOW_CEILING, max(WINDOW_FLOOR, WINDOW_MARGIN * p99)))
 
 
+def history_values(history: Any) -> List[float]:
+    """Normalize a ``propose(history=...)`` input to a flat list of
+    per-window occupancy values.  Accepts None, a live
+    :class:`~..obs.timeseries.TimeSeriesPlane` (or anything with
+    ``occupancy_values()``), a plane SNAPSHOT dict (``occupancy_rows``),
+    a sequence of row dicts (``occupancy`` key), or plain floats."""
+    if history is None:
+        return []
+    fn = getattr(history, "occupancy_values", None)
+    if callable(fn):
+        return [float(v) for v in fn()]
+    if isinstance(history, dict):
+        rows = history.get("occupancy_rows") or ()
+        return [float(r["occupancy"]) for r in rows]
+    out: List[float] = []
+    for item in history:
+        if isinstance(item, dict):
+            out.append(float(item["occupancy"]))
+        else:
+            out.append(float(item))
+    return out
+
+
 def propose(
     snapshot: Any,
     ledger_records: Optional[Sequence[Dict]] = None,
     *,
     budget_bytes: Optional[int] = None,
     tolerance: float = DEFAULT_TOLERANCE,
+    history: Any = None,
 ) -> PlanProposal:
     """The planner: one deterministic :class:`PlanProposal` from one
     devprof snapshot (+ optional perf-ledger records for the admission
-    window term)."""
-    model = CostModel(load_devprof(snapshot))
+    window term, + optional occupancy ``history`` for distribution-
+    weighted cost terms — see the module doc)."""
+    occupancy = history_values(history)
+    model = CostModel(load_devprof(snapshot), occupancy_history=occupancy)
     observed = model.observed_config()
     budget = budget_bytes if budget_bytes is not None else model.memory_budget()
 
@@ -204,6 +238,15 @@ def propose(
         "utilization": round(model.utilization(), 4),
         "tolerance": tolerance,
     }
+    if occupancy:
+        modeled["history"] = {
+            "rows": len(occupancy),
+            "occupancy": model.occupancy_distribution(),
+            "dispatch_weight_factor": round(
+                model.dispatch_weight_factor(), 4
+            ),
+            "weighted_terms": ["dispatch_cost", "utilization"],
+        }
     return PlanProposal(
         insert_width=cand["insert_width"],
         delete_width=cand["delete_width"],

@@ -41,6 +41,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..obs import Counters, GLOBAL_COUNTERS
 from ..obs.latency import CLOSE_BACKPRESSURE, CLOSE_FLUSH, CLOSE_WINDOW
+from ..obs.timeseries import GLOBAL_HISTORY
 from ..parallel.streaming import StreamingMerge
 from ..plan.fusion import FusionGroup, LanePlan, TenantSpec
 from .admission import AdmissionController, Verdict
@@ -150,6 +151,10 @@ class FusedMuxGroup:
         self._docs_dispatched = 0
         self._occ_sum = 0.0
         self._occ_count = 0
+        #: the history plane's occupancy channel (swap in a private plane
+        #: the way tests swap ``latency_plane``); disarmed it costs one
+        #: attribute read per lane per window
+        self.history = GLOBAL_HISTORY
 
     # -- per-tenant delegation --------------------------------------------
 
@@ -259,11 +264,15 @@ class FusedMuxGroup:
                     staged=t_staged, cause=cause,
                 )
                 applied += len(batch)
-            self._docs_dispatched += sum(
-                self.group.slots[name].docs for name in active
-            )
-            self._occ_sum += self.group.window_occupancy(lane, active)
+            docs = sum(self.group.slots[name].docs for name in active)
+            self._docs_dispatched += docs
+            occ = self.group.window_occupancy(lane, active)
+            self._occ_sum += occ
             self._occ_count += 1
+            if self.history.enabled:
+                # the closed planner loop's raw material: one occupancy
+                # row per lane per committed window
+                self.history.record_occupancy(lane, occ, docs=docs)
         self.dispatches += int(
             GLOBAL_COUNTERS.get("streaming.fused_dispatches") - d0
         )

@@ -51,6 +51,7 @@ from ..obs.latency import (
     CLOSE_WINDOW,
     GLOBAL_LATENCY,
 )
+from ..obs.timeseries import GLOBAL_HISTORY
 from ..parallel.codec import encode_frame
 from ..parallel.streaming import REASON_CAPACITY, StreamingMerge
 from .admission import (
@@ -233,6 +234,10 @@ class SessionMux:
         #: process-wide one, off until ``GLOBAL_LATENCY.enable()``); bench
         #: arms swap in a private plane so their decompositions don't mix
         self.latency_plane = GLOBAL_LATENCY
+        #: the history plane this mux feeds one frame per committed round
+        #: (same swap-in-a-private-plane discipline as ``latency_plane``);
+        #: disarmed it costs one attribute read per settle
+        self.history_plane = GLOBAL_HISTORY
         #: when this mux rides a fused group, the group's
         #: ``fusion_snapshot`` callable — snapshot()'s ``fusion`` key
         #: reports the shared window's stats instead of the standalone
@@ -457,6 +462,11 @@ class SessionMux:
             # the tier is keeping up again: sheds before this round are
             # history, not current health
             self._shed_mark = self.admission.stats.shed
+        if self.history_plane.enabled:
+            # one history frame per committed round (the plane's own
+            # sample_every decimates); measured by the caller's wall via
+            # note_overhead, never by the plane itself
+            self.history_plane.advance_round(serve=self)
 
     def pump(self, force: bool = False) -> int:
         """Close the open round if its window expired (or ``force``) and

@@ -866,6 +866,11 @@ class ServeChaosReport:
     incident_kinds: List[str] = None
     incident_resolved: bool = False
     incident_detection_rounds: int = -1
+    #: history-plane oracle: the gauge keys the private TimeSeriesPlane
+    #: flagged, and how many monitor rounds after the fault it fired
+    #: (must be <= incident_detection_rounds)
+    anomaly_keys: List[str] = None
+    anomaly_detection_rounds: int = -1
 
     def to_json(self) -> Dict:
         return asdict(self)
@@ -986,6 +991,18 @@ def run_serve_chaos(
 
         imon = IncidentMonitor(host=names[0], clear_after=2)
         shed_fault_round = imon.rounds
+        # the history-plane oracle rides the SAME monitor cadence: a
+        # PRIVATE TimeSeriesPlane warms a flat baseline on the idle mux,
+        # then the overload's first sampled spike must score as an
+        # anomaly no later than the round the shed-storm incident opens
+        from ..obs.timeseries import TimeSeriesPlane
+
+        tsp = TimeSeriesPlane(sample_every=1, min_frames=4).enable()
+        for _ in range(tsp.min_frames + 2):
+            tsp.sample(serve=mux)
+        anomaly_fault_round = tsp.rounds
+        anomaly_round = None
+        anomaly_findings: List[Dict] = []
         offered_target = int(overload_factor * max_depth) * 2
         offered = 0
         d = 0
@@ -1007,6 +1024,10 @@ def run_serve_chaos(
             if offered % (max_depth * 2) == 0:
                 imon.observe_serve(mux)
                 imon.advance_round()
+                tsp.sample(serve=mux)
+                if anomaly_round is None and tsp.active_anomalies():
+                    anomaly_round = tsp.rounds
+                    anomaly_findings = tsp.active_anomalies()
                 # an occasional pump mid-overload: the device keeps
                 # retiring rounds while the partition holds
                 mux.flush()
@@ -1015,6 +1036,25 @@ def run_serve_chaos(
         assert imon.incident_kinds() == ["shed-storm"], (
             f"seed={seed}: overload opened {imon.incident_kinds()}, "
             "expected exactly ['shed-storm']"
+        )
+        # history-plane oracle, detection half: the overload scored as an
+        # anomaly (serve.* keys -> the shed-storm kind) no later than the
+        # monitor round the incident opened
+        assert anomaly_round is not None, (
+            f"seed={seed}: overload never scored as a history anomaly"
+        )
+        report.anomaly_keys = sorted(a["key"] for a in anomaly_findings)
+        report.anomaly_detection_rounds = anomaly_round - anomaly_fault_round
+        assert any(a["kind"] == "shed-storm" for a in anomaly_findings), (
+            f"seed={seed}: anomaly findings missed the shed-storm "
+            f"mapping: {anomaly_findings}"
+        )
+        detect = imon.time_to_detection("shed-storm", shed_fault_round)
+        assert detect is not None and (
+            report.anomaly_detection_rounds <= detect
+        ), (
+            f"seed={seed}: anomaly lagged the incident "
+            f"({report.anomaly_detection_rounds} > {detect} rounds)"
         )
         mux.flush()
         stats = mux.admission.stats
@@ -1533,6 +1573,11 @@ class HostKillReport:
     incident_resolved: bool = False
     #: monitor rounds from the kill to the host-death incident opening
     incident_detection_rounds: int = -1
+    #: history-plane oracle: the fleet delay/shed gauge keys the private
+    #: TimeSeriesPlane flagged, and how many monitor rounds after the
+    #: kill it fired (must be <= incident_detection_rounds)
+    anomaly_keys: List[str] = None
+    anomaly_detection_rounds: int = -1
 
     def to_json(self) -> Dict:
         return asdict(self)
@@ -1593,10 +1638,29 @@ def run_host_kill_failover(
     imon = IncidentMonitor(host="frontend", clear_after=2,
                            recorder=recorder)
     kill_mon_round = 0
+    # the history-plane oracle rides the monitor cadence: a PRIVATE
+    # TimeSeriesPlane warms a flat baseline before traffic (below); the
+    # kill's delay/shed counter spike must then score as an anomaly no
+    # later than the monitor round the host-death incident opens.  Only
+    # the delay/shed keys count — traffic ramps the admit counters, and
+    # a ramp is drift, not a fault signature
+    from ..obs.timeseries import TimeSeriesPlane
+
+    tsp = TimeSeriesPlane(sample_every=1, min_frames=4).enable()
+    kill_tsp_round = 0
+    anomaly_state = {"round": None, "keys": []}
 
     def monitor_round():
         imon.observe_fleet(fe)
         imon.advance_round()
+        tsp.sample(fleet=fe)
+        if anomaly_state["round"] is None:
+            hits = [a for a in tsp.active_anomalies()
+                    if a["key"] in ("fleet.verdicts.delayed",
+                                    "fleet.verdicts.shed")]
+            if hits:
+                anomaly_state["round"] = tsp.rounds
+                anomaly_state["keys"] = sorted(a["key"] for a in hits)
 
     def make_mux():
         return SessionMux(
@@ -1626,6 +1690,11 @@ def run_host_kill_failover(
     acked: Dict[str, List[bytes]] = {k: [] for k in plans}
     pending: Dict[str, List[bytes]] = {k: list(v) for k, v in plans.items()}
     keys = sorted(plans)
+
+    # flat-baseline warmup: the anomaly scorer needs min_frames quiet
+    # frames before the kill's spike can be judged against them
+    for _ in range(tsp.min_frames + 2):
+        tsp.sample(fleet=fe)
 
     try:
         t0 = time.perf_counter()
@@ -1666,6 +1735,7 @@ def run_host_kill_failover(
                     fe.hosts[victim].kill()
                     kill_round = fe.rounds
                     kill_mon_round = imon.rounds
+                    kill_tsp_round = tsp.rounds
                     killed = True
                     # the very next submission to a victim doc must answer
                     # TYPED (delay: the lease has not expired yet)
@@ -1813,6 +1883,20 @@ def run_host_kill_failover(
         report.incident_kinds = imon.incident_kinds()
         report.incident_resolved = True
         report.incident_detection_rounds = ttd
+
+        # history-plane oracle: the kill's delay/shed spike scored as an
+        # anomaly no later than the host-death incident opened
+        assert anomaly_state["round"] is not None, (
+            f"seed={seed}: host kill never scored as a history anomaly"
+        )
+        report.anomaly_keys = anomaly_state["keys"]
+        report.anomaly_detection_rounds = (
+            anomaly_state["round"] - kill_tsp_round
+        )
+        assert report.anomaly_detection_rounds <= ttd, (
+            f"seed={seed}: anomaly lagged the incident "
+            f"({report.anomaly_detection_rounds} > {ttd} rounds)"
+        )
     finally:
         fe.stop()
     return report

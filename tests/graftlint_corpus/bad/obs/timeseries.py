@@ -1,0 +1,36 @@
+"""graftlint fixture: the history-plane mistake PTL006 exists for.
+
+The anomaly scorer in ``obs/timeseries.py`` is merge scope even though
+it lives in obs/ (the ``merge_scope_files`` entry pins it in, the same
+plan-scope split that pins ``plan/fusion.py``): its findings feed the
+incident monitor and its retained ring must replay byte-identically from
+persisted segments.  The tempting bug is stamping frames — or ageing the
+anomaly baseline — by a wall-clock read, which makes every replayed ring
+diverge from the live one (replay happens at a different wall time) and
+the byte-equality oracle (``frames_json()`` after ``replay_segments``)
+dies.  Overhead is measured by CALLERS and fed in as data
+(``note_overhead``), never read here.  This file is the TRUE POSITIVE
+proving the rule fires on exactly that; never "fix" it.
+"""
+
+import time
+
+
+class WallClockAnomalyScorer:
+    def __init__(self, window_seconds):
+        self.window_seconds = window_seconds
+        self._baseline = []
+
+    def score(self, value):
+        # PTL006: wall-clock stamp deciding the anomaly baseline window —
+        # a replayed ring ages its baseline by replay-time, not by the
+        # rounds the frames were committed at
+        now = time.time()
+        self._baseline = [
+            (at, v) for at, v in self._baseline
+            if now - at < self.window_seconds
+        ]
+        self._baseline.append((now, value))
+        vals = sorted(v for _, v in self._baseline)
+        med = vals[len(vals) // 2]
+        return abs(value - med)

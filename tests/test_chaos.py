@@ -648,6 +648,11 @@ class TestChaosHarness:
         assert report.incident_kinds == ["shed-storm"]
         assert report.incident_resolved
         assert report.incident_detection_rounds >= 1
+        # history-plane oracle (PR 20): the overload burst scored as an
+        # anomaly on serve gauges no later than the incident opened
+        assert report.anomaly_keys
+        assert all(k.startswith("serve.") for k in report.anomaly_keys)
+        assert report.anomaly_detection_rounds >= 0
 
     def test_reconnect_storm_drains_while_serving(self):
         """ROADMAP scenario item: a peer back from a long offline window
@@ -694,6 +699,15 @@ class TestChaosHarness:
         assert report.incident_kinds == ["host-death"]
         assert report.incident_resolved
         assert 1 <= report.incident_detection_rounds <= report.detection_rounds + 1
+        # history-plane oracle (PR 20): the kill's delay/shed spike scored
+        # as an anomaly no later than the host-death incident opened
+        assert report.anomaly_keys
+        assert set(report.anomaly_keys) <= {
+            "fleet.verdicts.delayed", "fleet.verdicts.shed",
+        }
+        assert 0 <= report.anomaly_detection_rounds <= (
+            report.incident_detection_rounds
+        )
 
     def test_markheavy_chaos_smoke(self):
         """ROADMAP scenario diversity: the mark-heavy editorial-pass

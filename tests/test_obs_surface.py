@@ -1,0 +1,224 @@
+"""Surface-mount audit (PR 20): every MetricsServer JSON endpoint has an
+``obs status`` roll-up row and a ``prometheus_text`` gauge family — a new
+endpoint that forgets either breaks set equality here, not in production.
+Plus the error-body contract: a raising plane snapshot answers a TYPED
+500 JSON body naming the plane, never a stack-trace HTML page or a dead
+serving thread."""
+
+import json
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+from peritext_tpu.obs import (
+    ConvergenceMonitor,
+    DeviceProfiler,
+    IncidentMonitor,
+    MetricsServer,
+    TimeSeriesPlane,
+    prometheus_text,
+)
+from peritext_tpu.obs.__main__ import _STATUS_PLANES, main as obs_main
+from peritext_tpu.obs.latency import LatencyPlane
+
+SNAPSHOT = Path(__file__).resolve().parents[1] / "perf" / "plan_devprof.json"
+
+#: the /metrics family each JSON endpoint's plane must emit.  ``trace``
+#: is the one documented exemption: spans export as Chrome trace JSON
+#: (``/trace.json`` -> Perfetto), not as Prometheus gauges, and
+#: ``prometheus_text`` takes no tracer; ``health`` is the roll-up body
+#: itself, pinned via the always-present build-info gauge.
+PROMETHEUS_NEEDLES = {
+    "health": "peritext_build_info{",
+    "convergence": "peritext_convergence_",
+    "devprof": "peritext_device_",
+    "serve": "peritext_serve_",
+    "fleet": "peritext_fleet_",
+    "plan": "peritext_plan_",
+    "latency": "peritext_latency_",
+    "incidents": "peritext_incident_",
+    "timeseries": "peritext_history_",
+}
+
+
+def _all_planes_server(**overrides):
+    """A MetricsServer with EVERY optional plane mounted.  Placeholder
+    objects suffice for route-mount auditing: routes mount on presence
+    and snapshot lazily."""
+    kwargs = dict(
+        tracer=object(), convergence=object(), devprof=object(),
+        serve=object(), fleet=object(), plan={}, latency=object(),
+        incidents=object(), history=object(),
+    )
+    kwargs.update(overrides)
+    return MetricsServer(**kwargs)
+
+
+class TestSurfaceMountAudit:
+    def test_every_json_endpoint_has_a_status_row(self):
+        """The golden set equality: ``{route stems}`` == ``{obs status
+        planes}``.  Mounting a new /<plane>.json without teaching the
+        roll-up about it (or vice versa) fails HERE."""
+        server = _all_planes_server()
+        try:
+            routes = server._httpd._routes
+            assert "/metrics" in routes  # the non-JSON exposition
+            stems = {path[1:-len(".json")] for path in routes
+                     if path.endswith(".json")}
+        finally:
+            server.stop()
+        status_stems = {name for name, _ in _STATUS_PLANES}
+        assert stems == status_stems
+        assert "timeseries" in stems  # the PR 20 endpoint rides the audit
+
+    def test_every_json_endpoint_has_a_prometheus_family(self):
+        """Live-plane audit: prometheus_text fed one real plane per
+        endpoint emits that endpoint's gauge family."""
+        history = TimeSeriesPlane(min_frames=4).enable()
+        history.sample(serve={"shed": 1.0})
+        text = prometheus_text(
+            convergence=ConvergenceMonitor(host="audit"),
+            devprof=DeviceProfiler(),
+            serve=_SnapStub(_SERVE_SNAP),
+            fleet=_SnapStub(_FLEET_SNAP),
+            plan=_plan_doc(),
+            latency=LatencyPlane(),
+            incidents=IncidentMonitor(host="audit"),
+            history=history,
+        )
+        exempt = {"trace"}
+        audited = {name for name, _ in _STATUS_PLANES} - exempt
+        assert audited == set(PROMETHEUS_NEEDLES)
+        for plane, needle in sorted(PROMETHEUS_NEEDLES.items()):
+            assert needle in text, f"{plane}: no {needle} family emitted"
+
+
+def _plan_doc():
+    from peritext_tpu.plan import propose
+
+    return propose(json.loads(Path(SNAPSHOT).read_text())).to_json()
+
+
+class _SnapStub:
+    """A snapshot-shaped stand-in for the heavyweight serve/fleet planes:
+    the exporter contract is 'reads the snapshot dict', so the audit pins
+    the SNAPSHOT SCHEMA the real planes already golden-test elsewhere
+    (test_serve.py / test_fleet.py)."""
+
+    def __init__(self, body):
+        self._body = body
+
+    def snapshot(self):
+        return json.loads(json.dumps(self._body))
+
+
+_SERVE_SNAP = {
+    "host": "audit", "sessions": 1, "docs": 1, "doc_capacity": 4,
+    "degraded_docs": 0, "rounds": 3, "applied_frames": 3,
+    "buffered_frames": 0, "overloaded": False,
+    "queue": {"depth": 0, "peak": 2, "max_depth": 64, "backpressure": False,
+              "verdicts": {"submitted": 3, "admitted": 3, "delayed": 0,
+                           "shed": 0, "shed_reasons": {}}},
+    "window": {"seconds": 0.01, "p99_round_seconds": 0.001,
+               "floor": 0.005, "ceiling": 0.1},
+}
+
+_FLEET_SNAP = {
+    "rounds": 2, "hosts": {}, "leases": {"leases": {}},
+    "router": {"docs": 0}, "serving": {}, "moving": {}, "failed_docs": [],
+    "failovers": 0, "failover_docs": 0, "migrations": 0,
+    "migration_rollbacks": 0, "checkpoint_ships": 0, "journal_frames": 0,
+    "checkpoint_docs": 0,
+    "verdicts": {"submitted": 0, "admitted": 0, "delayed": 0, "shed": 0,
+                 "shed_reasons": {}},
+    "auth": {"keys": 0, "rejected": 0},
+}
+
+
+class TestStatusRollupLive:
+    def test_status_rolls_up_every_mounted_plane(self, capsys):
+        history = TimeSeriesPlane(min_frames=4).enable()
+        for i in range(6):
+            history.sample(serve={"admitted": float(i)})
+        server = MetricsServer(
+            convergence=ConvergenceMonitor(host="roll"),
+            devprof=DeviceProfiler(),
+            incidents=IncidentMonitor(host="roll"),
+            latency=LatencyPlane(),
+            plan=_plan_doc(),
+            history=history,
+        )
+        host, port = server.start()
+        try:
+            code = obs_main(["status", f"http://{host}:{port}", "--json"])
+            body = json.loads(capsys.readouterr().out)
+        finally:
+            server.stop()
+        rows = {row["plane"]: row for row in body["planes"]}
+        assert {"health", "convergence", "devprof", "incidents", "latency",
+                "plan", "timeseries"} <= set(rows)
+        assert rows["timeseries"]["status"] == "ok"
+        assert code == body["exit"]
+
+
+class _Boom:
+    """A plane whose snapshot raises — the exporter must answer a typed
+    500, not die."""
+
+    def __init__(self, msg):
+        self._msg = msg
+
+    def snapshot(self):
+        raise RuntimeError(self._msg)
+
+    def chrome_trace(self):
+        raise RuntimeError(self._msg)
+
+
+class TestTypedErrorBodies:
+    def test_raising_planes_answer_typed_500_json(self):
+        """Satellite pin (>=2 planes): the body is ``{"error", "plane"}``
+        with the plane stem, and the server stays alive to answer the
+        next request."""
+        history = TimeSeriesPlane(min_frames=4).enable()
+        history.sample(serve={"ok": 1.0})
+        server = MetricsServer(
+            convergence=_Boom("lag ledger corrupt"),
+            incidents=_Boom("monitor detached"),
+            history=history,
+        )
+        host, port = server.start()
+        base = f"http://{host}:{port}"
+        try:
+            for stem, msg in (("convergence", "lag ledger corrupt"),
+                              ("incidents", "monitor detached")):
+                try:
+                    urllib.request.urlopen(f"{base}/{stem}.json", timeout=5)
+                    raise AssertionError(f"/{stem}.json did not 500")
+                except urllib.error.HTTPError as exc:
+                    assert exc.code == 500
+                    body = json.loads(exc.read())
+                    assert body["plane"] == stem
+                    assert msg in body["error"]
+            # the serving thread survived both faults
+            healthy = json.loads(urllib.request.urlopen(
+                f"{base}/timeseries.json", timeout=5).read())
+            assert healthy["rounds"] == history.rounds
+        finally:
+            server.stop()
+
+    def test_raising_history_plane_names_timeseries(self):
+        server = MetricsServer(history=_Boom("ring poisoned"))
+        host, port = server.start()
+        try:
+            try:
+                urllib.request.urlopen(
+                    f"http://{host}:{port}/timeseries.json?key=x", timeout=5)
+                raise AssertionError("/timeseries.json did not 500")
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 500
+                body = json.loads(exc.read())
+                assert body == {"error": "ring poisoned",
+                                "plane": "timeseries"}
+        finally:
+            server.stop()

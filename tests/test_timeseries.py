@@ -1,0 +1,642 @@
+"""History-plane tests (PR 20): retention tiers (a one-frame spike
+survives every downsampling level), byte-identical JSONL segment replay,
+the rolling-median + MAD anomaly detector and its incident-taxonomy
+mapping (the IncidentMonitor's ninth signal source), the query helpers
+behind ``/timeseries.json`` and ``obs history``, the closed planner loop
+(fused occupancy rows -> ``propose(history=...)``), and the off-by-default
+arming contract (zero new XLA compiles, bounded sampling overhead)."""
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from peritext_tpu.obs import (
+    GLOBAL_HISTORY,
+    IncidentMonitor,
+    RecompileSentinel,
+    TAXONOMY,
+    TimeSeriesPlane,
+    anomaly_kind,
+    health_snapshot,
+    prometheus_text,
+    replay_segments,
+)
+from peritext_tpu.obs.timeseries import (
+    ANOMALY_KIND_PREFIXES,
+    chronological_frames,
+    flatten_gauges,
+    key_summary,
+    mad_z,
+    occupancy_distribution,
+    query_snapshot,
+    series_points,
+    series_rate,
+    snapshot_keys,
+)
+from peritext_tpu.plan import history_values, propose
+
+#: the committed plan-smoke devprof capture the planner tests read
+SNAPSHOT = Path(__file__).resolve().parents[1] / "perf" / "plan_devprof.json"
+
+#: bimodal occupancy: mostly-sparse windows with a dense burst — p90
+#: lands on the dense mode (0.9) while the devprof point estimate on the
+#: committed snapshot is ~0.07, so the width-shrink gate flips
+BIMODAL = [0.05] * 12 + [0.9] * 4
+
+
+def _plane(**kw):
+    kw.setdefault("sample_every", 1)
+    kw.setdefault("min_frames", 4)
+    return TimeSeriesPlane(**kw).enable()
+
+
+def _feed_flat(plane, n, value=0.0, key="shed"):
+    for _ in range(n):
+        plane.sample(serve={key: value})
+
+
+# ---------------------------------------------------------------------------
+# retention: the tier cascade and the spike-survival envelope
+# ---------------------------------------------------------------------------
+
+
+class TestRetention:
+    def test_spike_survives_every_tier(self):
+        """The retention headline: one spiked frame, then enough flat
+        frames to merge it down into the DEEPEST tier — the min/max
+        envelope must still carry the spike even though every
+        intermediate tier downsampled it away."""
+        plane = _plane(tier_capacity=4, merge_factor=4, tiers=3,
+                       anomaly_window=4)
+        plane.sample(serve={"shed": 100.0})  # the one-frame spike
+        _feed_flat(plane, 80)  # tier 0 (cap 4) overflows through tier 1
+        snap = plane.snapshot()
+        frames = chronological_frames(snap)
+        # the spike frame merged all the way down: the OLDEST retained
+        # frame is a deep-tier merge whose envelope still holds 100
+        assert frames[0]["frames"] > 1, "spike frame never downsampled"
+        assert frames[0]["gauges"]["serve.shed"]["max"] == 100.0
+        # and the plane-wide summary sees it through the envelopes
+        assert key_summary(snap, "serve.shed")["max"] == 100.0
+        # while last-value percentiles reflect the flat steady state
+        assert key_summary(snap, "serve.shed")["p50"] == 0.0
+
+    def test_tier_cascade_is_bounded(self):
+        plane = _plane(tier_capacity=4, merge_factor=2, tiers=3)
+        for i in range(500):
+            plane.sample(serve={"shed": float(i)})
+        snap = plane.snapshot()
+        assert snap["frames_sampled"] == 500
+        # every tier within capacity (+merge slack on interior tiers)
+        for count in snap["tier_frames"]:
+            assert count <= plane.tier_capacity + plane.merge_factor
+        assert snap["frames_retained"] == sum(snap["tier_frames"])
+        # the last tier dropped oldest frames: history is bounded
+        assert snap["frames_retained"] < 500
+        oldest = chronological_frames(snap)[0]
+        assert oldest["round"] > 1
+
+    def test_segment_replay_reconstructs_ring_byte_identically(self, tmp_path):
+        """The persistence pin: JSONL segments re-fed through retention
+        rebuild the EXACT ring (frames_json() equality), across a
+        segment rotation."""
+        plane = _plane(tier_capacity=8, merge_factor=2, tiers=3,
+                       segment_frames=16, dir=tmp_path)
+        for i in range(50):
+            plane.sample(serve={"shed": float(i % 7)},
+                         fleet={"hosts": 3.0, "dead": float(i == 31)})
+        assert plane.segments() > 1, "rotation never exercised"
+        replayed = replay_segments(tmp_path, tier_capacity=8,
+                                   merge_factor=2, tiers=3)
+        assert replayed.frames_json() == plane.frames_json()
+        assert replayed.rounds == plane.rounds
+
+    def test_disarmed_plane_costs_and_records_nothing(self):
+        plane = TimeSeriesPlane()
+        assert not plane.enabled
+        assert plane.advance_round(serve={"x": 1}) is None
+        plane.record_occupancy(0, 0.5)
+        assert plane.frames_sampled == 0
+        assert plane.occupancy_rows() == []
+        # arming is enable(): the round counter kept counting throughout
+        assert plane.rounds == 1
+
+    def test_sample_every_decimates_advance_round(self):
+        plane = TimeSeriesPlane(sample_every=4).enable()
+        for _ in range(16):
+            plane.advance_round(serve={"x": 1.0})
+        assert plane.rounds == 16
+        assert plane.frames_sampled == 4  # rounds 1, 5, 9, 13
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError):
+            TimeSeriesPlane(sample_every=0)
+        with pytest.raises(ValueError):
+            TimeSeriesPlane(merge_factor=1)
+        with pytest.raises(ValueError):
+            TimeSeriesPlane(tier_capacity=2, merge_factor=4)
+
+
+# ---------------------------------------------------------------------------
+# flattening
+# ---------------------------------------------------------------------------
+
+
+class TestFlatten:
+    def test_flatten_rules(self):
+        gauges = flatten_gauges("serve", {
+            "depth": 3,
+            "overloaded": True,
+            "ratio": 0.5,
+            "label": "ignored",
+            "items": [1, 2],
+            "bad": float("nan"),
+            "nested": {"b": 2, "a": 1},
+        })
+        assert gauges == {
+            "serve.depth": 3.0,
+            "serve.overloaded": 1.0,
+            "serve.ratio": 0.5,
+            "serve.nested.a": 1.0,
+            "serve.nested.b": 2.0,
+        }
+
+    def test_live_plane_source_uses_snapshot(self):
+        class _Plane:
+            def snapshot(self):
+                return {"x": 2}
+
+        assert flatten_gauges("p", _Plane()) == {"p.x": 2.0}
+        with pytest.raises(TypeError):
+            flatten_gauges("p", object())
+
+
+# ---------------------------------------------------------------------------
+# the anomaly detector
+# ---------------------------------------------------------------------------
+
+
+class TestAnomalies:
+    def test_flat_baseline_spike_fires(self):
+        plane = _plane(threshold=6.0)
+        _feed_flat(plane, plane.min_frames + 2)
+        assert plane.active_anomalies() == []
+        plane.sample(serve={"shed": 50.0})
+        active = plane.active_anomalies()
+        assert [a["key"] for a in active] == ["serve.shed"]
+        a = active[0]
+        assert a["kind"] == "shed-storm"
+        assert a["value"] == 50.0 and a["median"] == 0.0
+        assert a["z"] > plane.threshold
+        assert plane.anomaly_first_round("serve.shed") == a["round"]
+        # recovery: the next flat frame scores against a window that
+        # still holds the spike, but the VALUE is back at the median
+        plane.sample(serve={"shed": 0.0})
+        assert plane.active_anomalies() == []
+        assert plane.anomalies_total == 1
+
+    def test_linear_drift_stays_quiet(self):
+        """A steadily-ramping counter has a healthy MAD — the robust z
+        never crosses the threshold, so growth is not an anomaly."""
+        plane = _plane()
+        for i in range(40):
+            plane.sample(serve={"admitted": float(i * 3)})
+        assert plane.active_anomalies() == []
+        assert plane.anomalies_total == 0
+
+    def test_zero_mad_floor_tolerates_float_jitter(self):
+        """The floor is RELATIVE: epsilon wobble around a large flat
+        value stays quiet while a genuine step change fires."""
+        plane = _plane()
+        for _ in range(plane.min_frames + 2):
+            plane.sample(latency={"p99": 100.0})
+        plane.sample(latency={"p99": 100.0 + 1e-9})
+        assert plane.active_anomalies() == []
+        plane.sample(latency={"p99": 200.0})
+        assert [a["kind"] for a in plane.active_anomalies()] == ["slo-burn"]
+
+    def test_mad_z_is_pure_and_capped(self):
+        flat = [0.0] * 8
+        assert mad_z(0.0, flat) == 0.0
+        assert mad_z(1e30, flat) == pytest.approx(1e9)  # Z_CAP
+        assert mad_z(5.0, [1.0, 2.0, 3.0, 4.0, 5.0]) < 6.0
+
+    def test_short_history_never_scores(self):
+        plane = _plane(min_frames=8)
+        for i in range(6):
+            plane.sample(serve={"shed": 0.0 if i < 5 else 9999.0})
+        assert plane.active_anomalies() == []
+
+    def test_anomaly_kind_covers_the_existing_taxonomy_only(self):
+        assert anomaly_kind("serve.queue.depth") == "shed-storm"
+        assert anomaly_kind("fleet.verdicts.shed") == "host-death"
+        assert anomaly_kind("convergence.lag") == "divergence"
+        assert anomaly_kind("jit.compiles_total") == "recompile-storm"
+        assert anomaly_kind("recompiles.site") == "recompile-storm"
+        assert anomaly_kind("latency.slo.burn") == "slo-burn"
+        assert anomaly_kind("session.quarantined") == "quarantine-storm"
+        assert anomaly_kind("plan.savings") == "perf-regression"
+        assert anomaly_kind("whatever.else") == "perf-regression"
+        # every mapped kind is an EXISTING taxonomy member — anomalies
+        # are root-cause candidates, never a new incident latch
+        kinds = {kind for _, kind in ANOMALY_KIND_PREFIXES}
+        kinds.add("perf-regression")
+        assert kinds <= set(TAXONOMY)
+
+    def test_incident_monitor_ninth_feed(self):
+        """observe_timeseries raises signals on EXISTING kinds: a serve
+        anomaly opens a shed-storm incident carrying the anomaly key."""
+        plane = _plane()
+        _feed_flat(plane, plane.min_frames + 2)
+        plane.sample(serve={"shed": 50.0})
+        imon = IncidentMonitor(host="front", open_after=2)
+        for _ in range(2):
+            imon.observe_timeseries(plane)
+            imon.advance_round()
+        assert imon.incident_kinds() == ["shed-storm"]
+        inc = imon.open_incidents()[0]
+        cause = inc.candidates()[0]
+        assert cause.kind == "shed-storm"
+        assert cause.detail.get("anomaly") is True
+        assert cause.detail.get("anomaly_key") == "serve.shed"
+
+    def test_ninth_feed_unknown_kind_folds_to_perf_regression(self):
+        imon = IncidentMonitor(host="front", open_after=1)
+        imon.observe_timeseries({
+            "host": "front",
+            "anomaly": {"active": [
+                {"key": "mystery.gauge", "kind": "not-a-kind",
+                 "round": 3, "z": 9.0},
+            ]},
+        })
+        imon.advance_round()
+        assert imon.incident_kinds() == ["perf-regression"]
+
+
+# ---------------------------------------------------------------------------
+# the query API (shared by /timeseries.json and obs history)
+# ---------------------------------------------------------------------------
+
+
+class TestQueries:
+    def _snap(self, n=10):
+        plane = _plane()
+        for i in range(n):
+            plane.sample(serve={"admitted": float(i * 2)},
+                         fleet={"hosts": 3.0})
+        return plane, plane.snapshot()
+
+    def test_series_points_and_rate(self):
+        plane, snap = self._snap()
+        points = series_points(snap, "serve.admitted")
+        assert len(points) == 10
+        assert points[0] == [1, 0.0] and points[-1] == [10, 18.0]
+        assert plane.series("serve.admitted", window=3) == points[-3:]
+        rates = series_rate(points)
+        assert all(r == 2.0 for _, r in rates)
+        assert plane.rate("serve.admitted")[-1] == [10, 2.0]
+
+    def test_key_summary_percentiles(self):
+        _, snap = self._snap()
+        s = key_summary(snap, "serve.admitted")
+        assert s["points"] == 10
+        assert s["min"] == 0.0 and s["max"] == 18.0
+        assert s["p50"] == 8.0 and s["p99"] == 18.0
+        assert s["first"] == 0.0 and s["last"] == 18.0
+        assert s["delta"] == 18.0
+        assert key_summary(snap, "no.such.key") == {"key": "no.such.key",
+                                                    "points": 0}
+
+    def test_query_snapshot_param_shapes(self):
+        _, snap = self._snap()
+        body = query_snapshot(snap, {"key": "serve.admitted", "rate": "1",
+                                     "window": "4"})
+        assert len(body["points"]) == 4
+        assert body["summary"]["points"] == 4
+        assert len(body["rate"]) == 3
+        windowed = query_snapshot(snap, {"window": "3"})
+        assert len(windowed["frames"]) == 3
+        assert "fleet.hosts" in windowed["keys"]
+        assert query_snapshot(snap, {}) is snap
+
+    def test_snapshot_keys_union(self):
+        plane = _plane()
+        plane.sample(serve={"a": 1})
+        plane.sample(fleet={"b": 2})
+        assert snapshot_keys(plane.snapshot()) == ["fleet.b", "serve.a"]
+
+
+# ---------------------------------------------------------------------------
+# the closed planner loop
+# ---------------------------------------------------------------------------
+
+
+class TestPlannerLoop:
+    def test_fused_group_records_occupancy_rows(self):
+        """FusedMuxGroup.pump feeds the plane one occupancy row per lane
+        per committed window when (and only when) the plane is armed."""
+        from peritext_tpu.parallel.codec import encode_frame
+        from peritext_tpu.plan import TenantSpec
+        from peritext_tpu.serve import FusedMuxGroup, default_lane_factory
+        from peritext_tpu.testing.fuzz import generate_workload
+
+        specs = [TenantSpec(tenant="tA", docs=1),
+                 TenantSpec(tenant="tB", docs=1)]
+        group = FusedMuxGroup(
+            specs,
+            default_lane_factory(
+                ("doc1", "doc2", "doc3"),
+                slot_capacity=128, mark_capacity=64, tomb_capacity=96,
+                round_insert_capacity=32, round_delete_capacity=16,
+                round_mark_capacity=16,
+            ),
+            host="test",
+        )
+        plane = _plane()
+        group.history = plane
+        sids = {}
+        for spec in specs:
+            sid, verdict = group.open_session(spec.tenant, "client")
+            assert verdict.admitted
+            sids[spec.tenant] = sid
+        workloads = generate_workload(seed=5, num_docs=2, ops_per_doc=12)
+        frames = {}
+        for spec, w in zip(specs, workloads):
+            changes = sorted((ch for log in w.values() for ch in log),
+                             key=lambda c: (c.actor, c.seq))
+            frames[spec.tenant] = [encode_frame(changes[:6]),
+                                   encode_frame(changes[6:])]
+        # window 1: both tenants (full); window 2: one tenant (sparse)
+        for name in ("tA", "tB"):
+            assert group.submit(name, sids[name], frames[name][0]).admitted
+        group.flush()
+        assert group.submit("tA", sids["tA"], frames["tA"][1]).admitted
+        group.flush()
+        rows = plane.occupancy_rows()
+        assert rows, "armed plane recorded no occupancy rows"
+        for row in rows:
+            assert set(row) == {"row", "lane", "occupancy", "docs"}
+            assert 0.0 <= row["occupancy"] <= 1.0
+        # the sparse second window recorded sub-full occupancy
+        assert min(r["occupancy"] for r in rows) < 1.0
+        dist = plane.snapshot()["occupancy"]["distribution"]
+        assert dist["count"] == len(rows)
+
+    def test_propose_history_weighted_differs_and_is_deterministic(self):
+        """The acceptance pin: on the committed snapshot, the bimodal
+        occupancy history flips the width-shrink gate (p90 utilization
+        0.9 vs the ~0.07 point estimate), so the proposal DIFFERS from
+        the snapshot-only one; same history -> byte-identical proposal."""
+        snap = json.loads(SNAPSHOT.read_text())
+        base = propose(snap)
+        weighted = propose(snap, history=BIMODAL)
+        again = propose(snap, history=list(BIMODAL))
+        assert json.dumps(weighted.to_json(), sort_keys=True) == (
+            json.dumps(again.to_json(), sort_keys=True))
+        assert weighted.to_json() != base.to_json()
+        # the point-estimate plan shrinks widths; the history-weighted
+        # plan sees p90 occupancy 0.9 and keeps them
+        assert weighted.insert_width > base.insert_width
+        hist = weighted.modeled["history"]
+        assert hist["rows"] == len(BIMODAL)
+        assert hist["occupancy"]["p90"] == 0.9
+        assert hist["occupancy"]["sparse_frac"] == 0.75
+        assert hist["dispatch_weight_factor"] == 1.75
+        assert hist["weighted_terms"] == ["dispatch_cost", "utilization"]
+        assert weighted.modeled["utilization"] == 0.9
+        # the no-history path is untouched: no phantom history block
+        assert "history" not in base.modeled
+
+    def test_history_values_normalizes_every_shape(self):
+        plane = _plane()
+        plane.record_occupancy(0, 0.25)
+        plane.record_occupancy(1, 0.75)
+        assert history_values(None) == []
+        assert history_values(plane) == [0.25, 0.75]
+        assert history_values(plane.snapshot()) == [0.25, 0.75]
+        assert history_values([{"occupancy": 0.5}, 0.9]) == [0.5, 0.9]
+
+    def test_occupancy_distribution_shape(self):
+        assert occupancy_distribution([]) == {"count": 0}
+        dist = occupancy_distribution(BIMODAL)
+        assert dist["count"] == 16 and dist["p90"] == 0.9
+        assert dist["sparse_frac"] == 0.75
+
+    def test_occupancy_ring_is_bounded(self):
+        plane = _plane(occupancy_cap=8)
+        for i in range(20):
+            plane.record_occupancy(0, i / 20.0)
+        rows = plane.occupancy_rows()
+        assert len(rows) == 8
+        assert plane.snapshot()["occupancy"]["total"] == 20
+        assert rows[0]["row"] == 13  # oldest rows aged out
+
+
+# ---------------------------------------------------------------------------
+# arming: zero compiles, bounded overhead, off-by-default global
+# ---------------------------------------------------------------------------
+
+
+class TestArming:
+    def test_global_plane_is_off_by_default(self):
+        assert not GLOBAL_HISTORY.enabled
+
+    def test_arming_compiles_nothing_within_overhead_budget(self):
+        """ISSUE acceptance: enabling the plane mid-serve triggers ZERO
+        new XLA compiles, and the caller-measured sampling overhead
+        stays within the pinned budget."""
+        from peritext_tpu.parallel.codec import encode_frame
+        from peritext_tpu.parallel.streaming import StreamingMerge
+        from peritext_tpu.serve import SessionMux
+        from peritext_tpu.testing.fuzz import generate_workload
+
+        def make_mux():
+            return SessionMux(
+                StreamingMerge(
+                    num_docs=1, actors=("doc1", "doc2", "doc3"),
+                    slot_capacity=128, mark_capacity=64, tomb_capacity=96,
+                    round_insert_capacity=32, round_delete_capacity=16,
+                    round_mark_capacity=16, static_rounds=True,
+                ),
+                host="armed",
+            )
+
+        def drive(mux, plane=None):
+            sid, verdict = mux.open_session("client")
+            assert verdict.admitted
+            if plane is not None:
+                mux.history_plane = plane  # arming: attribute swap, no jit
+            for frame in frames:
+                assert mux.submit(sid, frame).admitted
+                mux.flush()
+
+        w = generate_workload(seed=9, num_docs=1, ops_per_doc=24)[0]
+        changes = sorted((ch for log in w.values() for ch in log),
+                         key=lambda c: (c.actor, c.seq))
+        frames = [encode_frame(changes[i::6]) for i in range(6)]
+        drive(make_mux())  # cold run: every shape variant compiles here
+        plane = _plane()
+        with RecompileSentinel() as sentinel:
+            sentinel.mark()
+            t0 = time.perf_counter()
+            drive(make_mux(), plane=plane)
+            plane.note_overhead(time.perf_counter() - t0)
+            sentinel.assert_steady_state(
+                "armed history sampling over steady-state serve rounds")
+        assert plane.frames_sampled >= 1
+        snap = plane.snapshot()
+        assert "serve.queue.depth" in snap["keys"]
+        # the budget is generous (it covers the serve rounds themselves)
+        # — the pin is that overhead is FED IN and bounded, not measured
+        # by the merge-scope plane
+        assert 0.0 < snap["overhead_seconds"] < 30.0
+
+
+# ---------------------------------------------------------------------------
+# surfaces: health composition, prometheus gauges, the HTTP route
+# ---------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    def _active_plane(self):
+        plane = _plane()
+        _feed_flat(plane, plane.min_frames + 2)
+        plane.sample(serve={"shed": 50.0})
+        plane.record_occupancy(0, 0.5, docs=2)
+        return plane
+
+    def test_health_snapshot_composes_history(self):
+        plane = self._active_plane()
+        snap = health_snapshot(history=plane)
+        assert snap["history"]["rounds"] == plane.rounds
+        assert snap["history"]["anomaly"]["active"]
+        json.dumps(snap, default=str)
+
+    def test_prometheus_history_gauges(self):
+        plane = self._active_plane()
+        text = prometheus_text(history=plane)
+        for needle in (
+            "peritext_history_enabled 1",
+            "peritext_history_rounds ",
+            "peritext_history_frames_sampled ",
+            "peritext_history_frames_retained ",
+            'peritext_history_tier_frames{tier="0"} ',
+            "peritext_history_segments ",
+            "peritext_history_anomalies_active 1",
+            "peritext_history_anomalies_total 1",
+            'peritext_history_anomaly_by_key{key="serve.shed"} 1',
+            "peritext_history_occupancy_rows 1",
+            "peritext_history_sample_overhead_seconds ",
+        ):
+            assert needle in text, needle
+
+    def test_timeseries_route_and_query_params(self):
+        import urllib.request
+
+        from peritext_tpu.obs import MetricsServer
+
+        plane = self._active_plane()
+        server = MetricsServer(history=plane)
+        host, port = server.start()
+        base = f"http://{host}:{port}"
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"{base}/timeseries.json", timeout=5).read())
+            assert body["rounds"] == plane.rounds
+            assert body["anomaly"]["active"]
+            keyed = json.loads(urllib.request.urlopen(
+                f"{base}/timeseries.json?key=serve.shed&rate=1&window=4",
+                timeout=5).read())
+            assert keyed["key"] == "serve.shed"
+            assert len(keyed["points"]) == 4
+            assert keyed["summary"]["max"] == 50.0
+            assert keyed["rate"], "rate=1 produced no derivative"
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# the CLI: obs history / obs top / obs plan --history
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def _write_snapshot(self, tmp_path, plane):
+        path = tmp_path / "timeseries.json"
+        path.write_text(json.dumps(plane.snapshot(), default=str))
+        return path
+
+    def test_history_exit_codes(self, tmp_path, capsys):
+        from peritext_tpu.obs.__main__ import main as obs_main
+
+        quiet = _plane()
+        _feed_flat(quiet, quiet.min_frames + 2, value=3.0)
+        self._write_snapshot(tmp_path, quiet)
+        assert obs_main(["history", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "serve.shed" in out
+        # an active anomaly is exit 1 (the drift-check contract)
+        spiked = _plane()
+        _feed_flat(spiked, spiked.min_frames + 2)
+        spiked.sample(serve={"shed": 50.0})
+        hot = tmp_path / "hot"
+        hot.mkdir()
+        (hot / "timeseries.json").write_text(
+            json.dumps(spiked.snapshot(), default=str))
+        assert obs_main(["history", str(hot)]) == 1
+        err = capsys.readouterr().err
+        assert "anomaly: serve.shed [shed-storm]" in err
+        # unreadable source / unknown key are exit 2
+        assert obs_main(["history", str(tmp_path / "missing")]) == 2
+        assert obs_main(["history", str(tmp_path), "--key", "no.such"]) == 2
+
+    def test_history_key_view_with_rate(self, tmp_path, capsys):
+        from peritext_tpu.obs.__main__ import main as obs_main
+
+        plane = _plane()
+        for i in range(8):
+            plane.sample(serve={"admitted": float(i * 2)})
+        self._write_snapshot(tmp_path, plane)
+        assert obs_main(["history", str(tmp_path), "--key",
+                         "serve.admitted", "--rate", "--json"]) == 0
+        body = json.loads(capsys.readouterr().out)
+        assert len(body["points"]) == 8
+        assert body["rate"][-1][1] == 2.0
+        assert body["summary"]["delta"] == 14.0
+
+    def test_top_dashboard_over_live_server(self, capsys):
+        from peritext_tpu.obs import MetricsServer
+        from peritext_tpu.obs.__main__ import main as obs_main
+
+        plane = _plane()
+        for i in range(6):
+            plane.sample(serve={"admitted": float(i * 5)})
+        server = MetricsServer(history=plane)
+        host, port = server.start()
+        try:
+            assert obs_main(["top", f"http://{host}:{port}", "--json"]) == 0
+            body = json.loads(capsys.readouterr().out)
+        finally:
+            server.stop()
+        planes = {row["plane"] for row in body["planes"]}
+        assert {"health", "timeseries"} <= planes
+        assert body["movers"][0]["key"] == "serve.admitted"
+        assert body["movers"][0]["delta"] == 25.0
+
+    def test_plan_surfaces_history_weighted_terms(self, tmp_path, capsys):
+        from peritext_tpu.obs.__main__ import main as obs_main
+
+        plane = _plane()
+        for occ in BIMODAL:
+            plane.record_occupancy(0, occ)
+        hist_path = tmp_path / "history.json"
+        hist_path.write_text(json.dumps(plane.snapshot(), default=str))
+        assert obs_main(["plan", str(SNAPSHOT),
+                         "--history", str(hist_path)]) in (0, 1)
+        out = capsys.readouterr().out
+        assert "history-weighted terms: dispatch_cost, utilization" in out
+        assert "16 occupancy row(s)" in out
+        assert obs_main(["plan", str(SNAPSHOT), "--history",
+                         str(tmp_path / "nope.json")]) == 2
